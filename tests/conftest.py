@@ -1,0 +1,15 @@
+"""Test harness config: force CPU backend with 8 virtual devices.
+
+Mirrors the reference's DistributedQueryRunner strategy (SURVEY §4):
+"N servers in one process" — here, an 8-device virtual CPU mesh stands in
+for an 8-chip TPU slice so sharding/collective paths compile and execute
+without TPU hardware.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import trino_tpu
+
+trino_tpu.force_cpu(8)

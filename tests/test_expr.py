@@ -1,0 +1,256 @@
+"""Expression lowering tests: IR -> jax lanes with 3-valued logic.
+
+Golden behavior mirrors the reference's expression semantics
+(sql/gen/ExpressionCompiler + sql/ir evaluation): NULL propagation,
+Kleene AND/OR, decimal scale arithmetic, dictionary-code string predicates.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.expr import ir
+from trino_tpu.expr.lower import LoweringContext, compile_expr
+from trino_tpu.expr.functions import arith_result_type, days_from_civil
+
+
+def lane(vals, valid=None, dtype=jnp.int64):
+    v = jnp.asarray(np.array(vals), dtype=dtype)
+    ok = (
+        jnp.ones(v.shape, dtype=bool)
+        if valid is None
+        else jnp.asarray(np.array(valid, dtype=bool))
+    )
+    return (v, ok)
+
+
+def col(name, typ=T.BIGINT):
+    return ir.ColumnRef(typ, name)
+
+
+def test_comparison_basic():
+    e = ir.Comparison("<", col("x"), ir.Constant(T.BIGINT, 5))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([1, 5, 9])})
+    assert list(np.asarray(v)) == [True, False, False]
+    assert all(np.asarray(ok))
+
+
+def test_null_propagation_comparison():
+    e = ir.Comparison("=", col("x"), ir.Constant(T.BIGINT, 3))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([3, 3], valid=[True, False])})
+    assert list(np.asarray(ok)) == [True, False]
+
+
+def test_kleene_and():
+    # null AND false = false (valid); null AND true = null
+    a = ir.Comparison("=", col("x"), ir.Constant(T.BIGINT, 1))
+    b = ir.Comparison("=", col("y"), ir.Constant(T.BIGINT, 1))
+    f = compile_expr(ir.Logical("and", (a, b)))
+    # x null, y=0 -> (null AND false) = false, valid
+    v, ok = f({"x": lane([9], valid=[False]), "y": lane([0])})
+    assert list(np.asarray(ok)) == [True]
+    assert list(np.asarray(v)) == [False]
+    # x null, y=1 -> null
+    v, ok = f({"x": lane([9], valid=[False]), "y": lane([1])})
+    assert list(np.asarray(ok)) == [False]
+
+
+def test_kleene_or():
+    a = ir.Comparison("=", col("x"), ir.Constant(T.BIGINT, 1))
+    b = ir.Comparison("=", col("y"), ir.Constant(T.BIGINT, 1))
+    f = compile_expr(ir.Logical("or", (a, b)))
+    # x null, y=1 -> true valid
+    v, ok = f({"x": lane([9], valid=[False]), "y": lane([1])})
+    assert list(np.asarray(v)) == [True]
+    assert list(np.asarray(ok)) == [True]
+
+
+def test_decimal_multiply_rescale():
+    # extendedprice * (1 - discount): decimal(12,2) * decimal(13,2)
+    price = col("p", T.decimal(12, 2))
+    disc = col("d", T.decimal(12, 2))
+    one = ir.Constant(T.decimal(1, 0), 1)
+    sub_t = arith_result_type("subtract", one.type, disc.type)
+    sub = ir.Call(sub_t, "subtract", (one, disc))
+    mul_t = arith_result_type("multiply", price.type, sub_t)
+    mul = ir.Call(mul_t, "multiply", (price, sub))
+    f = compile_expr(mul)
+    # p=10.00 (1000), d=0.05 (5) -> 10.00*0.95 = 9.50
+    v, ok = f({"p": lane([1000]), "d": lane([5])})
+    scale = mul_t.scale
+    assert int(np.asarray(v)[0]) == int(9.5 * 10**scale)
+
+
+def test_between():
+    e = ir.Between(
+        col("x", T.decimal(12, 2)),
+        ir.Constant(T.decimal(12, 2), 500),
+        ir.Constant(T.decimal(12, 2), 700),
+    )
+    f = compile_expr(e)
+    v, ok = f({"x": lane([499, 500, 600, 700, 701])})
+    assert list(np.asarray(v)) == [False, True, True, True, False]
+
+
+def test_in_list():
+    e = ir.In(col("x"), (ir.Constant(T.BIGINT, 1), ir.Constant(T.BIGINT, 3)))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([1, 2, 3])})
+    assert list(np.asarray(v)) == [True, False, True]
+
+
+def test_dict_equality_uses_codes():
+    d = np.array(["AIR", "MAIL", "SHIP"], dtype=object)
+    ctx = LoweringContext({"mode": d})
+    e = ir.Comparison("=", col("mode", T.VARCHAR), ir.Constant(T.VARCHAR, "MAIL"))
+    f = compile_expr(e, ctx)
+    v, ok = f({"mode": lane([0, 1, 2], dtype=jnp.int32)})
+    assert list(np.asarray(v)) == [False, True, False]
+
+
+def test_dict_ordered_comparison():
+    d = np.array(["AIR", "MAIL", "SHIP"], dtype=object)
+    ctx = LoweringContext({"mode": d})
+    e = ir.Comparison("<", col("mode", T.VARCHAR), ir.Constant(T.VARCHAR, "MAIL"))
+    f = compile_expr(e, ctx)
+    v, ok = f({"mode": lane([0, 1, 2], dtype=jnp.int32)})
+    assert list(np.asarray(v)) == [True, False, False]
+
+
+def test_like_dictionary():
+    d = np.array(["PROMO BRASS", "STANDARD COPPER", "PROMO PLATED"], dtype=object)
+    ctx = LoweringContext({"ptype": d})
+    e = ir.Call(
+        T.BOOLEAN,
+        "like",
+        (col("ptype", T.VARCHAR), ir.Constant(T.VARCHAR, "PROMO%")),
+    )
+    f = compile_expr(e, ctx)
+    v, ok = f({"ptype": lane([0, 1, 2], dtype=jnp.int32)})
+    assert list(np.asarray(v)) == [True, False, True]
+
+
+def test_case_expression():
+    e = ir.Case(
+        T.BIGINT,
+        (
+            ir.WhenClause(
+                ir.Comparison("<", col("x"), ir.Constant(T.BIGINT, 0)),
+                ir.Constant(T.BIGINT, -1),
+            ),
+            ir.WhenClause(
+                ir.Comparison("=", col("x"), ir.Constant(T.BIGINT, 0)),
+                ir.Constant(T.BIGINT, 0),
+            ),
+        ),
+        ir.Constant(T.BIGINT, 1),
+    )
+    f = compile_expr(e)
+    v, ok = f({"x": lane([-5, 0, 7])})
+    assert list(np.asarray(v)) == [-1, 0, 1]
+
+
+def test_year_extract():
+    e = ir.Call(T.BIGINT, "year", (col("d", T.DATE),))
+    f = compile_expr(e)
+    days = [days_from_civil(1994, 1, 1), days_from_civil(1998, 12, 31), 0]
+    v, ok = f({"d": lane(days, dtype=jnp.int32)})
+    assert list(np.asarray(v)) == [1994, 1998, 1970]
+
+
+def test_days_from_civil_roundtrip():
+    import datetime
+
+    for y, m, d in [(1970, 1, 1), (1992, 2, 29), (1998, 12, 1), (2000, 3, 1)]:
+        days = days_from_civil(y, m, d)
+        assert datetime.date(1970, 1, 1) + datetime.timedelta(days=days) == datetime.date(y, m, d)
+
+
+def test_is_null():
+    e = ir.IsNull(col("x"))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([1, 2], valid=[True, False])})
+    assert list(np.asarray(v)) == [False, True]
+    assert all(np.asarray(ok))
+
+
+def test_cast_decimal_to_double():
+    e = ir.Cast(T.DOUBLE, col("x", T.decimal(10, 2)))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([150])})
+    assert float(np.asarray(v)[0]) == pytest.approx(1.5)
+
+
+def test_divide_decimal():
+    t = arith_result_type("divide", T.decimal(12, 2), T.decimal(12, 2))
+    e = ir.Call(t, "divide", (col("a", T.decimal(12, 2)), col("b", T.decimal(12, 2))))
+    f = compile_expr(e)
+    v, ok = f({"a": lane([100]), "b": lane([300])})  # 1.00 / 3.00
+    assert int(np.asarray(v)[0]) == round(10**t.scale / 3)
+
+
+# --- regressions from code review -------------------------------------
+
+
+def test_negative_decimal_rescale_rounds_half_away():
+    e = ir.Cast(T.decimal(10, 0), col("x", T.decimal(10, 1)))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([-54, -55, -56, 54, 55])})  # -5.4 -5.5 -5.6 5.4 5.5
+    assert list(np.asarray(v)) == [-5, -6, -6, 5, 6]
+
+
+def test_negative_decimal_divide():
+    t = arith_result_type("divide", T.decimal(12, 2), T.decimal(12, 2))
+    e = ir.Call(t, "divide", (col("a", T.decimal(12, 2)), col("b", T.decimal(12, 2))))
+    f = compile_expr(e)
+    v, ok = f({"a": lane([-100, 100]), "b": lane([300, -300])})
+    expected = -round(10**t.scale / 3)
+    assert list(np.asarray(v)) == [expected, expected]
+
+
+def test_between_mixed_scales():
+    # x decimal(12,2) BETWEEN 0.050 (scale 3) AND 0.07 (scale 2)
+    e = ir.Between(
+        col("x", T.decimal(12, 2)),
+        ir.Constant(T.decimal(12, 3), 50),
+        ir.Constant(T.decimal(12, 2), 7),
+    )
+    f = compile_expr(e)
+    v, ok = f({"x": lane([4, 5, 6, 7, 8])})  # 0.04 .. 0.08
+    assert list(np.asarray(v)) == [False, True, True, True, False]
+
+
+def test_modulus_follows_dividend_sign():
+    e = ir.Call(T.BIGINT, "modulus", (col("a"), col("b")))
+    f = compile_expr(e)
+    v, ok = f({"a": lane([-7, 7]), "b": lane([2, -2])})
+    assert list(np.asarray(v)) == [-1, 1]
+
+
+def test_round_half_away_double():
+    e = ir.Call(T.DOUBLE, "round", (col("x", T.DOUBLE),))
+    f = compile_expr(e)
+    v, ok = f({"x": lane([2.5, -2.5, 3.5], dtype=jnp.float64)})
+    assert list(np.asarray(v)) == [3.0, -3.0, 4.0]
+
+
+def test_dict_vs_dict_ordered_comparison_raises():
+    d = np.array(["B", "A"], dtype=object)
+    ctx = LoweringContext({"a": d, "b": np.array(["A", "C"], dtype=object)})
+    e = ir.Comparison("<", col("a", T.VARCHAR), col("b", T.VARCHAR))
+    f = compile_expr(e, ctx)
+    with pytest.raises(NotImplementedError):
+        f({"a": lane([0], dtype=jnp.int32), "b": lane([0], dtype=jnp.int32)})
+
+
+def test_is_distinct_dict_constant():
+    d = np.array(["AIR", "MAIL"], dtype=object)
+    ctx = LoweringContext({"m": d})
+    e = ir.Comparison("is_distinct", col("m", T.VARCHAR), ir.Constant(T.VARCHAR, "MAIL"))
+    f = compile_expr(e, ctx)
+    v, ok = f({"m": (jnp.asarray(np.array([0, 1], np.int32)), jnp.asarray(np.array([True, False])))})
+    # AIR distinct from MAIL: true; NULL distinct from MAIL: true
+    assert list(np.asarray(v)) == [True, True]
+    assert all(np.asarray(ok))

@@ -1,0 +1,76 @@
+"""Columnar model tests (reference: spi/Page, spi/block/* behavior)."""
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.page import Column, Page, column_from_pylist, page_from_pydict, pad_to
+
+
+def test_fixed_width_column_roundtrip():
+    c = column_from_pylist(T.BIGINT, [1, 2, None, 4])
+    assert len(c) == 4
+    assert c.has_nulls
+    assert c.to_python() == [1, 2, None, 4]
+
+
+def test_decimal_column_scaled_int64():
+    c = column_from_pylist(T.decimal(12, 2), [1.5, 2.25, None])
+    assert c.values.dtype == np.int64
+    assert list(c.values) == [150, 225, 0]
+    assert c.to_python() == [1.5, 2.25, None]
+
+
+def test_varchar_dictionary_encoding():
+    c = column_from_pylist(T.VARCHAR, ["a", "b", "a", None, "c"])
+    assert c.values.dtype == np.int32
+    assert c.to_python() == ["a", "b", "a", None, "c"]
+    assert len(c.dictionary) == 3
+
+
+def test_date_column():
+    c = column_from_pylist(T.DATE, ["1994-01-01", "1970-01-01", None])
+    assert list(c.values[:2]) == [8766, 0]
+    assert c.to_python() == ["1994-01-01", "1970-01-01", None]
+
+
+def test_boolean_column():
+    c = column_from_pylist(T.BOOLEAN, [True, False, None])
+    assert c.to_python() == [True, False, None]
+
+
+def test_page_pylist():
+    p = page_from_pydict(
+        [("a", T.BIGINT), ("b", T.VARCHAR)],
+        {"a": [1, 2], "b": ["x", "y"]},
+    )
+    assert p.to_pylist() == [(1, "x"), (2, "y")]
+    assert p.by_name("b").to_python() == ["x", "y"]
+
+
+def test_pad_to():
+    a = np.array([1, 2, 3], dtype=np.int64)
+    b = pad_to(a, 8)
+    assert b.shape == (8,)
+    assert list(b[:3]) == [1, 2, 3]
+    assert list(b[3:]) == [0] * 5
+
+
+def test_page_padding_with_count():
+    vals = pad_to(np.array([1, 2, 3], dtype=np.int64), 8)
+    p = Page([Column(T.BIGINT, vals)], 3, ["a"])
+    assert p.count == 3
+    assert p.capacity == 8
+    assert p.to_pylist() == [(1,), (2,), (3,)]
+
+
+def test_type_parsing():
+    assert T.parse_type("decimal(12,2)") == T.decimal(12, 2)
+    assert T.parse_type("varchar(25)").length == 25
+    assert T.parse_type("bigint") is T.BIGINT
+    assert str(T.decimal(12, 2)) == "decimal(12,2)"
+
+
+def test_common_super_type():
+    assert T.common_super_type(T.BIGINT, T.INTEGER) is T.BIGINT
+    d = T.common_super_type(T.decimal(12, 2), T.decimal(10, 4))
+    assert (d.precision, d.scale) == (14, 4)
+    assert T.common_super_type(T.decimal(5, 2), T.DOUBLE) is T.DOUBLE
