@@ -1,0 +1,78 @@
+"""sqlite3-based correctness oracle.
+
+The reference validates SQL semantics against an H2 in-memory DB loaded with
+TPC-H (testing/trino-testing/.../H2QueryRunner.java:91).  Here sqlite (stdlib)
+plays the H2 role: identical generated data is loaded host-side and the same
+(or dialect-adjusted) SQL runs on both engines; results are diffed with
+decimal tolerance.
+"""
+from __future__ import annotations
+
+import sqlite3
+from typing import Iterable, Sequence
+
+import numpy as np
+
+from trino_tpu.connectors import tpch
+from trino_tpu.page import Column, Page
+
+
+def load_tpch(conn: sqlite3.Connection, sf: float, tables: Iterable[str]):
+    for table in tables:
+        schema = tpch.SCHEMAS[table]
+        cols = ", ".join(c for c, _ in schema)
+        conn.execute(f"CREATE TABLE {table} ({cols})")
+        values, dicts, count = tpch.generate(table, sf)
+        page = Page(
+            [Column(t, values[c], None, dicts.get(c)) for c, t in schema],
+            count,
+            [c for c, _ in schema],
+        )
+        rows = page.to_pylist()
+        ph = ", ".join(["?"] * len(schema))
+        conn.executemany(f"INSERT INTO {table} VALUES ({ph})", rows)
+    conn.commit()
+
+
+def normalize(rows: Sequence[tuple]) -> list:
+    out = []
+    for r in rows:
+        norm = []
+        for v in r:
+            if isinstance(v, float):
+                norm.append(round(v, 4))
+            elif isinstance(v, np.generic):
+                norm.append(v.item())
+            else:
+                norm.append(v)
+        out.append(tuple(norm))
+    return out
+
+
+def assert_rows_match(actual, expected, tol=1e-2, ordered=True):
+    assert len(actual) == len(expected), (
+        f"row count {len(actual)} != {len(expected)}\n"
+        f"actual[:5]={actual[:5]}\nexpected[:5]={expected[:5]}"
+    )
+    a = actual if ordered else sorted(map(repr, actual))
+    b = expected if ordered else sorted(map(repr, expected))
+    if not ordered:
+        # fall back to repr-sort only for fully-hashable rows
+        a = sorted(normalize(actual), key=repr)
+        b = sorted(normalize(expected), key=repr)
+    else:
+        a = normalize(actual)
+        b = normalize(expected)
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        assert len(ra) == len(rb), f"row {i}: arity {len(ra)} != {len(rb)}"
+        for j, (va, vb) in enumerate(zip(ra, rb)):
+            if isinstance(va, float) or isinstance(vb, float):
+                assert va is not None and vb is not None, (
+                    f"row {i} col {j}: {va!r} != {vb!r}"
+                )
+                denom = max(1.0, abs(vb))
+                assert abs(float(va) - float(vb)) / denom <= tol, (
+                    f"row {i} col {j}: {va!r} != {vb!r}"
+                )
+            else:
+                assert va == vb, f"row {i} col {j}: {va!r} != {vb!r}"

@@ -1,0 +1,157 @@
+"""TPC-H generator invariants (reference: io.trino.tpch dbgen semantics via
+plugin/trino-tpch TpchRecordSetProvider)."""
+import sqlite3
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors import tpch
+
+SF = 0.001  # tiny: 1.5k orders, ~6k lineitems
+
+
+def test_row_counts():
+    for table in ("region", "nation"):
+        _, _, n = tpch.generate(table, SF)
+        assert n == {"region": 5, "nation": 25}[table]
+    _, _, n = tpch.generate("orders", SF)
+    assert n == 1500
+
+
+def test_split_independence():
+    """Concatenating N splits must equal the single-split generation."""
+    whole, _, n_whole = tpch.generate("lineitem", SF, 0, 1)
+    parts = [tpch.generate("lineitem", SF, i, 3) for i in range(3)]
+    n_sum = sum(p[2] for p in parts)
+    assert n_sum == n_whole
+    for col in ("l_orderkey", "l_quantity", "l_shipdate"):
+        cat = np.concatenate([p[0][col] for p in parts])
+        assert np.array_equal(cat, whole[col])
+
+
+def test_sparse_orderkeys():
+    vals, _, _ = tpch.generate("orders", SF, columns=["o_orderkey"])
+    ok = vals["o_orderkey"]
+    assert len(np.unique(ok)) == len(ok)
+    # 8 of every 32: keys mod 32 in [1..8]
+    assert ((ok - 1) % 32 < 8).all()
+
+
+def test_custkey_skips_multiples_of_3():
+    vals, _, _ = tpch.generate("orders", SF, columns=["o_custkey"])
+    ck = vals["o_custkey"]
+    assert (ck % 3 != 0).all()
+    assert ck.min() >= 1
+    assert ck.max() <= 150  # 150000 * 0.001
+
+
+def test_lineitem_partsupp_consistency():
+    """Every lineitem (partkey, suppkey) must exist in partsupp (Q9 join)."""
+    li, _, _ = tpch.generate("lineitem", SF, columns=["l_partkey", "l_suppkey"])
+    ps, _, _ = tpch.generate("partsupp", SF, columns=["ps_partkey", "ps_suppkey"])
+    pairs = set(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    li_pairs = set(zip(li["l_partkey"].tolist(), li["l_suppkey"].tolist()))
+    assert li_pairs <= pairs
+
+
+def test_extendedprice_formula():
+    li, _, _ = tpch.generate(
+        "lineitem", SF, columns=["l_partkey", "l_quantity", "l_extendedprice"]
+    )
+    qty = li["l_quantity"] // 100
+    expected = qty * (
+        90000 + (li["l_partkey"] // 10) % 20001 + 100 * (li["l_partkey"] % 1000)
+    )
+    assert np.array_equal(li["l_extendedprice"], expected)
+
+
+def test_returnflag_linestatus_relationship():
+    li, dicts, _ = tpch.generate(
+        "lineitem", SF, columns=["l_returnflag", "l_linestatus", "l_shipdate", "l_receiptdate"]
+    )
+    rf = dicts["l_returnflag"][li["l_returnflag"]]
+    ls = dicts["l_linestatus"][li["l_linestatus"]]
+    ship, receipt = li["l_shipdate"], li["l_receiptdate"]
+    assert ((receipt <= tpch.CURRENT_DATE) == np.isin(rf, ["A", "R"])).all()
+    assert ((ship > tpch.CURRENT_DATE) == (ls == "O")).all()
+    # both statuses must occur
+    assert set(np.unique(ls)) == {"F", "O"}
+
+
+def test_orderstatus_consistent_with_lines():
+    orders, odicts, _ = tpch.generate("orders", SF, columns=["o_orderkey", "o_orderstatus"])
+    li, ldicts, _ = tpch.generate("lineitem", SF, columns=["l_orderkey", "l_linestatus"])
+    status = {k: odicts["o_orderstatus"][s] for k, s in zip(orders["o_orderkey"], orders["o_orderstatus"])}
+    ls = ldicts["l_linestatus"][li["l_linestatus"]]
+    import collections
+
+    per_order = collections.defaultdict(set)
+    for k, s in zip(li["l_orderkey"], ls):
+        per_order[k].add(s)
+    for k, statuses in per_order.items():
+        if statuses == {"F"}:
+            assert status[k] == "F", k
+        elif statuses == {"O"}:
+            assert status[k] == "O", k
+        else:
+            assert status[k] == "P", k
+
+
+def test_dates_chain():
+    li, _, _ = tpch.generate(
+        "lineitem", SF, columns=["l_shipdate", "l_commitdate", "l_receiptdate"]
+    )
+    assert (li["l_receiptdate"] > li["l_shipdate"]).all()
+    assert (li["l_shipdate"] >= tpch.EPOCH_1992).all()
+
+
+def test_q6_selectivity_reasonable():
+    """Q6 predicate should select a few percent of lineitem."""
+    li, _, n = tpch.generate(
+        "lineitem", SF, columns=["l_shipdate", "l_discount", "l_quantity"]
+    )
+    d94 = 8766  # 1994-01-01
+    d95 = d94 + 365
+    sel = (
+        (li["l_shipdate"] >= d94)
+        & (li["l_shipdate"] < d95)
+        & (li["l_discount"] >= 5)
+        & (li["l_discount"] <= 7)
+        & (li["l_quantity"] < 2400)
+    )
+    frac = sel.sum() / n
+    assert 0.005 < frac < 0.05, frac
+
+
+def test_page_source_spi():
+    conn = tpch.TpchConnectorFactory().create("tpch", {"tpch.scale-factor": SF})
+    md = conn.metadata()
+    assert "lineitem" in md.list_tables()
+    stats = md.get_table_statistics("orders")
+    assert stats.row_count == 1500
+    splits = conn.split_manager().get_splits("lineitem", 4)
+    src = conn.page_source_provider().create_page_source(
+        splits[0], ["l_orderkey", "l_shipmode"]
+    )
+    pages = list(src.pages())
+    assert len(pages) == 1
+    assert pages[0].names == ["l_orderkey", "l_shipmode"]
+    assert "l_shipmode" in src.dictionaries()
+
+
+def test_sqlite_oracle_loads():
+    from oracle import load_tpch
+
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["nation", "region"])
+    n = conn.execute(
+        "SELECT count(*) FROM nation n JOIN region r ON n.n_regionkey = r.r_regionkey"
+    ).fetchone()[0]
+    assert n == 25
+    eu = conn.execute(
+        "SELECT n_name FROM nation JOIN region ON n_regionkey = r_regionkey "
+        "WHERE r_name = 'EUROPE' ORDER BY n_name"
+    ).fetchall()
+    assert [r[0] for r in eu] == [
+        "FRANCE", "GERMANY", "ROMANIA", "RUSSIA", "UNITED KINGDOM"
+    ]

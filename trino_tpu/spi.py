@@ -1,0 +1,134 @@
+"""Connector SPI — the plugin boundary.
+
+Reference parity: core/trino-spi/src/main/java/io/trino/spi/connector/
+(Plugin.java:36, ConnectorMetadata, ConnectorSplitManager,
+ConnectorPageSourceProvider -> ConnectorPageSource.getNextPage:59).
+
+The engine sees data sources only through these interfaces; connectors
+(connectors/tpch.py, memory.py, blackhole.py) implement them.  Pages are
+host-side numpy columns; upload to HBM happens at the operator boundary
+(the LazyBlock analog — spi/block/LazyBlock.java:32).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import types as T
+from .page import Page
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnSchema:
+    name: str
+    type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSchema:
+    name: str
+    columns: Tuple[ColumnSchema, ...]
+
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+    def column_type(self, name: str) -> T.Type:
+        for c in self.columns:
+            if c.name == name:
+                return c.type
+        raise KeyError(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnStatistics:
+    """Per-column stats for the CBO (spi/statistics/ColumnStatistics)."""
+
+    distinct_count: Optional[float] = None
+    null_fraction: float = 0.0
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class TableStatistics:
+    """Reference: spi/statistics/TableStatistics via
+    ConnectorMetadata.getTableStatistics (TpchMetadata supplies these
+    for the reference's CBO)."""
+
+    row_count: float
+    columns: Dict[str, ColumnStatistics] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Split:
+    """A unit of parallel scan work (spi/connector/ConnectorSplit)."""
+
+    table: str
+    ordinal: int
+    total: int
+    info: dict = dataclasses.field(default_factory=dict)
+
+
+class ConnectorMetadata:
+    def list_tables(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_table_schema(self, table: str) -> TableSchema:
+        raise NotImplementedError
+
+    def get_table_statistics(self, table: str) -> TableStatistics:
+        raise NotImplementedError
+
+
+class SplitManager:
+    def get_splits(self, table: str, desired: int) -> List[Split]:
+        raise NotImplementedError
+
+
+class PageSource:
+    """Streaming page iterator (ConnectorPageSource.getNextPage)."""
+
+    def pages(self) -> Iterator[Page]:
+        raise NotImplementedError
+
+    def dictionaries(self) -> Dict[str, np.ndarray]:
+        """Host dictionaries for varchar columns produced by this source."""
+        return {}
+
+
+class PageSourceProvider:
+    def create_page_source(
+        self, split: Split, columns: Sequence[str]
+    ) -> PageSource:
+        raise NotImplementedError
+
+
+class Connector:
+    """One mounted catalog (spi/connector/Connector)."""
+
+    name: str
+
+    def metadata(self) -> ConnectorMetadata:
+        raise NotImplementedError
+
+    def split_manager(self) -> SplitManager:
+        raise NotImplementedError
+
+    def page_source_provider(self) -> PageSourceProvider:
+        raise NotImplementedError
+
+
+class Plugin:
+    """Reference: spi/Plugin.java:36 — a factory of connector factories."""
+
+    def connector_factories(self) -> Dict[str, "ConnectorFactory"]:
+        return {}
+
+
+class ConnectorFactory:
+    name: str
+
+    def create(self, catalog_name: str, config: dict) -> Connector:
+        raise NotImplementedError
