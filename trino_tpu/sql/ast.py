@@ -1,0 +1,255 @@
+"""Untyped SQL AST.
+
+Reference parity: core/trino-parser/src/main/java/io/trino/sql/tree/
+(289 node classes).  This is the SELECT-core subset that covers TPC-H/
+TPC-DS-style analytics: query specification, joins, subqueries, CTEs,
+set operations, and the expression grammar.  Nodes are plain dataclasses;
+the analyzer (analyzer.py) types them into trino_tpu.expr.ir.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+
+class Node:
+    pass
+
+
+# --- expressions -------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Identifier(Node):
+    parts: Tuple[str, ...]  # possibly qualified: (table, column)
+
+    def __repr__(self):
+        return ".".join(self.parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Literal(Node):
+    kind: str  # 'integer' | 'decimal' | 'string' | 'null' | 'boolean' | 'double'
+    value: object
+
+    def __repr__(self):
+        return f"{self.value!r}"
+
+
+@dataclasses.dataclass(frozen=True)
+class TypedLiteral(Node):
+    """DATE 'x', TIMESTAMP 'x', INTERVAL 'n' unit, DECIMAL 'x'."""
+
+    kind: str
+    value: str
+    unit: Optional[str] = None  # interval unit
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Node):
+    op: str  # '-' | '+'
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryOp(Node):
+    op: str  # + - * / % ||
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class ComparisonOp(Node):
+    op: str  # = <> < <= > >=
+    left: Node
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class LogicalOp(Node):
+    op: str  # 'and' | 'or'
+    terms: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class NotOp(Node):
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNullOp(Node):
+    operand: Node
+    negate: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class BetweenOp(Node):
+    value: Node
+    low: Node
+    high: Node
+    negate: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Node):
+    value: Node
+    items: Tuple[Node, ...]
+    negate: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Node):
+    value: Node
+    query: "Query"
+    negate: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class Exists(Node):
+    query: "Query"
+    negate: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Node):
+    query: "Query"
+
+
+@dataclasses.dataclass(frozen=True)
+class LikeOp(Node):
+    value: Node
+    pattern: Node
+    escape: Optional[Node]
+    negate: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionCall(Node):
+    name: str
+    args: Tuple[Node, ...]
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclasses.dataclass(frozen=True)
+class CastOp(Node):
+    operand: Node
+    type_name: str
+    safe: bool = False  # try_cast
+
+
+@dataclasses.dataclass(frozen=True)
+class ExtractOp(Node):
+    field: str  # year|month|day|quarter
+    operand: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class WhenClause(Node):
+    condition: Node
+    result: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class CaseExpr(Node):
+    operand: Optional[Node]  # simple CASE if set
+    whens: Tuple[WhenClause, ...]
+    default: Optional[Node]
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Node):
+    qualifier: Optional[str] = None  # t.* qualifier
+
+
+# --- relations ---------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Table(Node):
+    name: Tuple[str, ...]  # (catalog, schema, table) suffix-qualified
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryRelation(Node):
+    query: "Query"
+    alias: Optional[str] = None
+    columns: Optional[Tuple[str, ...]] = None  # ") AS t (a, b)" form
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(Node):
+    kind: str  # inner | left | right | full | cross
+    left: Node
+    right: Node
+    condition: Optional[Node]  # ON expr (None for cross)
+
+
+# --- query structure ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SelectItem(Node):
+    expr: Node
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SortItem(Node):
+    expr: Node
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # None = dialect default
+
+
+@dataclasses.dataclass(frozen=True)
+class QuerySpec(Node):
+    """SELECT ... FROM ... WHERE ... GROUP BY ... HAVING ..."""
+
+    items: Tuple[Node, ...]  # SelectItem | Star
+    relation: Optional[Node]
+    where: Optional[Node]
+    group_by: Tuple[Node, ...]
+    having: Optional[Node]
+    distinct: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOp(Node):
+    kind: str  # union | intersect | except
+    all: bool
+    left: Node  # QuerySpec | SetOp
+    right: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class With(Node):
+    name: str
+    query: "Query"
+    columns: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Node):
+    """Full query: [WITH ...] body [ORDER BY ...] [LIMIT n]"""
+
+    body: Node  # QuerySpec | SetOp
+    order_by: Tuple[SortItem, ...] = ()
+    limit: Optional[int] = None
+    withs: Tuple[With, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Explain(Node):
+    query: Query
+    analyze: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Node):
+    catalog: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowColumns(Node):
+    table: Tuple[str, ...] = ()

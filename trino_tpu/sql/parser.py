@@ -1,0 +1,643 @@
+"""SQL lexer + Pratt parser.
+
+Reference parity: core/trino-grammar/src/main/antlr4/.../SqlBase.g4 (1419
+lines) + SqlParser.java:51.  The reference uses ANTLR; this is a hand-rolled
+recursive-descent/Pratt parser over the SELECT-core grammar (ast.py), which
+covers the TPC-H/TPC-DS query shapes: joins, subqueries, CTEs, set ops,
+CASE/CAST/EXTRACT/BETWEEN/IN/LIKE/EXISTS, date/interval literals.
+
+Operator precedence (low to high), matching SqlBase.g4's expression rules:
+  OR < AND < NOT < comparison|BETWEEN|IN|LIKE|IS < + - || < * / % < unary.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+from . import ast
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+|--[^\n]*\n?|/\*.*?\*/)
+  | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
+  | (?P<string>'(?:[^']|'')*')
+  | (?P<qident>"(?:[^"]|"")*")
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
+  | (?P<op><>|!=|>=|<=|\|\||=>|[-+*/%(),.;=<>\[\]?])
+""",
+    re.VERBOSE | re.DOTALL,
+)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "as", "and", "or", "not", "in", "exists", "between", "like", "escape",
+    "is", "null", "true", "false", "case", "when", "then", "else", "end",
+    "cast", "try_cast", "extract", "join", "inner", "left", "right", "full",
+    "outer", "cross", "on", "using", "union", "intersect", "except", "all",
+    "distinct", "with", "asc", "desc", "nulls", "first", "last", "date",
+    "timestamp", "interval", "year", "month", "day", "hour", "minute",
+    "second", "quarter", "explain", "analyze", "show", "tables", "columns",
+    "substring", "for", "fetch", "offset", "rows", "row", "only", "values",
+}
+
+
+class Token:
+    __slots__ = ("kind", "text", "pos")
+
+    def __init__(self, kind: str, text: str, pos: int):
+        self.kind = kind  # number|string|ident|qident|op|kw|eof
+        self.text = text
+        self.pos = pos
+
+    def __repr__(self):
+        return f"{self.kind}:{self.text}"
+
+
+def tokenize(sql: str) -> List[Token]:
+    out: List[Token] = []
+    i = 0
+    while i < len(sql):
+        m = _TOKEN_RE.match(sql, i)
+        if not m:
+            raise ParseError(f"unexpected character {sql[i]!r} at {i}")
+        i = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        text = m.group()
+        if kind == "ident" and text.lower() in KEYWORDS:
+            out.append(Token("kw", text.lower(), m.start()))
+        elif kind == "qident":
+            out.append(Token("ident", text[1:-1].replace('""', '"'), m.start()))
+        else:
+            out.append(Token(kind, text, m.start()))
+    out.append(Token("eof", "", len(sql)))
+    return out
+
+
+class ParseError(ValueError):
+    pass
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.toks = tokenize(sql)
+        self.i = 0
+
+    # --- token helpers -------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def at_kw(self, *kws: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.text in kws
+
+    def accept_kw(self, *kws: str) -> bool:
+        if self.at_kw(*kws):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, kw: str):
+        if not self.accept_kw(kw):
+            raise ParseError(f"expected {kw.upper()} at {self.peek()!r}")
+
+    def accept_op(self, op: str) -> bool:
+        t = self.peek()
+        if t.kind == "op" and t.text == op:
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str):
+        if not self.accept_op(op):
+            raise ParseError(f"expected {op!r} at {self.peek()!r} in {self.sql[max(0,self.peek().pos-30):self.peek().pos+10]!r}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind == "ident":
+            return self.next().text
+        # soft keywords usable as identifiers
+        if t.kind == "kw" and t.text in (
+            "year", "month", "day", "date", "first", "last", "left", "right",
+            "tables", "columns", "values", "row", "rows",
+        ):
+            return self.next().text
+        raise ParseError(f"expected identifier at {t!r}")
+
+    # --- entry ---------------------------------------------------------
+    def parse_statement(self) -> ast.Node:
+        if self.accept_kw("explain"):
+            analyze = self.accept_kw("analyze")
+            q = self.parse_query()
+            self._finish()
+            return ast.Explain(q, analyze)
+        if self.accept_kw("show"):
+            if self.accept_kw("tables"):
+                self._finish()
+                return ast.ShowTables()
+            if self.accept_kw("columns"):
+                self.expect_kw("from")
+                name = self.qualified_name()
+                self._finish()
+                return ast.ShowColumns(name)
+            raise ParseError("SHOW TABLES | SHOW COLUMNS FROM t")
+        q = self.parse_query()
+        self._finish()
+        return q
+
+    def _finish(self):
+        self.accept_op(";")
+        if self.peek().kind != "eof":
+            raise ParseError(f"trailing input at {self.peek()!r}")
+
+    # --- query ---------------------------------------------------------
+    def parse_query(self) -> ast.Query:
+        withs: List[ast.With] = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.ident()
+                cols = None
+                if self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                withs.append(ast.With(name, q, tuple(cols) if cols else None))
+                if not self.accept_op(","):
+                    break
+        body = self.parse_set_expr()
+        order: List[ast.SortItem] = []
+        limit = None
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.sort_item())
+            while self.accept_op(","):
+                order.append(self.sort_item())
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind == "kw" and t.text == "all":
+                limit = None
+            else:
+                limit = int(t.text)
+        elif self.accept_kw("fetch"):
+            self.accept_kw("first") or self.accept_kw("next")
+            t = self.next()
+            limit = int(t.text)
+            self.accept_kw("rows") or self.accept_kw("row")
+            self.expect_kw("only")
+        return ast.Query(body, tuple(order), limit, tuple(withs))
+
+    def sort_item(self) -> ast.SortItem:
+        e = self.expr()
+        asc = True
+        if self.accept_kw("asc"):
+            asc = True
+        elif self.accept_kw("desc"):
+            asc = False
+        nf = None
+        if self.accept_kw("nulls"):
+            if self.accept_kw("first"):
+                nf = True
+            else:
+                self.expect_kw("last")
+                nf = False
+        return ast.SortItem(e, asc, nf)
+
+    def parse_set_expr(self) -> ast.Node:
+        left = self.parse_query_primary()
+        while self.at_kw("union", "intersect", "except"):
+            kind = self.next().text
+            all_ = self.accept_kw("all")
+            self.accept_kw("distinct")
+            right = self.parse_query_primary()
+            left = ast.SetOp(kind, all_, left, right)
+        return left
+
+    def parse_query_primary(self) -> ast.Node:
+        if self.accept_op("("):
+            q = self.parse_set_expr()
+            self.expect_op(")")
+            return q
+        return self.parse_query_spec()
+
+    def parse_query_spec(self) -> ast.QuerySpec:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        items: List[ast.Node] = [self.select_item()]
+        while self.accept_op(","):
+            items.append(self.select_item())
+        relation = None
+        where = None
+        group: List[ast.Node] = []
+        having = None
+        if self.accept_kw("from"):
+            relation = self.parse_relation()
+        if self.accept_kw("where"):
+            where = self.expr()
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            group.append(self.expr())
+            while self.accept_op(","):
+                group.append(self.expr())
+        if self.accept_kw("having"):
+            having = self.expr()
+        return ast.QuerySpec(
+            tuple(items), relation, where, tuple(group), having, distinct
+        )
+
+    def select_item(self) -> ast.Node:
+        if self.accept_op("*"):
+            return ast.Star()
+        # t.* form
+        if (
+            self.peek().kind == "ident"
+            and self.peek(1).kind == "op"
+            and self.peek(1).text == "."
+            and self.peek(2).kind == "op"
+            and self.peek(2).text == "*"
+        ):
+            q = self.next().text
+            self.next()
+            self.next()
+            return ast.Star(q)
+        e = self.expr()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().text
+        return ast.SelectItem(e, alias)
+
+    # --- relations -----------------------------------------------------
+    def parse_relation(self) -> ast.Node:
+        rel = self.join_chain()
+        while self.accept_op(","):  # implicit cross join
+            right = self.join_chain()
+            rel = ast.Join("cross", rel, right, None)
+        return rel
+
+    def join_chain(self) -> ast.Node:
+        rel = self.relation_primary()
+        while True:
+            if self.accept_kw("cross"):
+                self.expect_kw("join")
+                right = self.relation_primary()
+                rel = ast.Join("cross", rel, right, None)
+                continue
+            kind = None
+            if self.at_kw("join"):
+                kind = "inner"
+            elif self.at_kw("inner") and self.peek(1).text == "join":
+                self.next()
+                kind = "inner"
+            elif self.at_kw("left", "right", "full"):
+                k = self.peek().text
+                nxt1 = self.peek(1)
+                nxt2 = self.peek(2)
+                if (nxt1.kind == "kw" and nxt1.text == "join") or (
+                    nxt1.kind == "kw" and nxt1.text == "outer"
+                    and nxt2.kind == "kw" and nxt2.text == "join"
+                ):
+                    self.next()
+                    self.accept_kw("outer")
+                    kind = k
+            if kind is None:
+                return rel
+            self.expect_kw("join")
+            right = self.relation_primary()
+            if self.accept_kw("on"):
+                cond = self.expr()
+            elif self.accept_kw("using"):
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                cond = None
+                for c in cols:
+                    eq = ast.ComparisonOp(
+                        "=", ast.Identifier((c,)), ast.Identifier((c,))
+                    )
+                    cond = eq if cond is None else ast.LogicalOp("and", (cond, eq))
+                raise ParseError("USING join not supported yet; use ON")
+            else:
+                raise ParseError("JOIN requires ON")
+            rel = ast.Join(kind, rel, right, cond)
+
+    def relation_primary(self) -> ast.Node:
+        if self.accept_op("("):
+            # subquery or parenthesized join
+            if self.at_kw("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                alias = None
+                cols = None
+                if self.accept_kw("as"):
+                    alias = self.ident()
+                elif self.peek().kind == "ident":
+                    alias = self.next().text
+                if alias is not None and self.accept_op("("):
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                return ast.SubqueryRelation(q, alias, tuple(cols) if cols else None)
+            rel = self.parse_relation()
+            self.expect_op(")")
+            return rel
+        name = self.qualified_name()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().text
+        return ast.Table(name, alias)
+
+    def qualified_name(self) -> Tuple[str, ...]:
+        parts = [self.ident()]
+        while (
+            self.peek().kind == "op"
+            and self.peek().text == "."
+            and self.peek(1).kind in ("ident", "kw")
+        ):
+            self.next()
+            parts.append(self.ident())
+        return tuple(parts)
+
+    # --- expressions (Pratt) -------------------------------------------
+    def expr(self) -> ast.Node:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Node:
+        terms = [self.and_expr()]
+        while self.accept_kw("or"):
+            terms.append(self.and_expr())
+        return terms[0] if len(terms) == 1 else ast.LogicalOp("or", tuple(terms))
+
+    def and_expr(self) -> ast.Node:
+        terms = [self.not_expr()]
+        while self.accept_kw("and"):
+            terms.append(self.not_expr())
+        return terms[0] if len(terms) == 1 else ast.LogicalOp("and", tuple(terms))
+
+    def not_expr(self) -> ast.Node:
+        if self.accept_kw("not"):
+            return ast.NotOp(self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> ast.Node:
+        left = self.additive()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in ("=", "<>", "!=", "<", "<=", ">", ">="):
+                self.next()
+                right = self.additive()
+                left = ast.ComparisonOp(
+                    "<>" if t.text == "!=" else t.text, left, right
+                )
+                continue
+            negate = False
+            save = self.i
+            if self.accept_kw("not"):
+                negate = True
+            if self.accept_kw("between"):
+                lo = self.additive()
+                self.expect_kw("and")
+                hi = self.additive()
+                left = ast.BetweenOp(left, lo, hi, negate)
+                continue
+            if self.accept_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.parse_query()
+                    self.expect_op(")")
+                    left = ast.InSubquery(left, q, negate)
+                else:
+                    items = [self.expr()]
+                    while self.accept_op(","):
+                        items.append(self.expr())
+                    self.expect_op(")")
+                    left = ast.InList(left, tuple(items), negate)
+                continue
+            if self.accept_kw("like"):
+                pat = self.additive()
+                esc = None
+                if self.accept_kw("escape"):
+                    esc = self.additive()
+                left = ast.LikeOp(left, pat, esc, negate)
+                continue
+            if negate:
+                self.i = save
+                break
+            if self.accept_kw("is"):
+                neg = self.accept_kw("not")
+                if self.accept_kw("null"):
+                    left = ast.IsNullOp(left, neg)
+                elif self.accept_kw("distinct"):
+                    self.expect_kw("from")
+                    right = self.additive()
+                    cmp = ast.ComparisonOp("is_distinct", left, right)
+                    left = ast.NotOp(cmp) if neg else cmp
+                else:
+                    raise ParseError(f"IS what? at {self.peek()!r}")
+                continue
+            break
+        return left
+
+    def additive(self) -> ast.Node:
+        left = self.multiplicative()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in ("+", "-", "||"):
+                self.next()
+                left = ast.BinaryOp(t.text, left, self.multiplicative())
+            else:
+                return left
+
+    def multiplicative(self) -> ast.Node:
+        left = self.unary()
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text in ("*", "/", "%"):
+                self.next()
+                left = ast.BinaryOp(t.text, left, self.unary())
+            else:
+                return left
+
+    def unary(self) -> ast.Node:
+        if self.accept_op("-"):
+            return ast.UnaryOp("-", self.unary())
+        if self.accept_op("+"):
+            return self.unary()
+        return self.postfix()
+
+    def postfix(self) -> ast.Node:
+        e = self.primary()
+        return e
+
+    def primary(self) -> ast.Node:
+        t = self.peek()
+        if t.kind == "number":
+            self.next()
+            if "." in t.text or "e" in t.text.lower():
+                if "e" in t.text.lower():
+                    return ast.Literal("double", float(t.text))
+                return ast.Literal("decimal", t.text)
+            return ast.Literal("integer", int(t.text))
+        if t.kind == "string":
+            self.next()
+            return ast.Literal("string", t.text[1:-1].replace("''", "'"))
+        if t.kind == "op" and t.text == "(":
+            self.next()
+            if self.at_kw("select", "with"):
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.ScalarSubquery(q)
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "kw":
+            if self.accept_kw("null"):
+                return ast.Literal("null", None)
+            if self.accept_kw("true"):
+                return ast.Literal("boolean", True)
+            if self.accept_kw("false"):
+                return ast.Literal("boolean", False)
+            if self.accept_kw("exists"):
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                return ast.Exists(q, False)
+            if self.accept_kw("cast") or (
+                t.text == "try_cast" and self.accept_kw("try_cast")
+            ):
+                safe = t.text == "try_cast"
+                self.expect_op("(")
+                e = self.expr()
+                self.expect_kw("as")
+                tn = self.type_name()
+                self.expect_op(")")
+                return ast.CastOp(e, tn, safe)
+            if self.accept_kw("extract"):
+                self.expect_op("(")
+                field = self.next().text.lower()
+                self.expect_kw("from")
+                e = self.expr()
+                self.expect_op(")")
+                return ast.ExtractOp(field, e)
+            if self.accept_kw("case"):
+                operand = None
+                if not self.at_kw("when"):
+                    operand = self.expr()
+                whens = []
+                while self.accept_kw("when"):
+                    c = self.expr()
+                    self.expect_kw("then")
+                    r = self.expr()
+                    whens.append(ast.WhenClause(c, r))
+                default = None
+                if self.accept_kw("else"):
+                    default = self.expr()
+                self.expect_kw("end")
+                return ast.CaseExpr(operand, tuple(whens), default)
+            if self.at_kw("date", "timestamp") and self.peek(1).kind == "string":
+                kind = self.next().text
+                v = self.next().text
+                return ast.TypedLiteral(kind, v[1:-1])
+            if (
+                self.at_kw("date", "timestamp")
+                and self.peek(1).kind == "op"
+                and self.peek(1).text == "("
+            ):
+                # date('1994-01-01') function form -> typed literal / cast
+                kind = self.next().text
+                self.next()
+                e = self.expr()
+                self.expect_op(")")
+                if isinstance(e, ast.Literal) and e.kind == "string":
+                    return ast.TypedLiteral(kind, e.value)
+                return ast.CastOp(e, kind)
+            if self.accept_kw("interval"):
+                sign = -1 if self.accept_op("-") else 1
+                v = self.next()  # string or number
+                txt = v.text[1:-1] if v.kind == "string" else v.text
+                unit = self.next().text.lower()
+                if sign < 0:
+                    txt = "-" + txt
+                return ast.TypedLiteral("interval", txt, unit)
+            if self.accept_kw("substring"):
+                self.expect_op("(")
+                e = self.expr()
+                if self.accept_kw("from"):
+                    start = self.expr()
+                    length = None
+                    if self.accept_kw("for"):
+                        length = self.expr()
+                else:
+                    self.expect_op(",")
+                    start = self.expr()
+                    length = None
+                    if self.accept_op(","):
+                        length = self.expr()
+                self.expect_op(")")
+                args = (e, start) + ((length,) if length is not None else ())
+                return ast.FunctionCall("substring", args)
+        # identifier or function call
+        name = self.ident() if self.peek().kind != "kw" else None
+        if name is None:
+            # keyword-named functions (e.g. left/right already handled via ident())
+            raise ParseError(f"unexpected token {t!r}")
+        if self.peek().kind == "op" and self.peek().text == "(":
+            self.next()
+            distinct = False
+            is_star = False
+            args: List[ast.Node] = []
+            if self.accept_op("*"):
+                is_star = True
+            elif not (self.peek().kind == "op" and self.peek().text == ")"):
+                distinct = self.accept_kw("distinct")
+                self.accept_kw("all")
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+            self.expect_op(")")
+            return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star)
+        parts = [name]
+        while (
+            self.peek().kind == "op"
+            and self.peek().text == "."
+            and self.peek(1).kind in ("ident", "kw")
+        ):
+            self.next()
+            parts.append(self.ident())
+        return ast.Identifier(tuple(parts))
+
+    def type_name(self) -> str:
+        base = self.next().text.lower()
+        if self.accept_op("("):
+            inner = [self.next().text]
+            while self.accept_op(","):
+                inner.append(self.next().text)
+            self.expect_op(")")
+            return f"{base}({','.join(inner)})"
+        return base
+
+
+def parse(sql: str) -> ast.Node:
+    """Parse one SQL statement (SqlParser.createStatement analog)."""
+    return Parser(sql).parse_statement()
