@@ -186,3 +186,43 @@ def test_all_22_tpch_queries_parse():
         except ParseError as e:
             failed.append((f.name, str(e)[:90]))
     assert not failed, failed
+
+
+# --- regressions from code review -------------------------------------
+
+
+def test_soft_keyword_column():
+    q = parse("select year from t")
+    assert q.body.items[0].expr.parts == ("year",)
+
+
+def test_intersect_binds_tighter_than_union():
+    q = parse("select 1 union select 2 intersect select 3")
+    assert q.body.kind == "union"
+    assert q.body.right.kind == "intersect"
+
+
+def test_limit_non_integer_is_parse_error():
+    with pytest.raises(ParseError):
+        parse("select 1 limit 1.5")
+    with pytest.raises(ParseError):
+        parse("select 1 limit foo")
+
+
+def test_parenthesized_ordered_branch_in_union():
+    q = parse("(select x from t order by x limit 1) union all select y from u")
+    assert q.body.kind == "union"
+    assert isinstance(q.body.left, ast.Query)
+    assert q.body.left.limit == 1
+
+
+def test_interval_requires_unit():
+    with pytest.raises(ParseError):
+        parse("select interval '3'")
+    with pytest.raises(ParseError):
+        parse("select interval '3' bogus")
+
+
+def test_using_join_raises_cleanly():
+    with pytest.raises(ParseError):
+        parse("select * from a join b using (x)")

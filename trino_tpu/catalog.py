@@ -1,0 +1,60 @@
+"""Catalog management: mounted connectors + metadata facade.
+
+Reference parity: metadata/MetadataManager (facade over connectors),
+connector/CatalogManager + DefaultCatalogFactory (etc/catalog/*.properties
+-> ConnectorFactory.create per catalog).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .spi import Connector, ConnectorFactory, TableSchema, TableStatistics
+
+
+class CatalogManager:
+    def __init__(self):
+        self._factories: Dict[str, ConnectorFactory] = {}
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register_factory(self, factory: ConnectorFactory):
+        self._factories[factory.name] = factory
+
+    def create_catalog(self, name: str, connector_name: str, config: dict):
+        factory = self._factories[connector_name]
+        self._catalogs[name] = factory.create(name, config)
+
+    def get(self, name: str) -> Connector:
+        if name not in self._catalogs:
+            raise KeyError(f"catalog not found: {name}")
+        return self._catalogs[name]
+
+    def names(self) -> List[str]:
+        return list(self._catalogs)
+
+
+class Metadata:
+    """MetadataManager analog: resolution entry point for the analyzer."""
+
+    def __init__(self, catalogs: CatalogManager):
+        self.catalogs = catalogs
+
+    def resolve_table(
+        self, parts, default_catalog: Optional[str]
+    ) -> "tuple[str, TableSchema]":
+        """parts: (table,) | (schema, table) | (catalog, schema, table)."""
+        if len(parts) == 3:
+            catalog, _schema, table = parts
+        elif len(parts) == 2:
+            catalog, table = default_catalog, parts[1]
+        else:
+            catalog, table = default_catalog, parts[0]
+        if catalog is None:
+            raise ValueError(f"no catalog specified for table {'.'.join(parts)}")
+        conn = self.catalogs.get(catalog)
+        md = conn.metadata()
+        if parts[-1] not in md.list_tables():
+            raise KeyError(f"table not found: {catalog}.{parts[-1]}")
+        return catalog, md.get_table_schema(parts[-1])
+
+    def table_statistics(self, catalog: str, table: str) -> TableStatistics:
+        return self.catalogs.get(catalog).metadata().get_table_statistics(table)

@@ -1,0 +1,364 @@
+"""Logical plan node algebra.
+
+Reference parity: core/trino-main/.../sql/planner/plan/ (~60 node types:
+TableScanNode, FilterNode, ProjectNode, AggregationNode, JoinNode,
+SemiJoinNode, ExchangeNode, SortNode, TopNNode, LimitNode, OutputNode,
+ValuesNode, EnforceSingleRowNode ...).
+
+Expressions inside nodes are typed trino_tpu.expr.ir trees whose ColumnRefs
+name *symbols* (SSA-ish unique column names, the reference's Symbol class).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from .. import types as T
+from ..expr import ir
+from ..ops.sort import SortKey
+
+
+class PlanNode:
+    @property
+    def sources(self) -> Tuple["PlanNode", ...]:
+        return ()
+
+    def output_symbols(self) -> List[str]:
+        raise NotImplementedError
+
+    def output_types(self) -> Dict[str, T.Type]:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class TableScan(PlanNode):
+    catalog: str
+    table: str
+    # symbol -> source column name
+    assignments: Tuple[Tuple[str, str], ...]
+    types: Tuple[Tuple[str, T.Type], ...]
+
+    def output_symbols(self):
+        return [s for s, _ in self.assignments]
+
+    def output_types(self):
+        return dict(self.types)
+
+
+@dataclasses.dataclass(frozen=True)
+class Values(PlanNode):
+    """Literal rows (ValuesNode): symbols + per-row constant tuples."""
+
+    symbols: Tuple[str, ...]
+    types_: Tuple[Tuple[str, T.Type], ...]
+    rows: Tuple[Tuple[object, ...], ...]
+
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        return dict(self.types_)
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(PlanNode):
+    source: PlanNode
+    predicate: ir.Expr
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(PlanNode):
+    source: PlanNode
+    assignments: Tuple[Tuple[str, ir.Expr], ...]
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return [s for s, _ in self.assignments]
+
+    def output_types(self):
+        return {s: e.type for s, e in self.assignments}
+
+
+@dataclasses.dataclass(frozen=True)
+class AggInfo:
+    output: str
+    kind: str  # sum|count|count_star|min|max|avg
+    arg: Optional[str]  # input symbol
+    distinct: bool
+    input_type: Optional[T.Type]
+    output_type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(PlanNode):
+    """AggregationNode. step follows the reference's PARTIAL/FINAL/SINGLE
+    (plan/AggregationNode.java:346); the planner emits SINGLE and the
+    optimizer/fragmenter splits around exchanges."""
+
+    source: PlanNode
+    keys: Tuple[str, ...]
+    aggs: Tuple[AggInfo, ...]
+    step: str = "single"  # single | partial | final
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return list(self.keys) + [a.output for a in self.aggs]
+
+    def output_types(self):
+        src = self.source.output_types()
+        out = {k: src[k] for k in self.keys}
+        for a in self.aggs:
+            out[a.output] = a.output_type
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(PlanNode):
+    """JoinNode: equi-criteria + optional residual filter."""
+
+    kind: str  # inner | left | cross (right/full planned to left+project)
+    left: PlanNode
+    right: PlanNode
+    criteria: Tuple[Tuple[str, str], ...]  # (left_symbol, right_symbol)
+    filter: Optional[ir.Expr] = None
+
+    @property
+    def sources(self):
+        return (self.left, self.right)
+
+    def output_symbols(self):
+        return self.left.output_symbols() + self.right.output_symbols()
+
+    def output_types(self):
+        out = dict(self.left.output_types())
+        out.update(self.right.output_types())
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SemiJoin(PlanNode):
+    """SemiJoinNode: marks rows of source whose key appears in filtering
+    source; output adds a boolean symbol."""
+
+    source: PlanNode
+    filtering: PlanNode
+    source_key: str
+    filtering_key: str
+    output: str
+    negate_unused: bool = False
+
+    @property
+    def sources(self):
+        return (self.source, self.filtering)
+
+    def output_symbols(self):
+        return self.source.output_symbols() + [self.output]
+
+    def output_types(self):
+        out = dict(self.source.output_types())
+        out[self.output] = T.BOOLEAN
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ScalarJoin(PlanNode):
+    """EnforceSingleRowNode + cross join of a 1-row subquery: attaches the
+    subquery's single row's columns to every source row."""
+
+    source: PlanNode
+    subquery: PlanNode
+
+    @property
+    def sources(self):
+        return (self.source, self.subquery)
+
+    def output_symbols(self):
+        return self.source.output_symbols() + self.subquery.output_symbols()
+
+    def output_types(self):
+        out = dict(self.source.output_types())
+        out.update(self.subquery.output_types())
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(PlanNode):
+    source: PlanNode
+    keys: Tuple[SortKey, ...]
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass(frozen=True)
+class TopN(PlanNode):
+    source: PlanNode
+    keys: Tuple[SortKey, ...]
+    count: int
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(PlanNode):
+    source: PlanNode
+    count: int
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass(frozen=True)
+class Distinct(PlanNode):
+    """SELECT DISTINCT; lowered to grouped Aggregate with no aggregates."""
+
+    source: PlanNode
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass(frozen=True)
+class SetOperation(PlanNode):
+    """Union/intersect/except (UnionNode & friends). Inputs are mapped to
+    shared output symbols positionally."""
+
+    kind: str  # union | intersect | except
+    all: bool
+    inputs: Tuple[PlanNode, ...]
+    symbols: Tuple[str, ...]
+    types_: Tuple[Tuple[str, T.Type], ...]
+
+    @property
+    def sources(self):
+        return self.inputs
+
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        return dict(self.types_)
+
+
+@dataclasses.dataclass(frozen=True)
+class Output(PlanNode):
+    """OutputNode: final column names for the client."""
+
+    source: PlanNode
+    names: Tuple[str, ...]
+    symbols: Tuple[str, ...]
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        src = self.source.output_types()
+        return {s: src[s] for s in self.symbols}
+
+
+@dataclasses.dataclass(frozen=True)
+class Exchange(PlanNode):
+    """ExchangeNode (distribution boundary; added by the optimizer's
+    AddExchanges analog). partitioning: 'single' gathers everything,
+    'hash' repartitions by keys, 'broadcast' replicates."""
+
+    source: PlanNode
+    partitioning: str  # single | hash | broadcast
+    keys: Tuple[str, ...] = ()
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+def visit_plan(node: PlanNode, fn, depth=0):
+    fn(node, depth)
+    for s in node.sources:
+        visit_plan(s, fn, depth + 1)
+
+
+def plan_to_string(node: PlanNode) -> str:
+    """EXPLAIN-style textual plan (PlanPrinter analog)."""
+    lines: List[str] = []
+
+    def fmt(n: PlanNode, d: int):
+        pad = "  " * d
+        name = type(n).__name__
+        extra = ""
+        if isinstance(n, TableScan):
+            extra = f" {n.catalog}.{n.table} {[s for s, _ in n.assignments]}"
+        elif isinstance(n, Filter):
+            extra = f" {n.predicate!r}"
+        elif isinstance(n, Project):
+            extra = f" {[s for s, _ in n.assignments]}"
+        elif isinstance(n, Aggregate):
+            extra = f" keys={list(n.keys)} aggs={[a.output for a in n.aggs]} step={n.step}"
+        elif isinstance(n, Join):
+            extra = f" {n.kind} on={list(n.criteria)}"
+        elif isinstance(n, (TopN,)):
+            extra = f" n={n.count} keys={[k.column for k in n.keys]}"
+        elif isinstance(n, Limit):
+            extra = f" n={n.count}"
+        elif isinstance(n, Exchange):
+            extra = f" {n.partitioning} keys={list(n.keys)}"
+        elif isinstance(n, Output):
+            extra = f" {list(n.names)}"
+        lines.append(f"{pad}{name}{extra}")
+
+    visit_plan(node, fmt)
+    return "\n".join(lines)

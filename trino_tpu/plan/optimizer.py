@@ -1,0 +1,377 @@
+"""Logical plan optimizer.
+
+Reference parity: sql/planner/PlanOptimizers.java:267 (1094-line ordered
+pipeline, 221 iterative rules + visitor optimizers).  This is the minimal
+rule set that matters for TPC-H-class plans (SURVEY §7 step 5):
+
+  - predicate pushdown + cross-join-to-inner-join
+    (PredicatePushDown + iterative rules EliminateCrossJoins)
+  - join build-side selection using connector statistics
+    (the CBO's DetermineJoinDistributionType / ReorderJoins role, reduced
+    to: probe side = larger, build side = unique-keyed dimension side)
+  - column pruning into table scans (PruneUnreferencedOutputs +
+    PushProjectionIntoTableScan — the generator then never materializes
+    unused columns)
+  - trivial projection/filter cleanup
+
+Exchange placement (AddExchanges) happens at fragmentation time
+(parallel/fragmenter.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import types as T
+from ..catalog import Metadata
+from ..expr import ir
+from . import nodes as P
+
+
+def optimize(plan: P.PlanNode, metadata: Optional[Metadata] = None) -> P.PlanNode:
+    prev = None
+    cur = plan
+    for _ in range(20):
+        if cur == prev:
+            break
+        prev = cur
+        cur = _push_predicates(cur)
+        cur = _merge_filters(cur)
+    if metadata is not None:
+        cur = _choose_build_sides(cur, metadata)
+    cur = _prune_columns(cur)
+    return cur
+
+
+# --- predicate pushdown ------------------------------------------------
+
+
+def _conjuncts(e: ir.Expr) -> List[ir.Expr]:
+    if isinstance(e, ir.Logical) and e.op == "and":
+        out: List[ir.Expr] = []
+        for t in e.terms:
+            out.extend(_conjuncts(t))
+        return out
+    return [e]
+
+
+def _combine(conj: List[ir.Expr]) -> Optional[ir.Expr]:
+    if not conj:
+        return None
+    if len(conj) == 1:
+        return conj[0]
+    return ir.Logical("and", tuple(conj))
+
+
+def _rewrite_sources(node: P.PlanNode, new_sources: Tuple[P.PlanNode, ...]):
+    import dataclasses
+
+    if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
+                         P.Limit, P.Distinct, P.Output, P.Exchange)):
+        return dataclasses.replace(node, source=new_sources[0])
+    if isinstance(node, P.Join):
+        return dataclasses.replace(node, left=new_sources[0], right=new_sources[1])
+    if isinstance(node, P.SemiJoin):
+        return dataclasses.replace(
+            node, source=new_sources[0], filtering=new_sources[1]
+        )
+    if isinstance(node, P.ScalarJoin):
+        return dataclasses.replace(
+            node, source=new_sources[0], subquery=new_sources[1]
+        )
+    if isinstance(node, P.SetOperation):
+        return dataclasses.replace(node, inputs=new_sources)
+    return node
+
+
+def _push_predicates(node: P.PlanNode) -> P.PlanNode:
+    node = _rewrite_sources(
+        node, tuple(_push_predicates(s) for s in node.sources)
+    )
+    if not isinstance(node, P.Filter):
+        return node
+    src = node.source
+    conj = _conjuncts(node.predicate)
+
+    if isinstance(src, P.Filter):
+        return _push_predicates(
+            P.Filter(src.source, _combine(conj + _conjuncts(src.predicate)))
+        )
+
+    if isinstance(src, P.Project):
+        mapping = {s: e for s, e in src.assignments}
+        pushable: List[ir.Expr] = []
+        stay: List[ir.Expr] = []
+        for c in conj:
+            refs = ir.referenced_columns(c)
+            # only push through pure column-renames and cheap exprs
+            if all(r in mapping for r in refs):
+                pushable.append(ir.replace_refs(c, mapping))
+            else:
+                stay.append(c)
+        if pushable:
+            new_src = P.Project(
+                P.Filter(src.source, _combine(pushable)), src.assignments
+            )
+            rest = _combine(stay)
+            return P.Filter(new_src, rest) if rest else new_src
+        return node
+
+    if isinstance(src, P.Join) and src.kind in ("cross", "inner"):
+        lsyms = set(src.left.output_symbols())
+        rsyms = set(src.right.output_symbols())
+        to_left: List[ir.Expr] = []
+        to_right: List[ir.Expr] = []
+        criteria: List[Tuple[str, str]] = list(src.criteria)
+        residual: List[ir.Expr] = []
+        for c in conj:
+            refs = set(ir.referenced_columns(c))
+            if refs and refs <= lsyms:
+                to_left.append(c)
+            elif refs and refs <= rsyms:
+                to_right.append(c)
+            elif (
+                isinstance(c, ir.Comparison)
+                and c.op == "="
+                and isinstance(c.left, ir.ColumnRef)
+                and isinstance(c.right, ir.ColumnRef)
+            ):
+                if c.left.name in lsyms and c.right.name in rsyms:
+                    criteria.append((c.left.name, c.right.name))
+                elif c.left.name in rsyms and c.right.name in lsyms:
+                    criteria.append((c.right.name, c.left.name))
+                else:
+                    residual.append(c)
+            else:
+                residual.append(c)
+        left = P.Filter(src.left, _combine(to_left)) if to_left else src.left
+        right = (
+            P.Filter(src.right, _combine(to_right)) if to_right else src.right
+        )
+        kind = "inner" if criteria else src.kind
+        join_filter = src.filter
+        if residual and kind == "inner":
+            jf = _conjuncts(join_filter) if join_filter is not None else []
+            join_filter = _combine(jf + residual)
+            residual = []
+        newj = P.Join(kind, left, right, tuple(criteria), join_filter)
+        rest = _combine(residual)
+        return P.Filter(newj, rest) if rest else newj
+
+    if isinstance(src, P.SemiJoin):
+        # predicates not on the mark push below
+        mark = src.output
+        below = [c for c in conj if mark not in ir.referenced_columns(c)]
+        stay = [c for c in conj if mark in ir.referenced_columns(c)]
+        if below:
+            new_src = P.SemiJoin(
+                P.Filter(src.source, _combine(below)),
+                src.filtering,
+                src.source_key,
+                src.filtering_key,
+                src.output,
+            )
+            rest = _combine(stay)
+            return P.Filter(new_src, rest) if rest else new_src
+        return node
+
+    return node
+
+
+def _merge_filters(node: P.PlanNode) -> P.PlanNode:
+    node = _rewrite_sources(node, tuple(_merge_filters(s) for s in node.sources))
+    if isinstance(node, P.Filter) and isinstance(node.source, P.Filter):
+        return P.Filter(
+            node.source.source,
+            _combine(_conjuncts(node.predicate) + _conjuncts(node.source.predicate)),
+        )
+    return node
+
+
+# --- build-side selection ---------------------------------------------
+
+
+def _estimate_rows(node: P.PlanNode, metadata: Metadata) -> float:
+    if isinstance(node, P.TableScan):
+        return metadata.table_statistics(node.catalog, node.table).row_count
+    if isinstance(node, P.Filter):
+        base = _estimate_rows(node.source, metadata)
+        # crude selectivity: 0.3 per conjunct (FilterStatsCalculator stand-in)
+        k = len(_conjuncts(node.predicate))
+        return base * (0.3**k)
+    if isinstance(node, P.Join):
+        l = _estimate_rows(node.left, metadata)
+        r = _estimate_rows(node.right, metadata)
+        if node.kind == "cross":
+            return l * r
+        return max(l, r)
+    if isinstance(node, P.Aggregate):
+        return max(1.0, _estimate_rows(node.source, metadata) / 10)
+    if isinstance(node, (P.TopN, P.Limit)):
+        cnt = getattr(node, "count", 1)
+        return min(cnt, _estimate_rows(node.sources[0], metadata))
+    if node.sources:
+        return max(_estimate_rows(s, metadata) for s in node.sources)
+    return 1.0
+
+
+def _key_unique(node: P.PlanNode, symbol: str, metadata: Metadata) -> bool:
+    """Is `symbol` unique in node's output? Walk to the defining scan."""
+    if isinstance(node, P.TableScan):
+        col = dict(node.assignments).get(symbol)
+        if col is None:
+            return False
+        stats = metadata.table_statistics(node.catalog, node.table)
+        cs = stats.columns.get(col)
+        return cs is not None and cs.distinct_count == stats.row_count
+    if isinstance(node, P.Filter):
+        return _key_unique(node.source, symbol, metadata)
+    if isinstance(node, P.Project):
+        for s, e in node.assignments:
+            if s == symbol and isinstance(e, ir.ColumnRef):
+                return _key_unique(node.source, e.name, metadata)
+        return False
+    if isinstance(node, P.Aggregate):
+        return len(node.keys) == 1 and symbol in node.keys
+    if isinstance(node, P.Join):
+        # unique key of one side joined 1:1 stays unique-ish; conservative:
+        for s in node.sources:
+            if symbol in s.output_symbols():
+                return _key_unique(s, symbol, metadata)
+    if isinstance(node, (P.SemiJoin, P.ScalarJoin, P.Sort, P.TopN, P.Limit)):
+        return _key_unique(node.sources[0], symbol, metadata)
+    return False
+
+
+def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    node = _rewrite_sources(
+        node, tuple(_choose_build_sides(s, metadata) for s in node.sources)
+    )
+    if not (isinstance(node, P.Join) and node.kind == "inner" and node.criteria):
+        return node
+    # right side is the build side (HashBuilderOperator on right child).
+    # it must have unique join keys; prefer the smaller unique side.
+    lkeys = [l for l, _ in node.criteria]
+    rkeys = [r for _, r in node.criteria]
+    l_unique = all(_key_unique(node.left, k, metadata) for k in lkeys) or (
+        len(lkeys) > 1 and any(_key_unique(node.left, k, metadata) for k in lkeys)
+    )
+    r_unique = all(_key_unique(node.right, k, metadata) for k in rkeys) or (
+        len(rkeys) > 1 and any(_key_unique(node.right, k, metadata) for k in rkeys)
+    )
+    lrows = _estimate_rows(node.left, metadata)
+    rrows = _estimate_rows(node.right, metadata)
+    swap = False
+    if l_unique and not r_unique:
+        swap = True
+    elif l_unique and r_unique and lrows < rrows:
+        swap = True
+    if swap:
+        return P.Join(
+            "inner",
+            node.right,
+            node.left,
+            tuple((r, l) for l, r in node.criteria),
+            node.filter,
+        )
+    return node
+
+
+# --- column pruning ----------------------------------------------------
+
+
+def _prune_columns(root: P.PlanNode) -> P.PlanNode:
+    """Top-down required-symbol pruning (PruneUnreferencedOutputs +
+    PushProjectionIntoTableScan combined): each node keeps only outputs its
+    parent requires and tells children what it needs."""
+    import dataclasses
+
+    def prune(node: P.PlanNode, required: Set[str]) -> P.PlanNode:
+        if isinstance(node, P.Output):
+            return dataclasses.replace(
+                node, source=prune(node.source, set(node.symbols))
+            )
+        if isinstance(node, P.TableScan):
+            kept = tuple(
+                (s, c) for s, c in node.assignments if s in required
+            ) or node.assignments[:1]
+            keep_syms = {s for s, _ in kept}
+            types_ = tuple((s, t) for s, t in node.types if s in keep_syms)
+            return P.TableScan(node.catalog, node.table, kept, types_)
+        if isinstance(node, P.Project):
+            kept = tuple(
+                (s, e) for s, e in node.assignments if s in required
+            ) or node.assignments[:1]
+            need: Set[str] = set()
+            for _, e in kept:
+                need.update(ir.referenced_columns(e))
+            return P.Project(prune(node.source, need), kept)
+        if isinstance(node, P.Filter):
+            need = set(required) | set(ir.referenced_columns(node.predicate))
+            return P.Filter(prune(node.source, need), node.predicate)
+        if isinstance(node, P.Aggregate):
+            kept_aggs = tuple(a for a in node.aggs if a.output in required)
+            need = set(node.keys) | {a.arg for a in kept_aggs if a.arg}
+            return P.Aggregate(
+                prune(node.source, need), node.keys, kept_aggs, node.step
+            )
+        if isinstance(node, P.Join):
+            need = set(required)
+            for l, r in node.criteria:
+                need.add(l)
+                need.add(r)
+            if node.filter is not None:
+                need.update(ir.referenced_columns(node.filter))
+            lsyms = set(node.left.output_symbols())
+            rsyms = set(node.right.output_symbols())
+            return P.Join(
+                node.kind,
+                prune(node.left, need & lsyms),
+                prune(node.right, need & rsyms),
+                node.criteria,
+                node.filter,
+            )
+        if isinstance(node, P.SemiJoin):
+            need = (set(required) - {node.output}) | {node.source_key}
+            return dataclasses.replace(
+                node,
+                source=prune(node.source, need),
+                filtering=prune(node.filtering, {node.filtering_key}),
+            )
+        if isinstance(node, P.ScalarJoin):
+            sub_syms = set(node.subquery.output_symbols())
+            return dataclasses.replace(
+                node,
+                source=prune(node.source, set(required) - sub_syms),
+                subquery=prune(node.subquery, sub_syms),
+            )
+        if isinstance(node, (P.Sort, P.TopN)):
+            need = set(required) | {k.column for k in node.keys}
+            return dataclasses.replace(node, source=prune(node.source, need))
+        if isinstance(node, (P.Limit, P.Exchange)):
+            return dataclasses.replace(
+                node, source=prune(node.source, set(required))
+            )
+        if isinstance(node, P.Distinct):
+            # distinct is over all output columns — everything is required
+            return dataclasses.replace(
+                node,
+                source=prune(node.source, set(node.source.output_symbols())),
+            )
+        if isinstance(node, P.SetOperation):
+            new_inputs = []
+            for inp in node.inputs:
+                pos_syms = inp.output_symbols()
+                need = {
+                    pos_syms[i]
+                    for i, s in enumerate(node.symbols)
+                    if s in required or True  # positional: keep arity
+                }
+                new_inputs.append(prune(inp, need))
+            return dataclasses.replace(node, inputs=tuple(new_inputs))
+        if isinstance(node, P.Values):
+            return node
+        return _rewrite_sources(
+            node, tuple(prune(s, set(required)) for s in node.sources)
+        )
+
+    return prune(root, set(root.output_symbols()))

@@ -1,0 +1,74 @@
+"""Session facade: SQL in, Pages out.
+
+Reference parity: the in-process query path of testing/PlanTester.java:250 /
+StandaloneQueryRunner — parse -> analyze/plan -> optimize -> execute without
+a server.  The distributed path (coordinator/worker) layers on top of the
+same pipeline (server/).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .catalog import CatalogManager, Metadata
+from .connectors.tpch import TpchConnectorFactory
+from .exec.local import LocalExecutor
+from .page import Page
+from .plan import nodes as P
+from .plan.optimizer import optimize
+from .sql import ast
+from .sql.analyzer import Analyzer
+from .sql.parser import parse
+
+
+class Session:
+    def __init__(
+        self,
+        catalog: Optional[str] = None,
+        config: Optional[dict] = None,
+    ):
+        self.catalogs = CatalogManager()
+        self.catalogs.register_factory(TpchConnectorFactory())
+        self.default_catalog = catalog
+        self.config = dict(config or {})
+        self.metadata = Metadata(self.catalogs)
+        self.executor = LocalExecutor(self.catalogs, self.config)
+
+    def create_catalog(self, name: str, connector: str, config: dict):
+        self.catalogs.create_catalog(name, connector, config)
+        if self.default_catalog is None:
+            self.default_catalog = name
+
+    # ------------------------------------------------------------------
+    def plan(self, sql: str, optimized: bool = True) -> P.PlanNode:
+        stmt = parse(sql)
+        if isinstance(stmt, ast.Explain):
+            stmt = stmt.query
+        analyzer = Analyzer(self.metadata, self.default_catalog)
+        plan = analyzer.plan_statement(stmt)
+        if optimized:
+            plan = optimize(plan, self.metadata)
+        return plan
+
+    def explain(self, sql: str) -> str:
+        return P.plan_to_string(self.plan(sql))
+
+    def execute(self, sql: str) -> Page:
+        stmt = parse(sql)
+        if isinstance(stmt, ast.Explain):
+            from .page import column_from_pylist
+            from . import types as T
+
+            text = self.explain(sql[sql.lower().index("explain") + 7 :])
+            col = column_from_pylist(T.VARCHAR, text.split("\n"))
+            return Page([col], len(text.split("\n")), ["Query Plan"])
+        analyzer = Analyzer(self.metadata, self.default_catalog)
+        plan = analyzer.plan_statement(stmt)
+        plan = optimize(plan, self.metadata)
+        return self.executor.execute(plan)
+
+
+def tpch_session(sf: float = 0.01, **config) -> Session:
+    """One-liner dev entry (TpchQueryRunner analog, SURVEY appendix A)."""
+    s = Session()
+    s.create_catalog("tpch", "tpch", {"tpch.scale-factor": sf})
+    return s

@@ -1,0 +1,1110 @@
+"""Analyzer + logical planner: AST -> typed logical plan.
+
+Reference parity: sql/analyzer/StatementAnalyzer.java:423 (+ExpressionAnalyzer,
+AggregationAnalyzer, Scope/Field) and sql/planner/LogicalPlanner.java:165
+(QueryPlanner, RelationPlanner, SubqueryPlanner).  The reference splits
+analysis (producing an Analysis side-table) from planning; here the two are
+fused into one bottom-up pass producing plan nodes with typed expr IR —
+the Analysis artifacts (resolved types, coercions, aggregate extraction)
+are materialized directly in the plan.
+
+Naming: every relation column gets a unique *symbol* (Symbol allocator
+analog); scopes map (qualifier, name) -> (symbol, type).
+
+Aggregation planning mirrors QueryPlanner.planGroupByAggregation: group-key
+and aggregate-argument expressions are computed in a pre-projection, the
+Aggregate node consumes symbols only, and post-aggregation expressions are
+rewritten over key/agg output symbols (AggregationAnalyzer's validation
+that select expressions are composed of grouping keys and aggregates).
+
+Subqueries: uncorrelated IN -> SemiJoin; uncorrelated EXISTS / scalar ->
+ScalarJoin (EnforceSingleRow analog).  Correlated subqueries need the
+decorrelation rules (reference sql/planner/optimizations/
+TransformCorrelated*) — explicitly rejected for now.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..catalog import Metadata
+from ..expr import ir
+from ..expr.functions import arith_result_type, days_from_civil
+from ..ops.sort import SortKey
+from ..plan import nodes as P
+from . import ast
+
+AGGREGATES = {"sum", "count", "min", "max", "avg"}
+
+SCALAR_FUNCTIONS = {
+    "abs", "sqrt", "round", "floor", "ceil", "ceiling", "year", "month",
+    "day", "quarter", "length", "like",
+}
+
+
+class SemanticError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class Field:
+    qualifier: Optional[str]
+    name: str
+    symbol: str
+    type: T.Type
+
+
+class Scope:
+    def __init__(self, fields: List[Field]):
+        self.fields = fields
+
+    def resolve(self, parts: Tuple[str, ...]) -> Field:
+        if len(parts) == 1:
+            matches = [f for f in self.fields if f.name == parts[0]]
+        else:
+            q, n = parts[-2], parts[-1]
+            matches = [
+                f for f in self.fields if f.name == n and f.qualifier == q
+            ]
+        if not matches:
+            raise SemanticError(f"column not found: {'.'.join(parts)}")
+        if len(matches) > 1:
+            raise SemanticError(f"ambiguous column: {'.'.join(parts)}")
+        return matches[0]
+
+
+class SymbolAllocator:
+    def __init__(self):
+        self._counts: Dict[str, int] = {}
+
+    def new(self, base: str) -> str:
+        base = base.lower()[:40] or "expr"
+        n = self._counts.get(base, 0)
+        self._counts[base] = n + 1
+        return base if n == 0 else f"{base}_{n}"
+
+
+@dataclasses.dataclass
+class RelationPlan:
+    root: P.PlanNode
+    scope: Scope
+
+
+class Analyzer:
+    """One statement analysis+planning session (LogicalPlanner.plan)."""
+
+    def __init__(self, metadata: Metadata, default_catalog: Optional[str]):
+        self.metadata = metadata
+        self.default_catalog = default_catalog
+        self.symbols = SymbolAllocator()
+        self.ctes: Dict[str, ast.Query] = {}
+
+    # ------------------------------------------------------------------
+    def plan_statement(self, stmt: ast.Node) -> P.PlanNode:
+        if isinstance(stmt, ast.Query):
+            rp, names = self.plan_root_query(stmt)
+            return P.Output(
+                rp.root, tuple(names), tuple(f.symbol for f in rp.scope.fields)
+            )
+        raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
+
+    def plan_root_query(self, q: ast.Query) -> Tuple[RelationPlan, List[str]]:
+        rp, names = self.plan_query(q)
+        return rp, names
+
+    # ------------------------------------------------------------------
+    def plan_query(self, q: ast.Query) -> Tuple[RelationPlan, List[str]]:
+        saved = dict(self.ctes)
+        for w in q.withs:
+            self.ctes[w.name.lower()] = w
+        try:
+            if isinstance(q.body, ast.QuerySpec):
+                rp, names = self.plan_query_spec(
+                    q.body, q.order_by, q.limit
+                )
+            else:
+                rp, names = self.plan_set_op(q.body)
+                rp = self._apply_order_limit(
+                    rp, names, q.order_by, q.limit, post_agg=None
+                )
+            return rp, names
+        finally:
+            self.ctes = saved
+
+    def plan_set_op(self, s: ast.Node) -> Tuple[RelationPlan, List[str]]:
+        if isinstance(s, ast.QuerySpec):
+            return self.plan_query_spec(s, (), None)
+        if isinstance(s, ast.Query):
+            # parenthesized branch with its own ORDER BY / LIMIT
+            return self.plan_query(s)
+        assert isinstance(s, ast.SetOp)
+        lp, lnames = self.plan_set_op(s.left)
+        rp, rnames = self.plan_set_op(s.right)
+        lt = [f.type for f in lp.scope.fields]
+        rt = [f.type for f in rp.scope.fields]
+        if len(lt) != len(rt):
+            raise SemanticError("set operation arity mismatch")
+        out_types = [T.common_super_type(a, b) for a, b in zip(lt, rt)]
+        syms = [self.symbols.new(n) for n in lnames]
+        node = P.SetOperation(
+            s.kind,
+            s.all,
+            (self._coerce_output(lp, out_types), self._coerce_output(rp, out_types)),
+            tuple(syms),
+            tuple(zip(syms, out_types)),
+        )
+        scope = Scope(
+            [Field(None, n, sym, t) for n, sym, t in zip(lnames, syms, out_types)]
+        )
+        return RelationPlan(node, scope), lnames
+
+    def _coerce_output(self, rp: RelationPlan, out_types) -> P.PlanNode:
+        assigns = []
+        changed = False
+        for f, ot in zip(rp.scope.fields, out_types):
+            e: ir.Expr = ir.ColumnRef(f.type, f.symbol)
+            if f.type != ot:
+                e = ir.Cast(ot, e)
+                changed = True
+            assigns.append((f.symbol, e))
+        if not changed:
+            return rp.root
+        return P.Project(rp.root, tuple(assigns))
+
+    # ------------------------------------------------------------------
+    def plan_query_spec(
+        self,
+        spec: ast.QuerySpec,
+        order_by: Tuple[ast.SortItem, ...],
+        limit: Optional[int],
+    ) -> Tuple[RelationPlan, List[str]]:
+        # FROM
+        if spec.relation is None:
+            sym = self.symbols.new("dual")
+            rel = RelationPlan(
+                P.Values((sym,), ((sym, T.BIGINT),), ((0,),)), Scope([])
+            )
+        else:
+            rel = self.plan_relation(spec.relation)
+
+        # WHERE (conjuncts; IN/EXISTS subquery conjuncts become semi joins)
+        if spec.where is not None:
+            rel = self._plan_where(rel, spec.where)
+
+        # expand stars
+        items: List[ast.SelectItem] = []
+        for it in spec.items:
+            if isinstance(it, ast.Star):
+                for f in rel.scope.fields:
+                    if it.qualifier is None or f.qualifier == it.qualifier:
+                        items.append(
+                            ast.SelectItem(
+                                ast.Identifier(
+                                    (f.qualifier, f.name)
+                                    if f.qualifier
+                                    else (f.name,)
+                                ),
+                                None,
+                            )
+                        )
+            else:
+                items.append(it)
+
+        has_aggs = bool(spec.group_by) or any(
+            _contains_aggregate(it.expr) for it in items
+        ) or (spec.having is not None and _contains_aggregate(spec.having))
+
+        ea = ExprAnalyzer(self, rel)
+        if has_aggs:
+            rel, post = self._plan_aggregation(rel, spec, items, ea)
+            proj_analyzer = post
+        else:
+            if spec.having is not None:
+                raise SemanticError("HAVING without aggregation")
+            proj_analyzer = ea
+
+        # SELECT projection
+        names: List[str] = []
+        assigns: List[Tuple[str, ir.Expr]] = []
+        out_fields: List[Field] = []
+        for i, it in enumerate(items):
+            e = proj_analyzer.analyze(it.expr)
+            name = it.alias or _derive_name(it.expr, i)
+            sym = self.symbols.new(name)
+            names.append(name)
+            assigns.append((sym, e))
+            out_fields.append(Field(None, name.lower(), sym, e.type))
+        rel = RelationPlan(proj_analyzer.relation.root, proj_analyzer.relation.scope)
+        proj = P.Project(rel.root, tuple(assigns))
+        out = RelationPlan(proj, Scope(out_fields))
+
+        if spec.distinct:
+            out = RelationPlan(P.Distinct(out.root), out.scope)
+
+        out = self._apply_order_limit(
+            out, names, order_by, limit,
+            post_agg=proj_analyzer if has_aggs else None,
+            pre_projection=rel,
+            select_assigns=assigns,
+        )
+        return out, names
+
+    # ------------------------------------------------------------------
+    def _plan_where(self, rel: RelationPlan, where: ast.Node) -> RelationPlan:
+        conjuncts = _flatten_and(where)
+        plain: List[ast.Node] = []
+        for c in conjuncts:
+            if isinstance(c, ast.InSubquery):
+                rel = self._plan_semijoin(rel, c.value, c.query, c.negate)
+            elif isinstance(c, ast.Exists):
+                rel = self._plan_exists(rel, c.query, c.negate)
+            elif isinstance(c, ast.NotOp) and isinstance(c.operand, ast.Exists):
+                rel = self._plan_exists(rel, c.operand.query, not c.operand.negate)
+            else:
+                plain.append(c)
+        if plain:
+            ea = ExprAnalyzer(self, rel)
+            pred = ea.analyze(_combine_and(plain))
+            rel = ea.relation  # scalar joins may have extended the plan
+            if pred.type != T.BOOLEAN:
+                raise SemanticError("WHERE must be boolean")
+            rel = RelationPlan(P.Filter(rel.root, pred), rel.scope)
+        return rel
+
+    def _plan_semijoin(
+        self, rel: RelationPlan, value: ast.Node, query: ast.Query, negate: bool
+    ) -> RelationPlan:
+        ea = ExprAnalyzer(self, rel)
+        v = ea.analyze(value)
+        rel = ea.relation
+        if not isinstance(v, ir.ColumnRef):
+            # compute the key in a projection first
+            sym = self.symbols.new("semikey")
+            assigns = [
+                (f.symbol, ir.ColumnRef(f.type, f.symbol))
+                for f in rel.scope.fields
+            ] + [(sym, v)]
+            rel = RelationPlan(
+                P.Project(rel.root, tuple(assigns)), rel.scope
+            )
+            v = ir.ColumnRef(v.type, sym)
+        sub, sub_names = self.plan_query(query)
+        if len(sub.scope.fields) != 1:
+            raise SemanticError("IN subquery must return one column")
+        out = self.symbols.new("semi")
+        node = P.SemiJoin(
+            rel.root, sub.root, v.name, sub.scope.fields[0].symbol, out
+        )
+        # filter on the mark (negated for NOT IN; NULL semantics simplified
+        # to not-matched, exact NOT IN null semantics handled at kernel)
+        mark = ir.ColumnRef(T.BOOLEAN, out)
+        pred: ir.Expr = ir.Not(mark) if negate else mark
+        return RelationPlan(P.Filter(node, pred), rel.scope)
+
+    def _plan_exists(
+        self, rel: RelationPlan, query: ast.Query, negate: bool
+    ) -> RelationPlan:
+        sub, _ = self.plan_query(query)
+        cnt = self.symbols.new("exists_count")
+        agg = P.Aggregate(
+            sub.root,
+            (),
+            (P.AggInfo(cnt, "count_star", None, False, None, T.BIGINT),),
+        )
+        flag_sym = self.symbols.new("exists")
+        flag = P.Project(
+            agg,
+            (
+                (
+                    flag_sym,
+                    ir.Comparison(
+                        ">", ir.ColumnRef(T.BIGINT, cnt), ir.Constant(T.BIGINT, 0)
+                    ),
+                ),
+            ),
+        )
+        node = P.ScalarJoin(rel.root, flag)
+        mark = ir.ColumnRef(T.BOOLEAN, flag_sym)
+        pred: ir.Expr = ir.Not(mark) if negate else mark
+        return RelationPlan(P.Filter(node, pred), rel.scope)
+
+    # ------------------------------------------------------------------
+    def _plan_aggregation(self, rel, spec, items, ea: "ExprAnalyzer"):
+        # group keys: ordinals or expressions
+        key_exprs: List[ir.Expr] = []
+        for g in spec.group_by:
+            if isinstance(g, ast.Literal) and g.kind == "integer":
+                idx = int(g.value) - 1
+                if not (0 <= idx < len(items)):
+                    raise SemanticError(f"GROUP BY ordinal {g.value} out of range")
+                key_exprs.append(ea.analyze(items[idx].expr))
+            else:
+                key_exprs.append(ea.analyze(g))
+        rel = ea.relation
+
+        # pre-projection: pass-through + key symbols
+        pre_assigns: List[Tuple[str, ir.Expr]] = [
+            (f.symbol, ir.ColumnRef(f.type, f.symbol)) for f in rel.scope.fields
+        ]
+        key_syms: List[str] = []
+        key_map: List[Tuple[ir.Expr, ir.ColumnRef]] = []
+        for ke in key_exprs:
+            if isinstance(ke, ir.ColumnRef):
+                key_syms.append(ke.name)
+                key_map.append((ke, ke))
+            else:
+                sym = self.symbols.new("groupkey")
+                pre_assigns.append((sym, ke))
+                ref = ir.ColumnRef(ke.type, sym)
+                key_syms.append(sym)
+                key_map.append((ke, ref))
+
+        agg_collector = AggCollector(self, rel, key_map, pre_assigns)
+        # analyze select + having with aggregate extraction
+        post_exprs = {}
+        for it in items:
+            post_exprs[id(it)] = agg_collector.analyze_post(it.expr)
+        having_pred = (
+            agg_collector.analyze_post(spec.having)
+            if spec.having is not None
+            else None
+        )
+        rel = agg_collector.relation
+
+        pre = P.Project(rel.root, tuple(agg_collector.pre_assigns))
+        agg_node = P.Aggregate(pre, tuple(key_syms), tuple(agg_collector.aggs))
+        new_fields = [
+            Field(None, s, s, t)
+            for s, t in agg_node.output_types().items()
+        ]
+        rel2 = RelationPlan(agg_node, Scope(new_fields))
+        if having_pred is not None:
+            rel2 = RelationPlan(P.Filter(rel2.root, having_pred), rel2.scope)
+        post_analyzer = PostAggAnalyzer(
+            self, rel2, agg_collector, post_exprs, dict((id(it), it) for it in items)
+        )
+        return rel2, post_analyzer
+
+    # ------------------------------------------------------------------
+    def _apply_order_limit(
+        self,
+        out: RelationPlan,
+        names: List[str],
+        order_by,
+        limit,
+        post_agg=None,
+        pre_projection: Optional[RelationPlan] = None,
+        select_assigns=None,
+    ) -> RelationPlan:
+        if order_by:
+            keys: List[SortKey] = []
+            extra_assigns: List[Tuple[str, ir.Expr]] = []
+            for si in order_by:
+                sym = self._resolve_sort_expr(
+                    si.expr, out, names, post_agg, pre_projection, extra_assigns
+                )
+                asc = si.ascending
+                nf = si.nulls_first if si.nulls_first is not None else (not asc)
+                keys.append(SortKey(sym, asc, nf))
+            root = out.root
+            if extra_assigns:
+                # hidden sort columns: extend the projection feeding the sort
+                assert isinstance(root, P.Project)
+                root = P.Project(
+                    root.source, tuple(list(root.assignments) + extra_assigns)
+                )
+            if limit is not None:
+                node: P.PlanNode = P.TopN(root, tuple(keys), limit)
+            else:
+                node = P.Sort(root, tuple(keys))
+            if extra_assigns:
+                # project hidden columns away
+                node = P.Project(
+                    node,
+                    tuple(
+                        (f.symbol, ir.ColumnRef(f.type, f.symbol))
+                        for f in out.scope.fields
+                    ),
+                )
+            return RelationPlan(node, out.scope)
+        if limit is not None:
+            return RelationPlan(P.Limit(out.root, limit), out.scope)
+        return out
+
+    def _resolve_sort_expr(
+        self, e, out: RelationPlan, names, post_agg, pre_projection, extra_assigns
+    ) -> str:
+        # ordinal
+        if isinstance(e, ast.Literal) and e.kind == "integer":
+            idx = int(e.value) - 1
+            if not (0 <= idx < len(out.scope.fields)):
+                raise SemanticError(f"ORDER BY ordinal {e.value} out of range")
+            return out.scope.fields[idx].symbol
+        # output alias / name
+        if isinstance(e, ast.Identifier) and len(e.parts) == 1:
+            matches = [
+                f for f in out.scope.fields if f.name == e.parts[0].lower()
+            ]
+            if len(matches) == 1:
+                return matches[0].symbol
+        # expression over the underlying relation (hidden column)
+        if post_agg is not None:
+            expr = post_agg.analyze(e)
+        elif pre_projection is not None:
+            expr = ExprAnalyzer(self, pre_projection).analyze(e)
+        else:
+            raise SemanticError("cannot resolve ORDER BY expression")
+        sym = self.symbols.new("sortkey")
+        extra_assigns.append((sym, expr))
+        return sym
+
+    # ------------------------------------------------------------------
+    def plan_relation(self, rel: ast.Node) -> RelationPlan:
+        if isinstance(rel, ast.Table):
+            return self._plan_table(rel)
+        if isinstance(rel, ast.SubqueryRelation):
+            rp, names = self.plan_query(rel.query)
+            cols = rel.columns or names
+            if len(cols) != len(rp.scope.fields):
+                raise SemanticError("derived table column count mismatch")
+            fields = [
+                Field(rel.alias, c.lower(), f.symbol, f.type)
+                for c, f in zip(cols, rp.scope.fields)
+            ]
+            return RelationPlan(rp.root, Scope(fields))
+        if isinstance(rel, ast.Join):
+            return self._plan_join(rel)
+        raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_table(self, t: ast.Table) -> RelationPlan:
+        name = t.name[-1].lower()
+        if name in self.ctes and len(t.name) == 1:
+            w = self.ctes[name]
+            # avoid infinite recursion for self-referencing names
+            saved = dict(self.ctes)
+            del self.ctes[name]
+            try:
+                rp, names = self.plan_query(w.query)
+            finally:
+                self.ctes = saved
+            cols = w.columns or names
+            fields = [
+                Field(t.alias or name, c.lower(), f.symbol, f.type)
+                for c, f in zip(cols, rp.scope.fields)
+            ]
+            return RelationPlan(rp.root, Scope(fields))
+        catalog, schema = self.metadata.resolve_table(
+            t.name, self.default_catalog
+        )
+        assigns = []
+        types_ = []
+        fields = []
+        qual = t.alias or schema.name
+        for c in schema.columns:
+            sym = self.symbols.new(c.name)
+            assigns.append((sym, c.name))
+            types_.append((sym, c.type))
+            fields.append(Field(qual, c.name.lower(), sym, c.type))
+        node = P.TableScan(catalog, schema.name, tuple(assigns), tuple(types_))
+        return RelationPlan(node, Scope(fields))
+
+    def _plan_join(self, j: ast.Join) -> RelationPlan:
+        left = self.plan_relation(j.left)
+        right = self.plan_relation(j.right)
+        scope = Scope(left.scope.fields + right.scope.fields)
+        if j.kind == "cross":
+            node = P.Join("cross", left.root, right.root, ())
+            return RelationPlan(node, scope)
+        ea = ExprAnalyzer(self, RelationPlan(left.root, scope))
+        cond = ea.analyze(j.condition)
+        lsyms = {f.symbol for f in left.scope.fields}
+        rsyms = {f.symbol for f in right.scope.fields}
+        criteria, residual = _extract_equi_criteria(cond, lsyms, rsyms)
+        if not criteria:
+            raise SemanticError("join requires at least one equi condition")
+        node = P.Join(j.kind, left.root, right.root, tuple(criteria), residual)
+        return RelationPlan(node, scope)
+
+
+# ----------------------------------------------------------------------
+# expression analysis
+
+
+def _flatten_and(e: ast.Node) -> List[ast.Node]:
+    if isinstance(e, ast.LogicalOp) and e.op == "and":
+        out = []
+        for t in e.terms:
+            out.extend(_flatten_and(t))
+        return out
+    return [e]
+
+
+def _combine_and(terms: List[ast.Node]) -> ast.Node:
+    if len(terms) == 1:
+        return terms[0]
+    return ast.LogicalOp("and", tuple(terms))
+
+
+def _derive_name(e: ast.Node, i: int) -> str:
+    if isinstance(e, ast.Identifier):
+        return e.parts[-1]
+    if isinstance(e, ast.FunctionCall):
+        return e.name
+    return f"_col{i}"
+
+
+def _contains_aggregate(e: ast.Node) -> bool:
+    if isinstance(e, ast.FunctionCall) and e.name in AGGREGATES:
+        return True
+    for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else ():
+        v = getattr(e, f.name)
+        if isinstance(v, ast.Node) and _contains_aggregate(v):
+            return True
+        if isinstance(v, tuple):
+            for x in v:
+                if isinstance(x, ast.Node) and _contains_aggregate(x):
+                    return True
+                if isinstance(x, ast.WhenClause):
+                    if _contains_aggregate(x.condition) or _contains_aggregate(
+                        x.result
+                    ):
+                        return True
+    return False
+
+
+def _extract_equi_criteria(cond: ir.Expr, lsyms, rsyms):
+    conj: List[ir.Expr] = []
+
+    def flat(e):
+        if isinstance(e, ir.Logical) and e.op == "and":
+            for t in e.terms:
+                flat(t)
+        else:
+            conj.append(e)
+
+    flat(cond)
+    criteria = []
+    residual = []
+    for c in conj:
+        if isinstance(c, ir.Comparison) and c.op == "=":
+            ls = set(ir.referenced_columns(c.left))
+            rs = set(ir.referenced_columns(c.right))
+            if (
+                isinstance(c.left, ir.ColumnRef)
+                and isinstance(c.right, ir.ColumnRef)
+            ):
+                if c.left.name in lsyms and c.right.name in rsyms:
+                    criteria.append((c.left.name, c.right.name))
+                    continue
+                if c.left.name in rsyms and c.right.name in lsyms:
+                    criteria.append((c.right.name, c.left.name))
+                    continue
+        residual.append(c)
+    res = None
+    if residual:
+        res = residual[0] if len(residual) == 1 else ir.Logical(
+            "and", tuple(residual)
+        )
+    return criteria, res
+
+
+class ExprAnalyzer:
+    """AST expression -> typed ir over the relation's symbols.
+
+    Scalar subqueries extend self.relation via ScalarJoin (SubqueryPlanner).
+    """
+
+    def __init__(self, analyzer: Analyzer, relation: RelationPlan):
+        self.a = analyzer
+        self.relation = relation
+
+    # -- entry ----------------------------------------------------------
+    def analyze(self, e: ast.Node) -> ir.Expr:
+        out = self._an(e)
+        return out
+
+    def _resolve_column(self, parts) -> ir.Expr:
+        f = self.relation.scope.resolve(tuple(p.lower() for p in parts))
+        return ir.ColumnRef(f.type, f.symbol)
+
+    def _an(self, e: ast.Node) -> ir.Expr:
+        if isinstance(e, ast.Identifier):
+            return self._resolve_column(e.parts)
+        if isinstance(e, ast.Literal):
+            return _literal(e)
+        if isinstance(e, ast.TypedLiteral):
+            return _typed_literal(e)
+        if isinstance(e, ast.UnaryOp):
+            v = self._an(e.operand)
+            return _fold(ir.Call(v.type, "negate", (v,)))
+        if isinstance(e, ast.BinaryOp):
+            l, r = self._an(e.left), self._an(e.right)
+            return _fold(_binary(e.op, l, r))
+        if isinstance(e, ast.ComparisonOp):
+            l, r = self._an(e.left), self._an(e.right)
+            _check_comparable(l.type, r.type)
+            return ir.Comparison(e.op, l, r)
+        if isinstance(e, ast.LogicalOp):
+            return ir.Logical(e.op, tuple(self._an(t) for t in e.terms))
+        if isinstance(e, ast.NotOp):
+            return ir.Not(self._an(e.operand))
+        if isinstance(e, ast.IsNullOp):
+            return ir.IsNull(self._an(e.operand), e.negate)
+        if isinstance(e, ast.BetweenOp):
+            return ir.Between(
+                self._an(e.value), self._an(e.low), self._an(e.high), e.negate
+            )
+        if isinstance(e, ast.InList):
+            return ir.In(
+                self._an(e.value),
+                tuple(self._an(i) for i in e.items),
+                e.negate,
+            )
+        if isinstance(e, ast.LikeOp):
+            v = self._an(e.value)
+            pat = self._an(e.pattern)
+            args = [v, pat]
+            if e.escape is not None:
+                args.append(self._an(e.escape))
+            call = ir.Call(T.BOOLEAN, "like", tuple(args))
+            return ir.Not(call) if e.negate else call
+        if isinstance(e, ast.FunctionCall):
+            return self._function(e)
+        if isinstance(e, ast.CastOp):
+            to = T.parse_type(e.type_name)
+            return _fold(ir.Cast(to, self._an(e.operand)))
+        if isinstance(e, ast.ExtractOp):
+            v = self._an(e.operand)
+            if e.field not in ("year", "month", "day", "quarter"):
+                raise SemanticError(f"extract({e.field}) unsupported")
+            return ir.Call(T.BIGINT, e.field, (v,))
+        if isinstance(e, ast.CaseExpr):
+            return self._case(e)
+        if isinstance(e, ast.ScalarSubquery):
+            return self._scalar_subquery(e.query)
+        if isinstance(e, (ast.InSubquery, ast.Exists)):
+            raise SemanticError(
+                "IN/EXISTS subqueries are only supported as top-level WHERE conjuncts"
+            )
+        raise SemanticError(f"unsupported expression: {type(e).__name__}")
+
+    def _case(self, e: ast.CaseExpr) -> ir.Expr:
+        whens = []
+        if e.operand is not None:
+            op = self._an(e.operand)
+            for w in e.whens:
+                cond = ir.Comparison("=", op, self._an(w.condition))
+                whens.append(ir.WhenClause(cond, self._an(w.result)))
+        else:
+            for w in e.whens:
+                c = self._an(w.condition)
+                if c.type != T.BOOLEAN:
+                    raise SemanticError("CASE WHEN must be boolean")
+                whens.append(ir.WhenClause(c, self._an(w.result)))
+        default = self._an(e.default) if e.default is not None else None
+        rts = [w.result.type for w in whens] + (
+            [default.type] if default is not None else []
+        )
+        rt = rts[0]
+        for t in rts[1:]:
+            rt = T.common_super_type(rt, t)
+        return ir.Case(rt, tuple(whens), default)
+
+    def _function(self, e: ast.FunctionCall) -> ir.Expr:
+        if e.name in AGGREGATES:
+            raise SemanticError(
+                f"aggregate {e.name}() not allowed here"
+            )
+        if e.name in ("year", "month", "day", "quarter"):
+            return ir.Call(T.BIGINT, e.name, (self._an(e.args[0]),))
+        if e.name in ("abs",):
+            v = self._an(e.args[0])
+            return ir.Call(v.type, "abs", (v,))
+        if e.name == "sqrt":
+            return ir.Call(T.DOUBLE, "sqrt", (self._an(e.args[0]),))
+        if e.name in ("round", "floor", "ceil", "ceiling"):
+            v = self._an(e.args[0])
+            args = [v]
+            rt = v.type
+            if e.name == "round" and len(e.args) > 1:
+                args.append(self._an(e.args[1]))
+            if e.name in ("floor", "ceil", "ceiling") and v.type.is_decimal:
+                rt = T.decimal(v.type.precision, 0)
+            return ir.Call(rt, e.name, tuple(args))
+        if e.name == "length":
+            return ir.Call(T.BIGINT, "length", (self._an(e.args[0]),))
+        if e.name == "coalesce":
+            args = tuple(self._an(a) for a in e.args)
+            rt = args[0].type
+            for a in args[1:]:
+                rt = T.common_super_type(rt, a.type)
+            # lower as CASE WHEN a IS NOT NULL THEN a ...
+            whens = tuple(
+                ir.WhenClause(ir.IsNull(a, negate=True), a) for a in args[:-1]
+            )
+            return ir.Case(rt, whens, args[-1])
+        raise SemanticError(f"unknown function: {e.name}")
+
+    def _scalar_subquery(self, q: ast.Query) -> ir.Expr:
+        sub, _ = self.a.plan_query(q)
+        if len(sub.scope.fields) != 1:
+            raise SemanticError("scalar subquery must return one column")
+        f = sub.scope.fields[0]
+        node = P.ScalarJoin(self.relation.root, sub.root)
+        self.relation = RelationPlan(node, self.relation.scope)
+        return ir.ColumnRef(f.type, f.symbol)
+
+
+class AggCollector(ExprAnalyzer):
+    """Post-aggregation expression analyzer: extracts aggregate calls into
+    AggInfo entries (pre-projected args) and rewrites group-key expressions
+    to key symbols (AggregationAnalyzer + QueryPlanner combined)."""
+
+    def __init__(self, analyzer, relation, key_map, pre_assigns):
+        super().__init__(analyzer, relation)
+        self.key_map = key_map  # [(key ir expr, key symbol ref)]
+        self.pre_assigns = pre_assigns
+        self.aggs: List[P.AggInfo] = []
+        self._agg_cache: Dict[tuple, ir.ColumnRef] = {}
+
+    def analyze_post(self, e: ast.Node) -> ir.Expr:
+        out = self._post(e)
+        self._validate(out)
+        return out
+
+    def _post(self, e: ast.Node) -> ir.Expr:
+        if isinstance(e, ast.FunctionCall) and e.name in AGGREGATES:
+            return self._aggregate_call(e)
+        # try: whole expression equals a group key
+        try:
+            full = self._an(e)
+        except SemanticError:
+            full = None
+        if full is not None:
+            for ke, ref in self.key_map:
+                if full == ke:
+                    return ref
+        # recurse structurally
+        if isinstance(e, ast.BinaryOp):
+            return _fold(_binary(e.op, self._post(e.left), self._post(e.right)))
+        if isinstance(e, ast.UnaryOp):
+            v = self._post(e.operand)
+            return ir.Call(v.type, "negate", (v,))
+        if isinstance(e, ast.ComparisonOp):
+            return ir.Comparison(e.op, self._post(e.left), self._post(e.right))
+        if isinstance(e, ast.LogicalOp):
+            return ir.Logical(e.op, tuple(self._post(t) for t in e.terms))
+        if isinstance(e, ast.NotOp):
+            return ir.Not(self._post(e.operand))
+        if isinstance(e, ast.CaseExpr):
+            whens = []
+            if e.operand is not None:
+                op = self._post(e.operand)
+                for w in e.whens:
+                    whens.append(
+                        ir.WhenClause(
+                            ir.Comparison("=", op, self._post(w.condition)),
+                            self._post(w.result),
+                        )
+                    )
+            else:
+                whens = [
+                    ir.WhenClause(self._post(w.condition), self._post(w.result))
+                    for w in e.whens
+                ]
+            default = self._post(e.default) if e.default is not None else None
+            rts = [w.result.type for w in whens] + (
+                [default.type] if default else []
+            )
+            rt = rts[0]
+            for t in rts[1:]:
+                rt = T.common_super_type(rt, t)
+            return ir.Case(rt, tuple(whens), default)
+        if isinstance(e, ast.CastOp):
+            return _fold(ir.Cast(T.parse_type(e.type_name), self._post(e.operand)))
+        if full is not None:
+            return full
+        return self._an(e)  # will raise a descriptive error
+
+    def _aggregate_call(self, e: ast.FunctionCall) -> ir.ColumnRef:
+        kind = e.name
+        if e.is_star:
+            kind = "count_star"
+            arg_sym = None
+            in_t = None
+            out_t = T.BIGINT
+        else:
+            if len(e.args) != 1:
+                raise SemanticError(f"{e.name} takes one argument")
+            arg = self._an(e.args[0])  # pre-agg scope
+            in_t = arg.type
+            out_t = _agg_output_type(kind, in_t)
+            if isinstance(arg, ir.ColumnRef):
+                arg_sym = arg.name
+            else:
+                arg_sym = self.a.symbols.new(f"{kind}arg")
+                self.pre_assigns.append((arg_sym, arg))
+        cache_key = (kind, arg_sym, e.distinct)
+        if cache_key in self._agg_cache:
+            return self._agg_cache[cache_key]
+        out_sym = self.a.symbols.new(kind)
+        self.aggs.append(
+            P.AggInfo(out_sym, kind, arg_sym, e.distinct, in_t, out_t)
+        )
+        ref = ir.ColumnRef(out_t, out_sym)
+        self._agg_cache[cache_key] = ref
+        return ref
+
+    def _validate(self, e: ir.Expr):
+        allowed = {r.name for _, r in self.key_map} | {
+            a.output for a in self.aggs
+        }
+        for n in ir.walk(e):
+            if isinstance(n, ir.ColumnRef) and n.name not in allowed:
+                raise SemanticError(
+                    f"'{n.name}' must appear in GROUP BY or inside an aggregate"
+                )
+
+
+class PostAggAnalyzer:
+    """Re-analyzes select/order expressions after aggregation planning,
+    reusing the AggCollector's extraction results."""
+
+    def __init__(self, analyzer, relation, collector: AggCollector, cache, items):
+        self.a = analyzer
+        self.relation = relation
+        self.collector = collector
+        self._cache = cache  # id(ast item) -> analyzed expr
+        self._items = items
+
+    def analyze(self, e: ast.Node) -> ir.Expr:
+        for iid, expr in self._cache.items():
+            if self._items.get(iid) is not None and self._items[iid].expr is e:
+                return expr
+        # order-by style expression referencing keys/aggs
+        self.collector.relation = self.relation
+        return self.collector.analyze_post(e)
+
+
+# ----------------------------------------------------------------------
+# literals, folding, typing helpers
+
+
+def _literal(e: ast.Literal) -> ir.Constant:
+    if e.kind == "integer":
+        return ir.Constant(T.BIGINT, int(e.value))
+    if e.kind == "double":
+        return ir.Constant(T.DOUBLE, float(e.value))
+    if e.kind == "decimal":
+        txt = str(e.value)
+        if "." in txt:
+            whole, frac = txt.split(".")
+        else:
+            whole, frac = txt, ""
+        scale = len(frac)
+        unscaled = int((whole + frac) or "0")
+        precision = max(len((whole + frac).lstrip("0")), scale + 1)
+        return ir.Constant(T.decimal(min(18, precision), scale), unscaled)
+    if e.kind == "string":
+        return ir.Constant(T.VARCHAR, e.value)
+    if e.kind == "boolean":
+        return ir.Constant(T.BOOLEAN, bool(e.value))
+    if e.kind == "null":
+        return ir.Constant(T.UNKNOWN, None)
+    raise SemanticError(f"literal kind {e.kind}")
+
+
+def _typed_literal(e: ast.TypedLiteral) -> ir.Constant:
+    if e.kind == "date":
+        y, m, d = map(int, e.value.split("-"))
+        return ir.Constant(T.DATE, days_from_civil(y, m, d))
+    if e.kind == "timestamp":
+        # 'YYYY-MM-DD[ HH:MM:SS]' -> microseconds
+        parts = e.value.split(" ")
+        y, m, d = map(int, parts[0].split("-"))
+        us = days_from_civil(y, m, d) * 86_400_000_000
+        if len(parts) > 1:
+            hh, mm, ss = (parts[1].split(":") + ["0", "0"])[:3]
+            us += (int(hh) * 3600 + int(mm) * 60 + int(float(ss))) * 1_000_000
+        return ir.Constant(T.TIMESTAMP, us)
+    if e.kind == "interval":
+        n = int(e.value)
+        unit = e.unit.rstrip("s")
+        # represented as a bigint day count (day) or month count (month/year)
+        if unit == "day":
+            return ir.Constant(_INTERVAL_DAY, n)
+        if unit == "week":
+            return ir.Constant(_INTERVAL_DAY, 7 * n)
+        if unit == "month":
+            return ir.Constant(_INTERVAL_MONTH, n)
+        if unit == "year":
+            return ir.Constant(_INTERVAL_MONTH, 12 * n)
+        raise SemanticError(f"interval unit {e.unit}")
+    raise SemanticError(f"typed literal {e.kind}")
+
+
+_INTERVAL_DAY = T.FixedWidthType("interval_day", "int64")
+_INTERVAL_MONTH = T.FixedWidthType("interval_month", "int64")
+
+
+def _binary(op: str, l: ir.Expr, r: ir.Expr) -> ir.Expr:
+    name = {
+        "+": "add",
+        "-": "subtract",
+        "*": "multiply",
+        "/": "divide",
+        "%": "modulus",
+        "||": "concat",
+    }[op]
+    if name == "concat":
+        raise SemanticError("|| not supported yet")
+    # date/interval arithmetic
+    if l.type.name == "date" and r.type is _INTERVAL_DAY:
+        return ir.Call(T.DATE, name, (l, ir.Constant(T.BIGINT, r.value if isinstance(r, ir.Constant) else None)))
+    if l.type is _INTERVAL_DAY and r.type.name == "date" and name == "add":
+        return ir.Call(T.DATE, name, (r, ir.Constant(T.BIGINT, l.value)))
+    if l.type.name == "date" and r.type is _INTERVAL_MONTH:
+        if not isinstance(l, ir.Constant) or not isinstance(r, ir.Constant):
+            raise SemanticError(
+                "date +/- interval month/year requires constant date for now"
+            )
+        return ir.Constant(T.DATE, _add_months(l.value, r.value if name == "add" else -r.value))
+    rt = arith_result_type(name, l.type, r.type)
+    return ir.Call(rt, name, (l, r))
+
+
+def _add_months(epoch_days: int, months: int) -> int:
+    d = datetime.date(1970, 1, 1) + datetime.timedelta(days=epoch_days)
+    y = d.year + (d.month - 1 + months) // 12
+    m = (d.month - 1 + months) % 12 + 1
+    import calendar
+
+    day = min(d.day, calendar.monthrange(y, m)[1])
+    return (datetime.date(y, m, day) - datetime.date(1970, 1, 1)).days
+
+
+def _check_comparable(a: T.Type, b: T.Type):
+    if a.name == "unknown" or b.name == "unknown":
+        return
+    try:
+        T.common_super_type(a, b)
+    except TypeError:
+        raise SemanticError(f"cannot compare {a} and {b}")
+
+
+def _agg_output_type(kind: str, in_t: T.Type) -> T.Type:
+    if kind == "count":
+        return T.BIGINT
+    if kind in ("min", "max"):
+        return in_t
+    if kind == "sum":
+        if in_t.is_decimal:
+            return T.decimal(18, in_t.scale)
+        if in_t.name in ("double", "real"):
+            return T.DOUBLE
+        return T.BIGINT
+    if kind == "avg":
+        if in_t.is_decimal:
+            return T.decimal(18, max(in_t.scale, 4))
+        return T.DOUBLE
+    raise SemanticError(kind)
+
+
+# constant folding -------------------------------------------------------
+
+
+def _fold(e: ir.Expr) -> ir.Expr:
+    """Evaluate constant-only arithmetic/cast at analysis time
+    (IrExpressionInterpreter / constant folding analog)."""
+    if isinstance(e, ir.Call):
+        if not all(isinstance(a, ir.Constant) for a in e.args):
+            return e
+        if any(a.value is None for a in e.args):
+            return ir.Constant(e.type, None)
+        vals = []
+        for a in e.args:
+            v = a.value
+            if a.type.is_decimal:
+                v = (v, a.type.scale)
+            vals.append(v)
+        try:
+            return ir.Constant(e.type, _eval_const(e.name, e.type, e.args))
+        except NotImplementedError:
+            return e
+    if isinstance(e, ir.Cast) and isinstance(e.term, ir.Constant):
+        c = e.term
+        if c.value is None:
+            return ir.Constant(e.type, None)
+        if c.type.is_decimal and e.type.is_decimal:
+            from ..expr.functions import decimal_rescale
+            import numpy as np
+
+            v = int(decimal_rescale(np.int64(c.value), c.type.scale, e.type.scale))
+            return ir.Constant(e.type, v)
+        if T.is_integral(c.type) and e.type.is_decimal:
+            return ir.Constant(e.type, c.value * 10**e.type.scale)
+        if c.type.is_decimal and e.type.name == "double":
+            return ir.Constant(e.type, c.value / 10**c.type.scale)
+    return e
+
+
+def _eval_const(name: str, out_t: T.Type, args) -> object:
+    def scaled(a):
+        return a.value, (a.type.scale if a.type.is_decimal else 0)
+
+    if name in ("add", "subtract", "multiply", "divide", "negate", "modulus"):
+        if out_t.is_decimal:
+            (av, asc) = scaled(args[0])
+            if name == "negate":
+                return -av * 10 ** (out_t.scale - asc)
+            (bv, bsc) = scaled(args[1])
+            if name == "add" or name == "subtract":
+                s = out_t.scale
+                av *= 10 ** (s - asc)
+                bv *= 10 ** (s - bsc)
+                return av + bv if name == "add" else av - bv
+            if name == "multiply":
+                prod = av * bv  # scale asc+bsc
+                from_scale, to_scale = asc + bsc, out_t.scale
+                if to_scale >= from_scale:
+                    return prod * 10 ** (to_scale - from_scale)
+                div = 10 ** (from_scale - to_scale)
+                sign = -1 if prod < 0 else 1
+                return sign * ((abs(prod) + div // 2) // div)
+            if name == "divide":
+                shift = out_t.scale - asc + bsc
+                num = av * 10**shift
+                sign = -1 if (num < 0) != (bv < 0) else 1
+                q, r = divmod(abs(num), abs(bv))
+                return sign * (q + (1 if 2 * r >= abs(bv) else 0))
+        if out_t.name in ("bigint", "integer", "date"):
+            av = args[0].value
+            if name == "negate":
+                return -av
+            bv = args[1].value
+            return {
+                "add": av + bv,
+                "subtract": av - bv,
+                "multiply": av * bv,
+                "divide": av // bv if bv else None,
+                "modulus": av % bv if bv else None,
+            }[name]
+        if out_t.name == "double":
+            def dv(a):
+                return (
+                    a.value / 10**a.type.scale if a.type.is_decimal else float(a.value)
+                )
+
+            av = dv(args[0])
+            if name == "negate":
+                return -av
+            bv = dv(args[1])
+            return {
+                "add": av + bv,
+                "subtract": av - bv,
+                "multiply": av * bv,
+                "divide": av / bv if bv else None,
+            }[name]
+    raise NotImplementedError(name)

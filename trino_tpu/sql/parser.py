@@ -189,11 +189,10 @@ class Parser:
             if t.kind == "kw" and t.text == "all":
                 limit = None
             else:
-                limit = int(t.text)
+                limit = self._int_token(t, "LIMIT")
         elif self.accept_kw("fetch"):
             self.accept_kw("first") or self.accept_kw("next")
-            t = self.next()
-            limit = int(t.text)
+            limit = self._int_token(self.next(), "FETCH")
             self.accept_kw("rows") or self.accept_kw("row")
             self.expect_kw("only")
         return ast.Query(body, tuple(order), limit, tuple(withs))
@@ -215,21 +214,40 @@ class Parser:
         return ast.SortItem(e, asc, nf)
 
     def parse_set_expr(self) -> ast.Node:
-        left = self.parse_query_primary()
-        while self.at_kw("union", "intersect", "except"):
+        # INTERSECT binds tighter than UNION/EXCEPT (SqlBase.g4 precedence)
+        left = self.parse_intersect_expr()
+        while self.at_kw("union", "except"):
             kind = self.next().text
             all_ = self.accept_kw("all")
             self.accept_kw("distinct")
-            right = self.parse_query_primary()
+            right = self.parse_intersect_expr()
             left = ast.SetOp(kind, all_, left, right)
+        return left
+
+    def parse_intersect_expr(self) -> ast.Node:
+        left = self.parse_query_primary()
+        while self.at_kw("intersect"):
+            self.next()
+            all_ = self.accept_kw("all")
+            self.accept_kw("distinct")
+            right = self.parse_query_primary()
+            left = ast.SetOp("intersect", all_, left, right)
         return left
 
     def parse_query_primary(self) -> ast.Node:
         if self.accept_op("("):
-            q = self.parse_set_expr()
+            # a parenthesized branch may carry its own ORDER BY / LIMIT
+            q = self.parse_query()
             self.expect_op(")")
+            if not q.withs and not q.order_by and q.limit is None:
+                return q.body
             return q
         return self.parse_query_spec()
+
+    def _int_token(self, t: Token, clause: str) -> int:
+        if t.kind != "number" or not t.text.isdigit():
+            raise ParseError(f"{clause} expects an integer, got {t!r}")
+        return int(t.text)
 
     def parse_query_spec(self) -> ast.QuerySpec:
         self.expect_kw("select")
@@ -322,18 +340,7 @@ class Parser:
             right = self.relation_primary()
             if self.accept_kw("on"):
                 cond = self.expr()
-            elif self.accept_kw("using"):
-                self.expect_op("(")
-                cols = [self.ident()]
-                while self.accept_op(","):
-                    cols.append(self.ident())
-                self.expect_op(")")
-                cond = None
-                for c in cols:
-                    eq = ast.ComparisonOp(
-                        "=", ast.Identifier((c,)), ast.Identifier((c,))
-                    )
-                    cond = eq if cond is None else ast.LogicalOp("and", (cond, eq))
+            elif self.at_kw("using"):
                 raise ParseError("USING join not supported yet; use ON")
             else:
                 raise ParseError("JOIN requires ON")
@@ -574,8 +581,16 @@ class Parser:
                 return ast.CastOp(e, kind)
             if self.accept_kw("interval"):
                 sign = -1 if self.accept_op("-") else 1
-                v = self.next()  # string or number
+                v = self.next()
+                if v.kind not in ("string", "number"):
+                    raise ParseError(f"INTERVAL expects a value, got {v!r}")
                 txt = v.text[1:-1] if v.kind == "string" else v.text
+                u = self.peek()
+                units = ("year", "month", "day", "hour", "minute", "second")
+                if not (u.kind == "kw" and u.text.rstrip("s") in units) and not (
+                    u.kind == "ident" and u.text.lower().rstrip("s") in units
+                ):
+                    raise ParseError(f"INTERVAL expects a unit, got {u!r}")
                 unit = self.next().text.lower()
                 if sign < 0:
                     txt = "-" + txt
@@ -597,10 +612,10 @@ class Parser:
                 self.expect_op(")")
                 args = (e, start) + ((length,) if length is not None else ())
                 return ast.FunctionCall("substring", args)
-        # identifier or function call
-        name = self.ident() if self.peek().kind != "kw" else None
-        if name is None:
-            # keyword-named functions (e.g. left/right already handled via ident())
+        # identifier or function call (soft keywords allowed via ident())
+        try:
+            name = self.ident()
+        except ParseError:
             raise ParseError(f"unexpected token {t!r}")
         if self.peek().kind == "op" and self.peek().text == "(":
             self.next()
