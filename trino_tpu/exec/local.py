@@ -50,6 +50,7 @@ class Batch:
     lanes: Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]
     sel: jnp.ndarray
     ordered: bool = False  # rows already compacted+ordered (sort output)
+    replicated: bool = False  # identical on every mesh device (mesh exec)
 
 
 def _pad_capacity(n: int) -> int:
@@ -223,7 +224,7 @@ class _TraceCtx:
         b = self.visit(node.source)
         f = compile_expr(node.predicate, self.lowering)
         v, ok = f(b.lanes)
-        return Batch(b.lanes, b.sel & v & ok, b.ordered)
+        return Batch(b.lanes, b.sel & v & ok, b.ordered, b.replicated)
 
     def _visit_project(self, node: P.Project) -> Batch:
         b = self.visit(node.source)
@@ -233,12 +234,12 @@ class _TraceCtx:
             # propagate dictionaries through pass-through references
             if isinstance(e, ir.ColumnRef) and e.name in self.ex.dicts:
                 self.ex.dicts[sym] = self.ex.dicts[e.name]
-        return Batch(out, b.sel, b.ordered)
+        return Batch(out, b.sel, b.ordered, b.replicated)
 
     def _visit_limit(self, node: P.Limit) -> Batch:
         b = self.visit(node.source)
         lanes, sel = sort_ops.limit(b.lanes, b.sel, node.count)
-        return Batch(lanes, sel, b.ordered)
+        return Batch(lanes, sel, b.ordered, b.replicated)
 
     def _visit_distinct(self, node: P.Distinct) -> Batch:
         b = self.visit(node.source)
@@ -256,8 +257,9 @@ class _TraceCtx:
         return Batch(lanes, sel_sorted & boundary)
 
     # -- aggregation -----------------------------------------------------
-    def _visit_aggregate(self, node: P.Aggregate) -> Batch:
-        b = self.visit(node.source)
+    def _visit_aggregate(self, node: P.Aggregate, b: Optional[Batch] = None) -> Batch:
+        if b is None:
+            b = self.visit(node.source)
         types = node.source.output_types()
         specs = [
             agg_ops.AggSpec(
@@ -420,7 +422,7 @@ class _TraceCtx:
         hit = (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
         lanes = dict(src.lanes)
         lanes[node.output] = (hit, jnp.ones(hit.shape, bool))
-        return Batch(lanes, src.sel, src.ordered)
+        return Batch(lanes, src.sel, src.ordered, src.replicated)
 
     def _visit_scalarjoin(self, node: P.ScalarJoin) -> Batch:
         src = self.visit(node.source)
@@ -436,7 +438,7 @@ class _TraceCtx:
                 jnp.broadcast_to(val, (n,)),
                 jnp.broadcast_to(okv, (n,)),
             )
-        return Batch(lanes, src.sel, src.ordered)
+        return Batch(lanes, src.sel, src.ordered, src.replicated)
 
     # -- ordering --------------------------------------------------------
     def _visit_sort(self, node: P.Sort) -> Batch:
@@ -444,13 +446,13 @@ class _TraceCtx:
         keys = self._rank_sort_keys(node.keys, b)
         perm = sort_ops.sort_perm(keys, b.lanes, b.sel)
         lanes, sel = sort_ops.apply_perm(b.lanes, perm, b.sel)
-        return Batch(lanes, sel, ordered=True)
+        return Batch(lanes, sel, ordered=True, replicated=b.replicated)
 
     def _visit_topn(self, node: P.TopN) -> Batch:
         b = self.visit(node.source)
         keys = self._rank_sort_keys(node.keys, b)
         lanes, sel = sort_ops.topn(keys, b.lanes, b.sel, node.count)
-        return Batch(lanes, sel, ordered=True)
+        return Batch(lanes, sel, ordered=True, replicated=b.replicated)
 
     def _rank_sort_keys(self, keys, b: Batch):
         """Replace dict-coded sort columns by their lexicographic ranks."""

@@ -1,0 +1,95 @@
+"""Flagship compiled kernel: the TPC-H Q1 fragment as a pure jittable step.
+
+This is the engine's "forward pass": scan->filter->project->group-by over
+lineitem, built from the production components (expression lowering +
+aggregation kernels), exposed as a standalone function over column arrays
+for compile checks and microbenchmarks (BenchmarkPageProcessor.java:67
+analog — the reference's hand-rolled JMH kernel plays the same role).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .connectors import tpch
+from .expr import ir
+from .expr.functions import arith_result_type, days_from_civil
+from .expr.lower import LoweringContext, compile_expr
+from .ops import aggregation as agg_ops
+from .ops.aggregation import AggSpec
+
+DEC = T.decimal(12, 2)
+
+
+def _q1_exprs():
+    qty = ir.ColumnRef(DEC, "l_quantity")
+    price = ir.ColumnRef(DEC, "l_extendedprice")
+    disc = ir.ColumnRef(DEC, "l_discount")
+    tax = ir.ColumnRef(DEC, "l_tax")
+    ship = ir.ColumnRef(T.DATE, "l_shipdate")
+    one = ir.Constant(T.decimal(1, 0), 1)
+    sub_t = arith_result_type("subtract", one.type, DEC)
+    one_minus = ir.Call(sub_t, "subtract", (one, disc))
+    disc_price_t = arith_result_type("multiply", DEC, sub_t)
+    disc_price = ir.Call(disc_price_t, "multiply", (price, one_minus))
+    add_t = arith_result_type("add", one.type, DEC)
+    one_plus_tax = ir.Call(add_t, "add", (one, tax))
+    charge_t = arith_result_type("multiply", disc_price_t, add_t)
+    charge = ir.Call(charge_t, "multiply", (disc_price, one_plus_tax))
+    cutoff = days_from_civil(1998, 12, 1) - 90
+    filt = ir.Comparison("<=", ship, ir.Constant(T.DATE, cutoff))
+    return filt, disc_price, charge, disc_price_t, charge_t
+
+
+def build_q1_step():
+    """Returns a jittable fn(cols: dict[str, array]) -> outputs tuple."""
+    filt_e, disc_price_e, charge_e, dp_t, ch_t = _q1_exprs()
+    ctx = LoweringContext({})
+    f_filt = compile_expr(filt_e, ctx)
+    f_dp = compile_expr(disc_price_e, ctx)
+    f_ch = compile_expr(charge_e, ctx)
+
+    specs = [
+        AggSpec("sum", "l_quantity", "sum_qty", DEC, T.decimal(18, 2)),
+        AggSpec("sum", "l_extendedprice", "sum_base", DEC, T.decimal(18, 2)),
+        AggSpec("sum", "disc_price", "sum_disc", dp_t, T.decimal(18, dp_t.scale)),
+        AggSpec("sum", "charge", "sum_charge", ch_t, T.decimal(18, ch_t.scale)),
+        AggSpec("avg", "l_quantity", "avg_qty", DEC, T.decimal(18, 4)),
+        AggSpec("avg", "l_extendedprice", "avg_price", DEC, T.decimal(18, 4)),
+        AggSpec("avg", "l_discount", "avg_disc", DEC, T.decimal(18, 4)),
+        AggSpec("count_star", None, "count_order"),
+    ]
+
+    def step(cols: Dict[str, jnp.ndarray]):
+        n = cols["l_quantity"].shape[0]
+        ones = jnp.ones(n, dtype=bool)
+        lanes = {k: (v, ones) for k, v in cols.items()}
+        fv, fok = f_filt(lanes)
+        sel = fv & fok
+        lanes["disc_price"] = f_dp(lanes)
+        lanes["charge"] = f_ch(lanes)
+        keys = [lanes["l_returnflag"], lanes["l_linestatus"]]
+        gid, cap = agg_ops.direct_group_ids(keys, [3, 2])
+        accs = agg_ops.accumulate(specs, lanes, gid, sel, cap)
+        out = agg_ops.finalize(specs, accs)
+        present = (
+            jnp.zeros(cap, dtype=jnp.int64)
+            .at[gid].add(sel.astype(jnp.int64))
+            > 0
+        )
+        return {"present": present, **{k: v for k, (v, _) in out.items()}}
+
+    return step
+
+
+def q1_example_args(sf: float = 0.001) -> Tuple[Dict[str, jnp.ndarray]]:
+    cols_needed = [
+        "l_quantity", "l_extendedprice", "l_discount", "l_tax",
+        "l_shipdate", "l_returnflag", "l_linestatus",
+    ]
+    values, dicts, count = tpch.generate("lineitem", sf, columns=cols_needed)
+    cols = {c: jnp.asarray(values[c]) for c in cols_needed}
+    return (cols,)

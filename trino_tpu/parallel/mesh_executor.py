@@ -1,0 +1,517 @@
+"""Distributed execution over a jax device mesh.
+
+Reference parity: the distributed dataflow stack —
+  - split assignment across workers (SourcePartitionedScheduler /
+    NodeScheduler/UniformNodeSelector): table splits sharded over the
+    mesh's 'workers' axis
+  - exchanges (operator/exchange, execution/buffer + HTTP page shuffle,
+    HttpPageBufferClient.java:98): XLA collectives over ICI inside one
+    shard_map program —
+      partial->final aggregation    = psum / all-gather + re-merge
+      broadcast join build side     = all_gather  (BroadcastOutputBuffer /
+                                       FIXED_BROADCAST_DISTRIBUTION)
+      gathering exchange at root    = all_gather  (SINGLE distribution)
+      hash repartition              = all_to_all  (parallel/shuffle.py,
+                                       FIXED_HASH_DISTRIBUTION)
+  - DistributedQueryRunner's "N servers in one process" test story maps
+    to N mesh devices in one process (virtual CPU devices in tests).
+
+The program is SPMD: every device runs the same fragment over its split
+shard; collectives implement the exchange boundaries that the reference
+places with AddExchanges (optimizations/AddExchanges.java:138).  Batch
+.replicated tracks which intermediate results are device-identical
+(the SINGLE vs partitioned distribution property of PlanFragments).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P_
+
+from ..catalog import CatalogManager
+from ..exec.local import (
+    Batch,
+    ExecutionError,
+    LocalExecutor,
+    _pad_capacity,
+    _TraceCtx,
+)
+from ..expr.lower import compile_expr
+from ..ops import aggregation as agg_ops
+from ..ops import join as join_ops
+from ..ops import sort as sort_ops
+from ..page import Page
+from ..plan import nodes as P
+from ..spi import Split
+
+AXIS = "workers"
+
+
+def default_mesh(n: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n or len(devs)
+    return Mesh(np.array(devs[:n]), (AXIS,))
+
+
+def _agather(x: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
+
+
+def _decode_direct_keys(domains, cap):
+    """Recover group key codes from the dense mixed-radix group id —
+    avoids cross-device gathers of representative rows."""
+    gids = jnp.arange(cap, dtype=jnp.int64)
+    radixes = [d + 1 for d in domains]
+    strides = []
+    s = 1
+    for r in reversed(radixes):
+        strides.append(s)
+        s *= r
+    strides = list(reversed(strides))
+    out = []
+    for dom, stride, radix in zip(domains, strides, radixes):
+        code = (gids // stride) % radix
+        ok = code < dom  # slot `dom` encodes NULL
+        out.append((code.astype(jnp.int32), ok))
+    return out
+
+
+def _gather_batch(b: Batch) -> Batch:
+    return Batch(
+        {s: (_agather(v), _agather(ok)) for s, (v, ok) in b.lanes.items()},
+        _agather(b.sel),
+        b.ordered,
+        replicated=True,
+    )
+
+
+class MeshExecutor(LocalExecutor):
+    """Executes a logical plan SPMD over all mesh devices."""
+
+    def __init__(self, catalogs: CatalogManager, mesh: Optional[Mesh] = None,
+                 config: Optional[dict] = None):
+        super().__init__(catalogs, config)
+        self.mesh = mesh or default_mesh()
+
+    # ------------------------------------------------------------------
+    def execute(self, plan: P.PlanNode) -> Page:
+        assert isinstance(plan, P.Output)
+        ndev = self.mesh.devices.size
+        scan_args, counts_args, dicts = self._load_sharded_scans(plan, ndev)
+        self.dicts = dicts
+        self.group_capacity = int(self.config.get("group_capacity", 4096))
+
+        for attempt in range(4):
+            ctx = _MeshTraceCtx(self, None, None)
+
+            def fragment(scans, counts):
+                ctx.scans = scans
+                ctx.counts = counts
+                batch = ctx.visit(plan.source)
+                if not batch.replicated:
+                    batch = _gather_batch(batch)
+                out = {s: batch.lanes[s] for s in plan.symbols}
+                return (
+                    out,
+                    batch.sel,
+                    tuple(ctx.capacity_checks),
+                    tuple(d for _, d in ctx.dup_checks),
+                )
+
+            shard_fn = jax.shard_map(
+                fragment,
+                mesh=self.mesh,
+                in_specs=(P_(AXIS), P_(AXIS)),
+                out_specs=P_(),
+                check_vma=False,
+            )
+            out_lanes, sel, checks, dups = jax.jit(shard_fn)(
+                scan_args, counts_args
+            )
+            for d in dups:
+                if int(d) > 0:
+                    raise ExecutionError(
+                        "join build side has duplicate keys "
+                        "(many-to-many join not yet supported)"
+                    )
+            overflow = any(
+                int(n) > cap
+                for n, cap in zip(checks, ctx.capacity_limits)
+            )
+            if not overflow:
+                break
+            self.group_capacity *= 8
+        else:
+            raise ExecutionError("group capacity overflow after retries")
+
+        return self._materialize(plan, out_lanes, sel, ctx.ordered_out)
+
+    # ------------------------------------------------------------------
+    def _load_sharded_scans(self, plan: P.PlanNode, ndev: int):
+        scans: Dict[str, Dict[str, np.ndarray]] = {}
+        counts: Dict[str, np.ndarray] = {}
+        dicts: Dict[str, np.ndarray] = {}
+
+        def walk(node: P.PlanNode):
+            if isinstance(node, P.TableScan):
+                conn = self.catalogs.get(node.catalog)
+                cols = [c for _, c in node.assignments]
+                provider = conn.page_source_provider()
+                per_dev: List[Dict[str, np.ndarray]] = []
+                dev_counts: List[int] = []
+                for d in range(ndev):
+                    sp = Split(node.table, d, ndev)
+                    src = provider.create_page_source(sp, cols)
+                    vals: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+                    total = 0
+                    for page in src.pages():
+                        for c, col in zip(page.names, page.columns):
+                            vals[c].append(
+                                np.asarray(col.values)[: page.count]
+                            )
+                        total += page.count
+                    for c, dct in src.dictionaries().items():
+                        sym = self._sym_for(node, c)
+                        prev = dicts.get(sym)
+                        if (
+                            prev is not None
+                            and prev is not dct
+                            and not np.array_equal(prev, dct)
+                        ):
+                            raise ExecutionError(
+                                f"per-split dictionaries diverge for {c}"
+                            )
+                        dicts[sym] = dct
+                    per_dev.append(
+                        {c: np.concatenate(v) for c, v in vals.items()}
+                    )
+                    dev_counts.append(total)
+                cap = _pad_capacity(max(max(dev_counts), 1))
+                merged: Dict[str, np.ndarray] = {}
+                for c in cols:
+                    sym = self._sym_for(node, c)
+                    stacked = np.zeros((ndev, cap), dtype=per_dev[0][c].dtype)
+                    for d in range(ndev):
+                        stacked[d, : dev_counts[d]] = per_dev[d][c]
+                    merged[sym] = stacked
+                scans[str(id(node))] = merged
+                counts[str(id(node))] = np.array(dev_counts, dtype=np.int64)
+                return
+            for s in node.sources:
+                walk(s)
+
+        walk(plan)
+        return scans, counts, dicts
+
+
+class _MeshTraceCtx(_TraceCtx):
+    """Trace context inside shard_map: exchange points become collectives."""
+
+    def __init__(self, ex: MeshExecutor, scans, counts):
+        super().__init__(ex, scans, counts)
+        self.capacity_limits: List[int] = []
+        self.ordered_out = False
+
+    def _note_capacity(self, ngroups, cap):
+        # replicate the check value so it can cross the out_specs=P() boundary
+        self.capacity_checks.append(jax.lax.pmax(ngroups, AXIS))
+        self.capacity_limits.append(cap)
+
+    # -- leaves ---------------------------------------------------------
+    def _visit_tablescan(self, node: P.TableScan) -> Batch:
+        arrays = self.scans[str(id(node))]
+        count = self.counts[str(id(node))][0]
+        lanes = {}
+        cap = None
+        for sym, arr in arrays.items():
+            v = arr[0]  # local shard [1, cap] -> [cap]
+            cap = v.shape[0]
+            lanes[sym] = (v, jnp.ones(cap, dtype=bool))
+        sel = jnp.arange(cap) < count
+        return Batch(lanes, sel, replicated=False)
+
+    def _visit_values(self, node: P.Values) -> Batch:
+        b = super()._visit_values(node)
+        # identical values exist on every device; select only on device 0
+        myidx = jax.lax.axis_index(AXIS)
+        return Batch(b.lanes, b.sel & (myidx == 0), b.ordered, False)
+
+    # -- aggregation -----------------------------------------------------
+    def _visit_aggregate(self, node: P.Aggregate) -> Batch:
+        b = self.visit(node.source)
+        if b.replicated:
+            out = _TraceCtx._visit_aggregate(self, node, b)
+            return Batch(out.lanes, out.sel, out.ordered, replicated=True)
+        types = node.source.output_types()
+        specs = [
+            agg_ops.AggSpec(a.kind, a.arg, a.output, a.input_type, a.output_type)
+            for a in node.aggs
+        ]
+        for a in node.aggs:
+            if a.distinct:
+                raise ExecutionError("DISTINCT aggregates not yet supported")
+
+        if not node.keys:
+            gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)
+            accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, 1)
+            accs = self._psum_accs(specs, accs)
+            out = agg_ops.finalize(specs, accs)
+            lanes = {
+                k: (jnp.pad(v, (0, 127)), jnp.pad(ok, (0, 127)))
+                for k, (v, ok) in out.items()
+            }
+            sel = jnp.pad(jnp.ones(1, bool), (0, 127))
+            return Batch(lanes, sel, replicated=True)
+
+        key_lanes = [b.lanes[k] for k in node.keys]
+        domains = self._direct_domains(node.keys, types)
+        if domains is not None:
+            gid, cap = agg_ops.direct_group_ids(key_lanes, domains)
+            accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, cap)
+            present_local = (
+                jax.ops.segment_sum(
+                    b.sel.astype(jnp.int64), gid, num_segments=cap
+                )
+                > 0
+            )
+            # exchange: dense accumulators are psum-able (partial->final)
+            accs = self._psum_accs(specs, accs)
+            present = jax.lax.psum(present_local.astype(jnp.int32), AXIS) > 0
+            out = agg_ops.finalize(specs, accs)
+            keys_out = _decode_direct_keys(domains, cap)
+        else:
+            # partial aggregate locally; gathering exchange of partial
+            # group state; re-merge (PARTIAL -> exchange -> FINAL)
+            cap = min(self.ex.group_capacity, b.sel.shape[0])
+            perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
+            self._note_capacity(ngroups, cap)
+            sel_sorted = b.sel[perm]
+            sorted_lanes = {
+                s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
+            }
+            accs = agg_ops.accumulate(specs, sorted_lanes, gid, sel_sorted, cap)
+            present_local = jnp.arange(cap) < ngroups
+            keys_local = agg_ops.group_keys_output(
+                [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap
+            )
+            acc_lanes = {
+                name: (_agather(arr), jnp.ones(arr.shape[0] * self._ndev(), bool))
+                for name, arr in accs.items()
+            }
+            key_lanes_g = [(_agather(v), _agather(ok)) for v, ok in keys_local]
+            present_g = _agather(present_local)
+            fcap = min(self.ex.group_capacity, present_g.shape[0])
+            perm2, gid2, ngroups2 = agg_ops.sort_group_ids(
+                key_lanes_g, present_g, fcap
+            )
+            self._note_capacity(ngroups2, fcap)
+            sel2 = present_g[perm2]
+            acc_sorted = {
+                s: (v[perm2], ok[perm2]) for s, (v, ok) in acc_lanes.items()
+            }
+            merged = agg_ops.merge_accumulators(
+                specs, acc_sorted, gid2, sel2, fcap
+            )
+            out = agg_ops.finalize(specs, merged)
+            keys_out = agg_ops.group_keys_output(
+                [(v[perm2], ok[perm2]) for v, ok in key_lanes_g],
+                gid2,
+                sel2,
+                fcap,
+            )
+            present = jnp.arange(fcap) < ngroups2
+            cap = fcap
+
+        lanes = {}
+        for k, kl in zip(node.keys, keys_out):
+            lanes[k] = kl
+        for s in out:
+            lanes[s] = out[s]
+        pad_cap = _pad_capacity(cap)
+        if pad_cap != cap:
+            lanes = {
+                s: (
+                    jnp.pad(v, (0, pad_cap - cap)),
+                    jnp.pad(ok, (0, pad_cap - cap)),
+                )
+                for s, (v, ok) in lanes.items()
+            }
+            present = jnp.pad(present, (0, pad_cap - cap))
+        return Batch(lanes, present, replicated=True)
+
+    def _ndev(self) -> int:
+        return self.ex.mesh.devices.size
+
+    def _psum_accs(self, specs, accs):
+        out = {}
+        for s in specs:
+            for name in s.accumulator_names:
+                arr = accs[name]
+                if s.kind in ("min", "max") and name.endswith("$val"):
+                    op = jax.lax.pmin if s.kind == "min" else jax.lax.pmax
+                    out[name] = op(arr, AXIS)
+                else:
+                    out[name] = jax.lax.psum(arr, AXIS)
+        return out
+
+    # -- joins ----------------------------------------------------------
+    def _visit_join(self, node: P.Join) -> Batch:
+        left = self.visit(node.left)
+        right = self.visit(node.right)
+        if not right.replicated:
+            # broadcast exchange: replicate build side to all workers
+            right = _gather_batch(right)
+        out = self._join_local(node, left, right)
+        out.replicated = left.replicated
+        return out
+
+    def _join_local(self, node: P.Join, left: Batch, right: Batch) -> Batch:
+        if node.kind == "cross":
+            return self._cross_join(node, left, right)
+        lkeys = [left.lanes[l] for l, _ in node.criteria]
+        rkeys = [right.lanes[r] for _, r in node.criteria]
+        self._check_join_dicts(node)
+        bkey = join_ops.composite_key(rkeys, right.sel)
+        pkey = join_ops.composite_key(lkeys, left.sel)
+        src = join_ops.build_unique(bkey, right.sel)
+        self.dup_checks.append((node, src.dup_count))
+        row, matched = join_ops.probe(src, pkey, left.sel)
+        build_cols = join_ops.gather_build(right.lanes, row, matched)
+        lanes = dict(left.lanes)
+        lanes.update(build_cols)
+        if node.kind == "inner":
+            sel = left.sel & matched
+        elif node.kind == "left":
+            sel = left.sel
+        else:
+            raise ExecutionError(f"join kind {node.kind} not supported yet")
+        if node.filter is not None:
+            f = compile_expr(node.filter, self.lowering)
+            v, ok = f(lanes)
+            if node.kind == "inner":
+                sel = sel & v & ok
+            else:
+                keep = matched & v & ok
+                for name in build_cols:
+                    bv, bok = lanes[name]
+                    lanes[name] = (bv, bok & keep)
+        return Batch(lanes, sel)
+
+    def _visit_semijoin(self, node: P.SemiJoin) -> Batch:
+        src = self.visit(node.source)
+        filt = self.visit(node.filtering)
+        v, ok = filt.lanes[node.filtering_key]
+        live = filt.sel & ok
+        kv = jnp.where(live, v.astype(jnp.int64), join_ops.I64_MAX)
+        if not filt.replicated:
+            kv = _agather(kv)  # broadcast the filtering keys
+        sorted_keys = jax.lax.sort(kv)
+        pv, pok = src.lanes[node.source_key]
+        idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
+        safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
+        hit = (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
+        lanes = dict(src.lanes)
+        lanes[node.output] = (hit, jnp.ones(hit.shape, bool))
+        return Batch(lanes, src.sel, src.ordered, src.replicated)
+
+    def _visit_scalarjoin(self, node: P.ScalarJoin) -> Batch:
+        src = self.visit(node.source)
+        sub = self.visit(node.subquery)
+        if not sub.replicated:
+            sub = _gather_batch(sub)
+        first = jnp.argmax(sub.sel)
+        n = src.sel.shape[0]
+        lanes = dict(src.lanes)
+        for s, (v, ok) in sub.lanes.items():
+            val = v[first]
+            okv = ok[first] & (sub.sel.sum() > 0)
+            lanes[s] = (
+                jnp.broadcast_to(val, (n,)),
+                jnp.broadcast_to(okv, (n,)),
+            )
+        return Batch(lanes, src.sel, src.ordered, src.replicated)
+
+    # -- ordering --------------------------------------------------------
+    def _visit_sort(self, node: P.Sort) -> Batch:
+        b = self.visit(node.source)
+        if not b.replicated:
+            b = _gather_batch(b)  # gathering exchange (single distribution)
+        keys = self._rank_sort_keys(node.keys, b)
+        perm = sort_ops.sort_perm(keys, b.lanes, b.sel)
+        lanes, sel = sort_ops.apply_perm(b.lanes, perm, b.sel)
+        self.ordered_out = True
+        return Batch(lanes, sel, ordered=True, replicated=True)
+
+    def _visit_topn(self, node: P.TopN) -> Batch:
+        b = self.visit(node.source)
+        keys = self._rank_sort_keys(node.keys, b)
+        lanes, sel = sort_ops.topn(keys, b.lanes, b.sel, node.count)
+        if not b.replicated:
+            # local top-n -> gather candidates -> global top-n (MergeOperator)
+            b2 = Batch(
+                {s: (_agather(v), _agather(ok)) for s, (v, ok) in lanes.items()},
+                _agather(sel),
+            )
+            keys2 = self._rank_sort_keys(node.keys, b2)
+            lanes, sel = sort_ops.topn(keys2, b2.lanes, b2.sel, node.count)
+        self.ordered_out = True
+        return Batch(lanes, sel, ordered=True, replicated=True)
+
+    def _visit_limit(self, node: P.Limit) -> Batch:
+        b = self.visit(node.source)
+        lanes, sel = sort_ops.limit(b.lanes, b.sel, node.count)
+        if not b.replicated:
+            b2 = Batch(
+                {s: (_agather(v), _agather(ok)) for s, (v, ok) in lanes.items()},
+                _agather(sel),
+            )
+            lanes, sel = sort_ops.limit(b2.lanes, b2.sel, node.count)
+            return Batch(lanes, sel, replicated=True)
+        return Batch(lanes, sel, b.ordered, b.replicated)
+
+    def _visit_distinct(self, node: P.Distinct) -> Batch:
+        b = super()._visit_distinct(node)
+        if not b.replicated:
+            b = _gather_batch(b)
+            b = self._local_distinct(node.output_symbols(), b)
+            b.replicated = True
+        return b
+
+    def _local_distinct(self, syms, b: Batch) -> Batch:
+        key_lanes = [b.lanes[s] for s in syms]
+        cap = b.sel.shape[0]
+        perm, gid, _ = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
+        boundary = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
+        )
+        lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()}
+        return Batch(lanes, b.sel[perm] & boundary, replicated=b.replicated)
+
+    def _visit_setoperation(self, node: P.SetOperation) -> Batch:
+        if node.kind != "union":
+            raise ExecutionError(f"{node.kind} not supported yet")
+        # gather every non-replicated input, then reuse the local union
+        originals = {}
+        for inp in node.inputs:
+            batch = self.visit(inp)
+            if not batch.replicated:
+                batch = _gather_batch(batch)
+            originals[id(inp)] = batch
+
+        saved_visit = self.visit
+
+        def patched_visit(n):
+            if id(n) in originals:
+                return originals[id(n)]
+            return saved_visit(n)
+
+        self.visit = patched_visit
+        try:
+            out = _TraceCtx._visit_setoperation(self, node)
+        finally:
+            self.visit = saved_visit
+        out.replicated = True
+        return out
