@@ -230,3 +230,33 @@ def test_explain(session):
 def test_limit_without_order(session):
     page = session.execute("select o_orderkey from orders limit 7")
     assert page.count == 7
+
+
+def test_expansion_join_one_to_many(session, oracle_conn):
+    """customer joined to orders from the 1-side (build side has dups)."""
+    sql = (
+        "select c_custkey, count(o_orderkey) as c from customer "
+        "left join orders on c_custkey = o_custkey "
+        "group by c_custkey order by c_custkey limit 15"
+    )
+    check(session, oracle_conn, sql)
+
+
+def test_tpch_q13_shape(session, oracle_conn):
+    sql = """
+    select c_count, count(*) as custdist
+    from (select c_custkey, count(o_orderkey) as c_count
+          from customer left join orders on c_custkey = o_custkey
+          group by c_custkey) c_orders
+    group by c_count
+    order by custdist desc, c_count desc
+    """
+    check(session, oracle_conn, sql)
+
+
+def test_expansion_inner_join(session, oracle_conn):
+    sql = (
+        "select n_name, count(*) from nation join customer on n_nationkey = c_nationkey "
+        "group by n_name order by n_name"
+    )
+    check(session, oracle_conn, sql)

@@ -610,12 +610,34 @@ class TpchMetadata(ConnectorMetadata):
 
     def get_table_statistics(self, table: str) -> TableStatistics:
         """Mirrors TpchMetadata's statistics support (plugin/trino-tpch
-        .../statistics) — row counts and NDV estimates drive join ordering."""
-        n = _counts(self.sf)[table]
+        .../statistics) — row counts and NDV estimates drive join ordering
+        and unique-build-side detection.  Only true primary keys report
+        distinct_count == row_count."""
+        counts = _counts(self.sf)
+        n = counts[table]
+        pk = {
+            "region": "r_regionkey", "nation": "n_nationkey",
+            "supplier": "s_suppkey", "customer": "c_custkey",
+            "part": "p_partkey", "orders": "o_orderkey",
+        }.get(table)
+        # FK cardinalities (approximate dbgen NDVs)
+        fk_ndv = {
+            "o_custkey": counts["customer"] * 2 / 3,
+            "l_orderkey": float(counts["orders"]),
+            "l_partkey": float(counts["part"]),
+            "l_suppkey": float(counts["supplier"]),
+            "ps_partkey": float(counts["part"]),
+            "ps_suppkey": float(counts["supplier"]),
+            "c_nationkey": 25.0,
+            "s_nationkey": 25.0,
+            "n_regionkey": 5.0,
+        }
         cols: Dict[str, ColumnStatistics] = {}
         for c, t in SCHEMAS[table]:
-            if c.endswith("key"):
+            if c == pk:
                 cols[c] = ColumnStatistics(distinct_count=float(n))
+            elif c in fk_ndv:
+                cols[c] = ColumnStatistics(distinct_count=min(fk_ndv[c], n))
             elif t.is_dictionary and c in _VOCABS:
                 cols[c] = ColumnStatistics(distinct_count=float(len(_VOCABS[c])))
         return TableStatistics(float(n), cols)

@@ -78,8 +78,9 @@ class LocalExecutor:
         self.group_capacity = int(
             self.config.get("group_capacity", DEFAULT_GROUP_CAPACITY)
         )
+        self.join_factor = 1
 
-        for attempt in range(4):
+        for attempt in range(5):
             ctx = _TraceCtx(self, scans, counts)
             out_lanes, sel, ordered, checks = self._run(plan, ctx)
             for join_node, dup in ctx.dup_checks:
@@ -95,6 +96,7 @@ class LocalExecutor:
             if not overflow:
                 break
             self.group_capacity *= 8
+            self.join_factor *= 8
         else:
             raise ExecutionError("group capacity overflow after retries")
 
@@ -298,7 +300,7 @@ class _TraceCtx:
         else:
             cap = min(self.ex.group_capacity, b.sel.shape[0])
             perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
-            self.capacity_checks.append((ngroups, cap))
+            self._note_capacity(ngroups, cap)
             sel_sorted = b.sel[perm]
             sorted_lanes = {
                 s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
@@ -339,12 +341,20 @@ class _TraceCtx:
         return domains if prod <= 4096 else None
 
     # -- joins -----------------------------------------------------------
+    def _note_capacity(self, ngroups, cap):
+        self.capacity_checks.append((ngroups, cap))
+
     def _visit_join(self, node: P.Join) -> Batch:
         left = self.visit(node.left)
         right = self.visit(node.right)
+        return self._join_batches(node, left, right)
+
+    def _join_batches(self, node: P.Join, left: Batch, right: Batch) -> Batch:
         if node.kind == "cross":
             return self._cross_join(node, left, right)
-        # build on right, probe on left
+        if node.expansion:
+            return self._expansion_join(node, left, right)
+        # unique-keyed build on right, probe on left
         lkeys = [left.lanes[l] for l, _ in node.criteria]
         rkeys = [right.lanes[r] for _, r in node.criteria]
         self._check_join_dicts(node)
@@ -373,6 +383,50 @@ class _TraceCtx:
                 for name in build_cols:
                     bv, bok = lanes[name]
                     lanes[name] = (bv, bok & keep)
+        return Batch(lanes, sel)
+
+    def _expansion_join(self, node: P.Join, left: Batch, right: Batch) -> Batch:
+        """General (duplicate-build-key) join with static output capacity +
+        host retry (vectorized LookupJoinOperator page building)."""
+        lkeys = [left.lanes[l] for l, _ in node.criteria]
+        rkeys = [right.lanes[r] for _, r in node.criteria]
+        self._check_join_dicts(node)
+        bkey = join_ops.composite_key(rkeys, right.sel)
+        pkey = join_ops.composite_key(lkeys, left.sel)
+        src = join_ops.build_multi(bkey, right.sel)
+        counts, lo = join_ops.probe_counts(src, pkey, left.sel)
+        outer = node.kind == "left"
+        probe_cap = left.sel.shape[0]
+        capacity = _pad_capacity(
+            int(probe_cap * getattr(self.ex, "join_factor", 1))
+        )
+        probe_row, build_row, matched, total = join_ops.expand_join(
+            src, counts, lo, capacity, outer=outer
+        )
+        # expand_join's internal eff uses max(counts,1) for outer including
+        # unselected rows; mask them below via probe sel gather
+        self._note_capacity(total, capacity)
+        psel = left.sel[probe_row]
+        lanes = {}
+        for s, (v, ok) in left.lanes.items():
+            lanes[s] = (v[probe_row], ok[probe_row])
+        for s, (v, ok) in right.lanes.items():
+            lanes[s] = (v[build_row], ok[build_row] & matched)
+        within = jnp.arange(capacity) < total
+        if node.kind == "inner":
+            sel = within & matched & psel
+        else:
+            sel = within & psel
+        if node.filter is not None:
+            f = compile_expr(node.filter, self.lowering)
+            v, ok = f(lanes)
+            if node.kind == "inner":
+                sel = sel & v & ok
+            else:
+                keep = matched & v & ok
+                for s in right.lanes:
+                    bv, bok = lanes[s]
+                    lanes[s] = (bv, bok & keep)
         return Batch(lanes, sel)
 
     def _check_join_dicts(self, node: P.Join):

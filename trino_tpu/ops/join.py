@@ -81,6 +81,68 @@ def gather_build(
     return out
 
 
+class MultiLookupSource(NamedTuple):
+    """Build side with duplicate keys allowed (the general PagesHash)."""
+
+    sorted_keys: jnp.ndarray
+    perm: jnp.ndarray
+    nvalid: jnp.ndarray
+
+
+def build_multi(key: Lane, sel: jnp.ndarray) -> MultiLookupSource:
+    v, ok = key
+    n = v.shape[0]
+    live = sel & ok
+    kv = jnp.where(live, v.astype(jnp.int64), I64_MAX)
+    sorted_keys, perm = jax.lax.sort(
+        (kv, jnp.arange(n, dtype=jnp.int64)), num_keys=1
+    )
+    return MultiLookupSource(sorted_keys, perm, live.sum())
+
+
+def probe_counts(
+    source: MultiLookupSource, key: Lane, sel: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-probe-row match count and first-match slot ([lo,hi) range)."""
+    v, ok = key
+    pk = jnp.where(sel & ok, v.astype(jnp.int64), I64_MAX - 1)
+    lo = jnp.searchsorted(source.sorted_keys, pk, side="left")
+    hi = jnp.searchsorted(source.sorted_keys, pk, side="right")
+    return (hi - lo).astype(jnp.int64), lo
+
+
+def expand_join(
+    source: MultiLookupSource,
+    counts: jnp.ndarray,
+    lo: jnp.ndarray,
+    capacity: int,
+    outer: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Expand probe rows by their match multiplicity into a static-capacity
+    output (the LookupJoinOperator page-building loop, vectorized).
+
+    Returns (probe_row, build_row, matched, total):
+      probe_row[j] : index of the probe row producing output j
+      build_row[j] : build-side row index (garbage where not matched)
+      matched[j]   : output j is a real (joined) row; for outer=True,
+                     unmatched probe rows emit one row with matched=False
+      total        : true output size (host checks vs capacity and retries)
+    """
+    eff = jnp.maximum(counts, 1) if outer else counts
+    offsets = jnp.cumsum(eff)
+    total = offsets[-1]
+    j = jnp.arange(capacity, dtype=jnp.int64)
+    probe_row = jnp.searchsorted(offsets, j, side="right")
+    probe_row = jnp.clip(probe_row, 0, counts.shape[0] - 1)
+    start = offsets[probe_row] - eff[probe_row]
+    k = j - start
+    slot = jnp.clip(lo[probe_row] + k, 0, source.sorted_keys.shape[0] - 1)
+    build_row = source.perm[slot]
+    within = j < total
+    matched = within & (k < counts[probe_row])
+    return probe_row, build_row, matched, total
+
+
 def composite_key(key_lanes, sel) -> Lane:
     """Combine a multi-column equi-join key into one int64 lane.
 

@@ -103,8 +103,9 @@ class MeshExecutor(LocalExecutor):
         scan_args, counts_args, dicts = self._load_sharded_scans(plan, ndev)
         self.dicts = dicts
         self.group_capacity = int(self.config.get("group_capacity", 4096))
+        self.join_factor = 1
 
-        for attempt in range(4):
+        for attempt in range(5):
             ctx = _MeshTraceCtx(self, None, None)
 
             def fragment(scans, counts):
@@ -144,6 +145,7 @@ class MeshExecutor(LocalExecutor):
             if not overflow:
                 break
             self.group_capacity *= 8
+            self.join_factor *= 8
         else:
             raise ExecutionError("group capacity overflow after retries")
 
@@ -364,41 +366,9 @@ class _MeshTraceCtx(_TraceCtx):
         if not right.replicated:
             # broadcast exchange: replicate build side to all workers
             right = _gather_batch(right)
-        out = self._join_local(node, left, right)
+        out = self._join_batches(node, left, right)
         out.replicated = left.replicated
         return out
-
-    def _join_local(self, node: P.Join, left: Batch, right: Batch) -> Batch:
-        if node.kind == "cross":
-            return self._cross_join(node, left, right)
-        lkeys = [left.lanes[l] for l, _ in node.criteria]
-        rkeys = [right.lanes[r] for _, r in node.criteria]
-        self._check_join_dicts(node)
-        bkey = join_ops.composite_key(rkeys, right.sel)
-        pkey = join_ops.composite_key(lkeys, left.sel)
-        src = join_ops.build_unique(bkey, right.sel)
-        self.dup_checks.append((node, src.dup_count))
-        row, matched = join_ops.probe(src, pkey, left.sel)
-        build_cols = join_ops.gather_build(right.lanes, row, matched)
-        lanes = dict(left.lanes)
-        lanes.update(build_cols)
-        if node.kind == "inner":
-            sel = left.sel & matched
-        elif node.kind == "left":
-            sel = left.sel
-        else:
-            raise ExecutionError(f"join kind {node.kind} not supported yet")
-        if node.filter is not None:
-            f = compile_expr(node.filter, self.lowering)
-            v, ok = f(lanes)
-            if node.kind == "inner":
-                sel = sel & v & ok
-            else:
-                keep = matched & v & ok
-                for name in build_cols:
-                    bv, bok = lanes[name]
-                    lanes[name] = (bv, bok & keep)
-        return Batch(lanes, sel)
 
     def _visit_semijoin(self, node: P.SemiJoin) -> Batch:
         src = self.visit(node.source)

@@ -137,6 +137,10 @@ class Join(PlanNode):
     right: PlanNode
     criteria: Tuple[Tuple[str, str], ...]  # (left_symbol, right_symbol)
     filter: Optional[ir.Expr] = None
+    # build side may contain duplicate join keys -> expansion join kernel
+    # (vectorized LookupJoinOperator page building); set by the optimizer
+    # from connector uniqueness statistics
+    expansion: bool = False
 
     @property
     def sources(self):

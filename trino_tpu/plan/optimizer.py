@@ -246,10 +246,10 @@ def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
     node = _rewrite_sources(
         node, tuple(_choose_build_sides(s, metadata) for s in node.sources)
     )
-    if not (isinstance(node, P.Join) and node.kind == "inner" and node.criteria):
+    if not (isinstance(node, P.Join) and node.criteria):
         return node
-    # right side is the build side (HashBuilderOperator on right child).
-    # it must have unique join keys; prefer the smaller unique side.
+    import dataclasses
+
     lkeys = [l for l, _ in node.criteria]
     rkeys = [r for _, r in node.criteria]
     l_unique = all(_key_unique(node.left, k, metadata) for k in lkeys) or (
@@ -258,6 +258,12 @@ def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
     r_unique = all(_key_unique(node.right, k, metadata) for k in rkeys) or (
         len(rkeys) > 1 and any(_key_unique(node.right, k, metadata) for k in rkeys)
     )
+    if node.kind != "inner":
+        # outer joins cannot swap sides; build (right) duplicates -> expansion
+        return dataclasses.replace(node, expansion=not r_unique)
+    # right side is the build side (HashBuilderOperator on right child).
+    # prefer a unique-keyed (dimension) build side; else the smaller side
+    # with the expansion kernel.
     lrows = _estimate_rows(node.left, metadata)
     rrows = _estimate_rows(node.right, metadata)
     swap = False
@@ -265,6 +271,8 @@ def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
         swap = True
     elif l_unique and r_unique and lrows < rrows:
         swap = True
+    elif not l_unique and not r_unique and lrows < rrows:
+        swap = True  # smaller side as (expansion) build
     if swap:
         return P.Join(
             "inner",
@@ -272,8 +280,9 @@ def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
             node.left,
             tuple((r, l) for l, r in node.criteria),
             node.filter,
+            expansion=not l_unique,
         )
-    return node
+    return dataclasses.replace(node, expansion=not r_unique)
 
 
 # --- column pruning ----------------------------------------------------
@@ -323,12 +332,10 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
                 need.update(ir.referenced_columns(node.filter))
             lsyms = set(node.left.output_symbols())
             rsyms = set(node.right.output_symbols())
-            return P.Join(
-                node.kind,
-                prune(node.left, need & lsyms),
-                prune(node.right, need & rsyms),
-                node.criteria,
-                node.filter,
+            return dataclasses.replace(
+                node,
+                left=prune(node.left, need & lsyms),
+                right=prune(node.right, need & rsyms),
             )
         if isinstance(node, P.SemiJoin):
             need = (set(required) - {node.output}) | {node.source_key}
