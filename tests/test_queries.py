@@ -24,7 +24,8 @@ def oracle_conn():
     conn = sqlite3.connect(":memory:")
     load_tpch(
         conn, SF,
-        ["region", "nation", "customer", "orders", "lineitem", "supplier", "part"],
+        ["region", "nation", "customer", "orders", "lineitem", "supplier",
+         "part", "partsupp"],
     )
     return conn
 
@@ -259,4 +260,58 @@ def test_expansion_inner_join(session, oracle_conn):
         "select n_name, count(*) from nation join customer on n_nationkey = c_nationkey "
         "group by n_name order by n_name"
     )
+    check(session, oracle_conn, sql)
+
+
+# --- correlated subqueries (decorrelation) ----------------------------
+
+
+def test_correlated_exists_q4_shape(session, oracle_conn):
+    sql = """
+    select o_orderpriority, count(*) as order_count
+    from orders
+    where o_orderdate >= date '1993-07-01'
+      and o_orderdate < date '1993-10-01'
+      and exists (select * from lineitem
+                  where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
+    group by o_orderpriority
+    order by o_orderpriority
+    """
+    oracle_sql = sql.replace("date '1993-07-01'", "'1993-07-01'").replace(
+        "date '1993-10-01'", "'1993-10-01'"
+    )
+    check(session, oracle_conn, sql, oracle_sql)
+
+
+def test_correlated_not_exists(session, oracle_conn):
+    sql = (
+        "select count(*) from customer where not exists "
+        "(select * from orders where o_custkey = c_custkey)"
+    )
+    check(session, oracle_conn, sql)
+
+
+def test_correlated_scalar_avg_q17_shape(session, oracle_conn):
+    # official Q17 shape: correlation on the outer p_partkey
+    sql = """
+    select sum(l_extendedprice) / 7.0 as avg_yearly
+    from lineitem, part
+    where p_partkey = l_partkey
+      and p_brand = 'Brand#23'
+      and l_quantity < (select 0.2 * avg(l_quantity)
+                        from lineitem l2 where l2.l_partkey = p_partkey)
+    """
+    check(session, oracle_conn, sql, tol=5e-2)
+
+
+def test_correlated_scalar_min_q2_shape(session, oracle_conn):
+    sql = """
+    select s_name, p_partkey
+    from part, supplier, partsupp
+    where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+      and ps_supplycost = (select min(ps2.ps_supplycost) from partsupp ps2
+                           where ps2.ps_partkey = p_partkey)
+      and p_size = 15
+    order by s_name, p_partkey limit 10
+    """
     check(session, oracle_conn, sql)

@@ -462,21 +462,26 @@ class _TraceCtx:
     def _visit_semijoin(self, node: P.SemiJoin) -> Batch:
         src = self.visit(node.source)
         filt = self.visit(node.filtering)
-        fkey = filt.lanes[node.filtering_key]
-        skey = src.lanes[node.source_key]
-        # duplicates in the filtering side are fine for semi join: dedup by
-        # using sorted search (any match counts)
-        v, ok = fkey
-        live = filt.sel & ok
-        kv = jnp.where(live, v.astype(jnp.int64), join_ops.I64_MAX)
-        sorted_keys = jax.lax.sort(kv)
-        pv, pok = skey
-        idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
-        safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
-        hit = (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
+        hit = self._semi_hit(node, src, filt)
         lanes = dict(src.lanes)
         lanes[node.output] = (hit, jnp.ones(hit.shape, bool))
         return Batch(lanes, src.sel, src.ordered, src.replicated)
+
+    def _semi_hit(self, node: P.SemiJoin, src: Batch, filt: Batch):
+        """Membership mark; duplicates in the filtering side are fine
+        (sorted search, any match counts)."""
+        fv, fok = join_ops.composite_key(
+            [filt.lanes[k] for k in node.filtering_keys], filt.sel
+        )
+        live = filt.sel & fok
+        kv = jnp.where(live, fv.astype(jnp.int64), join_ops.I64_MAX)
+        sorted_keys = jax.lax.sort(kv)
+        pv, pok = join_ops.composite_key(
+            [src.lanes[k] for k in node.source_keys], src.sel
+        )
+        idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
+        safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
+        return (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
 
     def _visit_scalarjoin(self, node: P.ScalarJoin) -> Batch:
         src = self.visit(node.source)

@@ -373,16 +373,10 @@ class _MeshTraceCtx(_TraceCtx):
     def _visit_semijoin(self, node: P.SemiJoin) -> Batch:
         src = self.visit(node.source)
         filt = self.visit(node.filtering)
-        v, ok = filt.lanes[node.filtering_key]
-        live = filt.sel & ok
-        kv = jnp.where(live, v.astype(jnp.int64), join_ops.I64_MAX)
         if not filt.replicated:
-            kv = _agather(kv)  # broadcast the filtering keys
-        sorted_keys = jax.lax.sort(kv)
-        pv, pok = src.lanes[node.source_key]
-        idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
-        safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
-        hit = (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
+            # broadcast the filtering side (dynamic-filter style exchange)
+            filt = _gather_batch(filt)
+        hit = self._semi_hit(node, src, filt)
         lanes = dict(src.lanes)
         lanes[node.output] = (hit, jnp.ones(hit.shape, bool))
         return Batch(lanes, src.sel, src.ordered, src.replicated)

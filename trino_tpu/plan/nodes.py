@@ -157,15 +157,15 @@ class Join(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class SemiJoin(PlanNode):
-    """SemiJoinNode: marks rows of source whose key appears in filtering
-    source; output adds a boolean symbol."""
+    """SemiJoinNode: marks rows of source whose key(s) appear in the
+    filtering source; output adds a boolean symbol.  Multi-key form covers
+    decorrelated EXISTS (TransformCorrelatedExistsSubquery analog)."""
 
     source: PlanNode
     filtering: PlanNode
-    source_key: str
-    filtering_key: str
+    source_keys: Tuple[str, ...]
+    filtering_keys: Tuple[str, ...]
     output: str
-    negate_unused: bool = False
 
     @property
     def sources(self):

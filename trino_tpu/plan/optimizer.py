@@ -157,6 +157,43 @@ def _push_predicates(node: P.PlanNode) -> P.PlanNode:
         rest = _combine(residual)
         return P.Filter(newj, rest) if rest else newj
 
+    if isinstance(src, P.Join) and src.kind == "left":
+        # WHERE conjuncts touching only the probe (left) side commute with
+        # a left outer join; right-side/mixed conjuncts must stay above
+        lsyms = set(src.left.output_symbols())
+        down: List[ir.Expr] = []
+        stay: List[ir.Expr] = []
+        for c in conj:
+            refs = set(ir.referenced_columns(c))
+            (down if refs and refs <= lsyms else stay).append(c)
+        if down:
+            import dataclasses
+
+            newj = dataclasses.replace(
+                src, left=P.Filter(src.left, _combine(down))
+            )
+            rest = _combine(stay)
+            return P.Filter(newj, rest) if rest else newj
+        return node
+
+    if isinstance(src, P.ScalarJoin):
+        # same commuting rule: source-side conjuncts push below
+        ssyms = set(src.source.output_symbols())
+        down = []
+        stay = []
+        for c in conj:
+            refs = set(ir.referenced_columns(c))
+            (down if refs and refs <= ssyms else stay).append(c)
+        if down:
+            import dataclasses
+
+            newj = dataclasses.replace(
+                src, source=P.Filter(src.source, _combine(down))
+            )
+            rest = _combine(stay)
+            return P.Filter(newj, rest) if rest else newj
+        return node
+
     if isinstance(src, P.SemiJoin):
         # predicates not on the mark push below
         mark = src.output
@@ -166,8 +203,8 @@ def _push_predicates(node: P.PlanNode) -> P.PlanNode:
             new_src = P.SemiJoin(
                 P.Filter(src.source, _combine(below)),
                 src.filtering,
-                src.source_key,
-                src.filtering_key,
+                src.source_keys,
+                src.filtering_keys,
                 src.output,
             )
             rest = _combine(stay)
@@ -338,11 +375,11 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
                 right=prune(node.right, need & rsyms),
             )
         if isinstance(node, P.SemiJoin):
-            need = (set(required) - {node.output}) | {node.source_key}
+            need = (set(required) - {node.output}) | set(node.source_keys)
             return dataclasses.replace(
                 node,
                 source=prune(node.source, need),
-                filtering=prune(node.filtering, {node.filtering_key}),
+                filtering=prune(node.filtering, set(node.filtering_keys)),
             )
         if isinstance(node, P.ScalarJoin):
             sub_syms = set(node.subquery.output_symbols())

@@ -100,6 +100,24 @@ class Analyzer:
         self.default_catalog = default_catalog
         self.symbols = SymbolAllocator()
         self.ctes: Dict[str, ast.Query] = {}
+        # correlated-subquery support: while planning a subquery, outer
+        # scopes are visible for resolution; outer symbols actually used
+        # are recorded per level (ApplyNode correlation list analog)
+        self.outer_scopes: List[Scope] = []
+        self.correlation_used: List[Dict[str, T.Type]] = []
+
+    def _plan_subquery_correlated(self, q: ast.Query, outer: Scope):
+        """Plan q with `outer` visible; returns (RelationPlan, names,
+        {outer symbol -> type} actually referenced)."""
+        self.outer_scopes.append(outer)
+        self.correlation_used.append({})
+        try:
+            rp, names = self.plan_query(q)
+            used = self.correlation_used[-1]
+        finally:
+            self.outer_scopes.pop()
+            self.correlation_used.pop()
+        return rp, names, used
 
     # ------------------------------------------------------------------
     def plan_statement(self, stmt: ast.Node) -> P.PlanNode:
@@ -295,7 +313,7 @@ class Analyzer:
             raise SemanticError("IN subquery must return one column")
         out = self.symbols.new("semi")
         node = P.SemiJoin(
-            rel.root, sub.root, v.name, sub.scope.fields[0].symbol, out
+            rel.root, sub.root, (v.name,), (sub.scope.fields[0].symbol,), out
         )
         # filter on the mark (negated for NOT IN; NULL semantics simplified
         # to not-matched, exact NOT IN null semantics handled at kernel)
@@ -303,10 +321,116 @@ class Analyzer:
         pred: ir.Expr = ir.Not(mark) if negate else mark
         return RelationPlan(P.Filter(node, pred), rel.scope)
 
+    # -- decorrelation (TransformCorrelated* rules analog) --------------
+    def _decorrelate(self, root: P.PlanNode, outer_syms: Dict[str, T.Type]):
+        """Extract correlated equality conjuncts from the subplan.
+
+        Returns (new_root, pairs) where pairs = [(outer_symbol, inner_symbol)]
+        and new_root exposes every inner symbol at its top (pass-through
+        projections added; Aggregates gain the inner symbols as group keys,
+        turning a correlated scalar aggregate into a grouped one).
+        """
+        outer = set(outer_syms)
+
+        def rec(node: P.PlanNode):
+            if isinstance(node, P.Filter):
+                src2, pairs = rec(node.source)
+                rest: List[ir.Expr] = []
+                my_pairs: List[Tuple[str, str]] = []
+                extra_proj: List[Tuple[str, ir.Expr]] = []
+                for c in _flatten_ir_and(node.predicate):
+                    refs = set(ir.referenced_columns(c)) & outer
+                    if not refs:
+                        rest.append(c)
+                        continue
+                    pair = _as_correlated_equality(c, outer)
+                    if pair is None:
+                        raise SemanticError(
+                            f"unsupported correlated predicate: {c!r} "
+                            "(only outer_col = inner_expr is decorrelatable)"
+                        )
+                    osym, inner = pair
+                    if isinstance(inner, ir.ColumnRef):
+                        my_pairs.append((osym, inner.name))
+                    else:
+                        isym = self.symbols.new("corrkey")
+                        extra_proj.append((isym, inner))
+                        my_pairs.append((osym, isym))
+                src3 = src2
+                if extra_proj:
+                    passthrough = [
+                        (s, ir.ColumnRef(t, s))
+                        for s, t in src2.output_types().items()
+                    ]
+                    src3 = P.Project(src2, tuple(passthrough + extra_proj))
+                out = P.Filter(src3, _combine_ir(rest)) if rest else src3
+                return out, pairs + my_pairs
+            if isinstance(node, P.Project):
+                src2, pairs = rec(node.source)
+                if not pairs:
+                    return dataclasses.replace(node, source=src2), pairs
+                types = src2.output_types()
+                have = {s for s, _ in node.assignments}
+                extra = tuple(
+                    (isym, ir.ColumnRef(types[isym], isym))
+                    for _, isym in pairs
+                    if isym not in have
+                )
+                return (
+                    P.Project(src2, tuple(node.assignments) + extra),
+                    pairs,
+                )
+            if isinstance(node, P.Aggregate):
+                src2, pairs = rec(node.source)
+                if not pairs:
+                    return dataclasses.replace(node, source=src2), pairs
+                new_keys = tuple(
+                    dict.fromkeys(
+                        list(node.keys) + [isym for _, isym in pairs]
+                    )
+                )
+                return (
+                    P.Aggregate(src2, new_keys, node.aggs, node.step),
+                    pairs,
+                )
+            if isinstance(node, (P.Limit, P.TopN, P.Sort, P.Distinct)):
+                src2, pairs = rec(node.sources[0])
+                if pairs:
+                    raise SemanticError(
+                        "correlation below ORDER BY/LIMIT/DISTINCT is not "
+                        "decorrelatable"
+                    )
+                return node, pairs
+            # joins/scans/semijoins: correlation must not appear below
+            for s in node.sources:
+                for t in _walk_plan_exprs(s):
+                    if set(ir.referenced_columns(t)) & outer:
+                        raise SemanticError(
+                            "correlated reference in unsupported position"
+                        )
+            return node, []
+
+        return rec(root)
+
     def _plan_exists(
         self, rel: RelationPlan, query: ast.Query, negate: bool
     ) -> RelationPlan:
-        sub, _ = self.plan_query(query)
+        sub, _, corr = self._plan_subquery_correlated(query, rel.scope)
+        if corr:
+            new_root, pairs = self._decorrelate(sub.root, corr)
+            if not pairs:
+                raise SemanticError("correlated EXISTS without usable equality")
+            out = self.symbols.new("semi")
+            node = P.SemiJoin(
+                rel.root,
+                new_root,
+                tuple(o for o, _ in pairs),
+                tuple(i for _, i in pairs),
+                out,
+            )
+            mark = ir.ColumnRef(T.BOOLEAN, out)
+            pred: ir.Expr = ir.Not(mark) if negate else mark
+            return RelationPlan(P.Filter(node, pred), rel.scope)
         cnt = self.symbols.new("exists_count")
         agg = P.Aggregate(
             sub.root,
@@ -532,6 +656,54 @@ class Analyzer:
 # expression analysis
 
 
+def _flatten_ir_and(e: ir.Expr) -> List[ir.Expr]:
+    if isinstance(e, ir.Logical) and e.op == "and":
+        out: List[ir.Expr] = []
+        for t in e.terms:
+            out.extend(_flatten_ir_and(t))
+        return out
+    return [e]
+
+
+def _combine_ir(terms: List[ir.Expr]) -> ir.Expr:
+    return terms[0] if len(terms) == 1 else ir.Logical("and", tuple(terms))
+
+
+def _as_correlated_equality(c: ir.Expr, outer: set):
+    """Match `outer_col = inner_expr` (either orientation); returns
+    (outer_symbol, inner_expr) or None."""
+    if not (isinstance(c, ir.Comparison) and c.op == "="):
+        return None
+    lrefs = set(ir.referenced_columns(c.left))
+    rrefs = set(ir.referenced_columns(c.right))
+    if (
+        isinstance(c.left, ir.ColumnRef)
+        and c.left.name in outer
+        and not (rrefs & outer)
+    ):
+        return c.left.name, c.right
+    if (
+        isinstance(c.right, ir.ColumnRef)
+        and c.right.name in outer
+        and not (lrefs & outer)
+    ):
+        return c.right.name, c.left
+    return None
+
+
+def _walk_plan_exprs(node: P.PlanNode):
+    """All expressions inside a plan subtree (for correlation checks)."""
+    if isinstance(node, P.Filter):
+        yield node.predicate
+    elif isinstance(node, P.Project):
+        for _, e in node.assignments:
+            yield e
+    elif isinstance(node, P.Join) and node.filter is not None:
+        yield node.filter
+    for s in node.sources:
+        yield from _walk_plan_exprs(s)
+
+
 def _flatten_and(e: ast.Node) -> List[ast.Node]:
     if isinstance(e, ast.LogicalOp) and e.op == "and":
         out = []
@@ -626,7 +798,20 @@ class ExprAnalyzer:
         return out
 
     def _resolve_column(self, parts) -> ir.Expr:
-        f = self.relation.scope.resolve(tuple(p.lower() for p in parts))
+        key = tuple(p.lower() for p in parts)
+        try:
+            f = self.relation.scope.resolve(key)
+        except SemanticError:
+            # correlated reference into an enclosing query's scope
+            for i in range(len(self.a.outer_scopes) - 1, -1, -1):
+                try:
+                    f = self.a.outer_scopes[i].resolve(key)
+                except SemanticError:
+                    continue
+                for lvl in range(i, len(self.a.correlation_used)):
+                    self.a.correlation_used[lvl][f.symbol] = f.type
+                return ir.ColumnRef(f.type, f.symbol)
+            raise
         return ir.ColumnRef(f.type, f.symbol)
 
     def _an(self, e: ast.Node) -> ir.Expr:
@@ -748,10 +933,25 @@ class ExprAnalyzer:
         raise SemanticError(f"unknown function: {e.name}")
 
     def _scalar_subquery(self, q: ast.Query) -> ir.Expr:
-        sub, _ = self.a.plan_query(q)
+        sub, _, corr = self.a._plan_subquery_correlated(q, self.relation.scope)
         if len(sub.scope.fields) != 1:
             raise SemanticError("scalar subquery must return one column")
         f = sub.scope.fields[0]
+        if corr:
+            # correlated scalar aggregate -> grouped aggregate + LEFT join
+            # (TransformCorrelatedScalarAggregationToJoin)
+            new_root, pairs = self.a._decorrelate(sub.root, corr)
+            if not pairs:
+                raise SemanticError("correlated scalar subquery without equality")
+            node = P.Join(
+                "left",
+                self.relation.root,
+                new_root,
+                tuple(pairs),
+                expansion=False,  # grouped by the correlation keys -> unique
+            )
+            self.relation = RelationPlan(node, self.relation.scope)
+            return ir.ColumnRef(f.type, f.symbol)
         node = P.ScalarJoin(self.relation.root, sub.root)
         self.relation = RelationPlan(node, self.relation.scope)
         return ir.ColumnRef(f.type, f.symbol)
@@ -1007,7 +1207,9 @@ def _agg_output_type(kind: str, in_t: T.Type) -> T.Type:
         return T.BIGINT
     if kind == "avg":
         if in_t.is_decimal:
-            return T.decimal(18, max(in_t.scale, 4))
+            # scale 6 keeps boundary comparisons (e.g. Q17's qty < 0.2*avg)
+            # within rounding noise of exact decimal(38) math
+            return T.decimal(18, max(in_t.scale, 6))
         return T.DOUBLE
     raise SemanticError(kind)
 
