@@ -315,3 +315,45 @@ def test_correlated_scalar_min_q2_shape(session, oracle_conn):
     order by s_name, p_partkey limit 10
     """
     check(session, oracle_conn, sql)
+
+
+def test_count_distinct(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select count(distinct o_custkey), count(*) from orders",
+    )
+
+
+def test_count_distinct_grouped(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderpriority, count(distinct o_custkey) from orders "
+        "group by o_orderpriority order by o_orderpriority",
+    )
+
+
+def test_tpch_q16_shape(session, oracle_conn):
+    sql = """
+    select p_brand, p_type, p_size, count(distinct ps_suppkey) as supplier_cnt
+    from partsupp, part
+    where p_partkey = ps_partkey
+      and p_brand <> 'Brand#45'
+      and p_type not like 'MEDIUM POLISHED%'
+      and p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+      and ps_suppkey not in (select s_suppkey from supplier
+                             where s_comment like '%Customer%Complaints%')
+    group by p_brand, p_type, p_size
+    order by supplier_cnt desc, p_brand, p_type, p_size
+    limit 20
+    """
+    check(session, oracle_conn, sql)
+
+
+def test_substring_predicate_q22_shape(session, oracle_conn):
+    sql = (
+        "select substring(c_phone, 1, 2) as cntrycode, count(*), sum(c_acctbal) "
+        "from customer where substring(c_phone, 1, 2) in ('13', '31', '23') "
+        "group by substring(c_phone, 1, 2) order by cntrycode"
+    )
+    oracle_sql = sql.replace("substring(c_phone, 1, 2)", "substr(c_phone, 1, 2)")
+    check(session, oracle_conn, sql, oracle_sql)

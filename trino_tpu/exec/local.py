@@ -233,9 +233,13 @@ class _TraceCtx:
         out = {}
         for sym, e in node.assignments:
             out[sym] = compile_expr(e, self.lowering)(b.lanes)
-            # propagate dictionaries through pass-through references
+            # propagate dictionaries: pass-through refs and derived strings
             if isinstance(e, ir.ColumnRef) and e.name in self.ex.dicts:
                 self.ex.dicts[sym] = self.ex.dicts[e.name]
+            else:
+                d = self.lowering.dict_for_expr(e)
+                if d is not None:
+                    self.ex.dicts[sym] = d
         return Batch(out, b.sel, b.ordered, b.replicated)
 
     def _visit_limit(self, node: P.Limit) -> Batch:
@@ -265,13 +269,11 @@ class _TraceCtx:
         types = node.source.output_types()
         specs = [
             agg_ops.AggSpec(
-                a.kind, a.arg, a.output, a.input_type, a.output_type
+                a.kind, a.arg, a.output, a.input_type, a.output_type,
+                a.distinct,
             )
             for a in node.aggs
         ]
-        for a in node.aggs:
-            if a.distinct:
-                raise ExecutionError("DISTINCT aggregates not yet supported")
         if not node.keys:
             # global aggregation: one group
             gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)

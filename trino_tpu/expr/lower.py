@@ -64,20 +64,37 @@ class LoweringContext:
 
     def __init__(self, dictionaries: Dict[str, np.ndarray] | None = None):
         self.dictionaries = dictionaries or {}
+        # dictionaries of *derived* string expressions (substring(col,..)
+        # etc.), keyed by the (hashable, frozen) IR node that produced them
+        self.expr_dicts: Dict[object, np.ndarray] = {}
+
+    def dict_for_expr(self, e) -> np.ndarray | None:
+        """Dictionary of a varchar-typed expression: source column's, or a
+        derived one registered by a string function."""
+        from . import ir as _ir
+
+        if isinstance(e, _ir.ColumnRef):
+            return self.dictionaries.get(e.name)
+        return self.expr_dicts.get(e)
 
     # -- host-side dictionary predicate evaluation ---------------------
-    def dict_code_for(self, col: str, s: str) -> int:
-        d = self.dictionaries.get(col)
+    def _dict_of(self, col_or_expr):
+        if isinstance(col_or_expr, str):
+            d = self.dictionaries.get(col_or_expr)
+        else:
+            d = self.dict_for_expr(col_or_expr)
         if d is None:
-            raise KeyError(f"no dictionary for column {col}")
+            raise KeyError(f"no dictionary for {col_or_expr}")
+        return d
+
+    def dict_code_for(self, col, s: str) -> int:
+        d = self._dict_of(col)
         idx = np.nonzero(d == s)[0]
         return int(idx[0]) if len(idx) else -2  # -2: never matches any code
 
-    def dict_mask(self, col: str, pred: Callable[[str], bool]) -> np.ndarray:
+    def dict_mask(self, col, pred: Callable[[str], bool]) -> np.ndarray:
         """Boolean lookup table over dictionary entries (for LIKE etc.)."""
-        d = self.dictionaries.get(col)
-        if d is None:
-            raise KeyError(f"no dictionary for column {col}")
+        d = self._dict_of(col)
         return np.array([bool(pred(str(x))) for x in d], dtype=bool)
 
 
@@ -183,17 +200,16 @@ def _cmp(op: str, lv, rv):
 
 
 def _dict_const_cmp(col_expr, op, const_val, cols, ev, ctx: LoweringContext) -> Lane:
-    """Lower dict-column <op> string-constant via host dictionary lookup."""
+    """Lower dict-expr <op> string-constant via host dictionary lookup."""
     cv, cok = ev(col_expr, cols)
-    name = col_expr.name if isinstance(col_expr, ir.ColumnRef) else None
-    if name is None or name not in ctx.dictionaries:
-        raise NotImplementedError("dict comparison requires a scan dictionary")
+    if ctx.dict_for_expr(col_expr) is None:
+        raise NotImplementedError("dict comparison requires a dictionary")
     if op in ("=", "<>", "!="):
-        code = ctx.dict_code_for(name, const_val)
+        code = ctx.dict_code_for(col_expr, const_val)
         res = cv == code if op == "=" else cv != code
         return res, cok
     if op == "is_distinct":
-        code = ctx.dict_code_for(name, const_val)
+        code = ctx.dict_code_for(col_expr, const_val)
         # null IS DISTINCT FROM 'x' -> true; result is never null
         res = jnp.where(cok, cv != code, True)
         return res, _all_valid(res)
@@ -201,7 +217,7 @@ def _dict_const_cmp(col_expr, op, const_val, cols, ev, ctx: LoweringContext) -> 
     import operator as _op
 
     fns = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge}
-    table = ctx.dict_mask(name, lambda s: fns[op](s, const_val))
+    table = ctx.dict_mask(col_expr, lambda s: fns[op](s, const_val))
     res = dict_gather(table, cv)
     return res, cok
 
@@ -226,11 +242,14 @@ def _lower_logical(node: ir.Logical, cols, ev) -> Lane:
 
 def _lower_in(node: ir.In, cols, ev, ctx: LoweringContext) -> Lane:
     vt = node.value.type
-    if vt.is_dictionary and isinstance(node.value, ir.ColumnRef):
-        name = node.value.name
-        vals = {it.value for it in node.items if isinstance(it, ir.Constant)}
-        table = ctx.dict_mask(name, lambda s: s in vals)
+    if vt.is_dictionary:
+        # evaluate first: derived-string functions register their
+        # dictionaries during evaluation
         cv, cok = ev(node.value, cols)
+        if ctx.dict_for_expr(node.value) is None:
+            raise NotImplementedError("IN on varchar requires a dictionary")
+        vals = {it.value for it in node.items if isinstance(it, ir.Constant)}
+        table = ctx.dict_mask(node.value, lambda s: s in vals)
         res = dict_gather(table, cv)
         if node.negate:
             res = jnp.logical_not(res)

@@ -55,6 +55,7 @@ class AggSpec:
     output: str
     input_type: Optional[T.Type] = None
     output_type: Optional[T.Type] = None
+    distinct: bool = False
 
     @property
     def accumulator_names(self) -> List[str]:
@@ -114,6 +115,30 @@ def sort_group_ids(
     return perm, gid, ngroups
 
 
+def distinct_count(
+    gid: jnp.ndarray, lane: Lane, sel: jnp.ndarray, capacity: int
+) -> jnp.ndarray:
+    """count(DISTINCT x) per group: sort by (gid, x), count first
+    occurrences (MarkDistinctOperator + count, in one sort)."""
+    v, ok = lane
+    live = sel & ok
+    n = gid.shape[0]
+    vv = v.astype(jnp.int64) if v.dtype.kind in ("i", "u", "b") else v
+    dead = jnp.logical_not(live)
+    # dead rows sort last; within live rows, equal (gid, value) adjacent
+    sorted_ops = jax.lax.sort(
+        (dead, gid, vv, jnp.arange(n, dtype=jnp.int64)), num_keys=3
+    )
+    d2, g2, v2, perm = sorted_ops
+    live2 = jnp.logical_not(d2)
+    first = jnp.concatenate(
+        [jnp.ones(1, bool), (g2[1:] != g2[:-1]) | (v2[1:] != v2[:-1])]
+    )
+    flags = (first & live2).astype(jnp.int64)
+    return jax.ops.segment_sum(flags, jnp.clip(g2, 0, capacity - 1),
+                               num_segments=capacity)
+
+
 def accumulate(
     specs: Sequence[AggSpec],
     lanes: Dict[str, Lane],
@@ -124,6 +149,13 @@ def accumulate(
     """Compute accumulator arrays (shape [capacity]) per spec."""
     out: Dict[str, jnp.ndarray] = {}
     for s in specs:
+        if getattr(s, "distinct", False):
+            if s.kind != "count":
+                raise NotImplementedError(f"{s.kind}(DISTINCT) not supported")
+            out[f"{s.output}$count"] = distinct_count(
+                gid, lanes[s.input], sel, capacity
+            )
+            continue
         if s.kind == "count_star":
             w = sel.astype(jnp.int64)
             out[f"{s.output}$count"] = jax.ops.segment_sum(

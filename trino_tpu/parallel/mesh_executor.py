@@ -244,6 +244,11 @@ class _MeshTraceCtx(_TraceCtx):
     # -- aggregation -----------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate) -> Batch:
         b = self.visit(node.source)
+        if any(a.distinct for a in node.aggs) and not b.replicated:
+            # DISTINCT aggregation needs global dedup: gather input rows
+            # (single-distribution fragment; hash-repartitioned distinct
+            # is the next increment)
+            b = _gather_batch(b)
         if b.replicated:
             out = _TraceCtx._visit_aggregate(self, node, b)
             return Batch(out.lanes, out.sel, out.ordered, replicated=True)
@@ -252,9 +257,6 @@ class _MeshTraceCtx(_TraceCtx):
             agg_ops.AggSpec(a.kind, a.arg, a.output, a.input_type, a.output_type)
             for a in node.aggs
         ]
-        for a in node.aggs:
-            if a.distinct:
-                raise ExecutionError("DISTINCT aggregates not yet supported")
 
         if not node.keys:
             gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)

@@ -920,6 +920,11 @@ class ExprAnalyzer:
             return ir.Call(rt, e.name, tuple(args))
         if e.name == "length":
             return ir.Call(T.BIGINT, "length", (self._an(e.args[0]),))
+        if e.name in ("substring", "substr"):
+            args = tuple(self._an(a) for a in e.args)
+            if not args[0].type.is_dictionary:
+                raise SemanticError("substring() requires a varchar argument")
+            return ir.Call(T.VARCHAR, "substring", args)
         if e.name == "coalesce":
             args = tuple(self._an(a) for a in e.args)
             rt = args[0].type
