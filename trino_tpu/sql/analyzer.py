@@ -503,7 +503,10 @@ class Analyzer:
             Field(None, s, s, t)
             for s, t in agg_node.output_types().items()
         ]
-        rel2 = RelationPlan(agg_node, Scope(new_fields))
+        root: P.PlanNode = agg_node
+        for subplan in agg_collector.pending_scalar:
+            root = P.ScalarJoin(root, subplan)
+        rel2 = RelationPlan(root, Scope(new_fields))
         if having_pred is not None:
             rel2 = RelationPlan(P.Filter(rel2.root, having_pred), rel2.scope)
         post_analyzer = PostAggAnalyzer(
@@ -791,6 +794,8 @@ class ExprAnalyzer:
     def __init__(self, analyzer: Analyzer, relation: RelationPlan):
         self.a = analyzer
         self.relation = relation
+        # symbols produced by scalar subqueries (allowed post-aggregation)
+        self.scalar_syms: set = set()
 
     # -- entry ----------------------------------------------------------
     def analyze(self, e: ast.Node) -> ir.Expr:
@@ -956,9 +961,11 @@ class ExprAnalyzer:
                 expansion=False,  # grouped by the correlation keys -> unique
             )
             self.relation = RelationPlan(node, self.relation.scope)
+            self.scalar_syms.add(f.symbol)
             return ir.ColumnRef(f.type, f.symbol)
         node = P.ScalarJoin(self.relation.root, sub.root)
         self.relation = RelationPlan(node, self.relation.scope)
+        self.scalar_syms.add(f.symbol)
         return ir.ColumnRef(f.type, f.symbol)
 
 
@@ -973,6 +980,23 @@ class AggCollector(ExprAnalyzer):
         self.pre_assigns = pre_assigns
         self.aggs: List[P.AggInfo] = []
         self._agg_cache: Dict[tuple, ir.ColumnRef] = {}
+        # scalar subqueries in HAVING/post-agg expressions join ABOVE the
+        # aggregation (the reference plans Apply above AggregationNode)
+        self.pending_scalar: List[P.PlanNode] = []
+
+    def _scalar_subquery(self, q: ast.Query) -> ir.Expr:
+        sub, _, corr = self.a._plan_subquery_correlated(q, self.relation.scope)
+        if corr:
+            raise SemanticError(
+                "correlated scalar subquery in post-aggregation position "
+                "is not supported"
+            )
+        if len(sub.scope.fields) != 1:
+            raise SemanticError("scalar subquery must return one column")
+        f = sub.scope.fields[0]
+        self.pending_scalar.append(sub.root)
+        self.scalar_syms.add(f.symbol)
+        return ir.ColumnRef(f.type, f.symbol)
 
     def analyze_post(self, e: ast.Node) -> ir.Expr:
         out = self._post(e)
@@ -1063,9 +1087,11 @@ class AggCollector(ExprAnalyzer):
         return ref
 
     def _validate(self, e: ir.Expr):
-        allowed = {r.name for _, r in self.key_map} | {
-            a.output for a in self.aggs
-        }
+        allowed = (
+            {r.name for _, r in self.key_map}
+            | {a.output for a in self.aggs}
+            | self.scalar_syms
+        )
         for n in ir.walk(e):
             if isinstance(n, ir.ColumnRef) and n.name not in allowed:
                 raise SemanticError(
