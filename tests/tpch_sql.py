@@ -394,7 +394,7 @@ limit 100
 """,
     None,
     True,
-    "correlated EXISTS with non-equality conjunct (round-2 decorrelation)",
+    None,
 )
 
 QUERIES[22] = (

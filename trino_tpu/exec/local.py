@@ -472,6 +472,8 @@ class _TraceCtx:
     def _semi_hit(self, node: P.SemiJoin, src: Batch, filt: Batch):
         """Membership mark; duplicates in the filtering side are fine
         (sorted search, any match counts)."""
+        if node.filter is not None:
+            return self._semi_hit_filtered(node, src, filt)
         fv, fok = join_ops.composite_key(
             [filt.lanes[k] for k in node.filtering_keys], filt.sel
         )
@@ -484,6 +486,46 @@ class _TraceCtx:
         idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
         safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
         return (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
+
+    def _semi_hit_filtered(self, node: P.SemiJoin, src: Batch, filt: Batch):
+        """Mark join with a residual pair predicate: expand candidate
+        (source, filtering) pairs on the equi keys, evaluate the residual,
+        reduce any-match per source row (EXISTS with non-equality
+        correlation, e.g. TPC-H Q21)."""
+        bkey = join_ops.composite_key(
+            [filt.lanes[k] for k in node.filtering_keys], filt.sel
+        )
+        pkey = join_ops.composite_key(
+            [src.lanes[k] for k in node.source_keys], src.sel
+        )
+        build = join_ops.build_multi(bkey, filt.sel)
+        counts, lo = join_ops.probe_counts(build, pkey, src.sel)
+        n_src = src.sel.shape[0]
+        capacity = _pad_capacity(
+            int(n_src * getattr(self.ex, "join_factor", 1))
+        )
+        probe_row, build_row, matched, total = join_ops.expand_join(
+            build, counts, lo, capacity
+        )
+        self._note_capacity(total, capacity)
+        lanes = {}
+        for s, (v, ok) in src.lanes.items():
+            lanes[s] = (v[probe_row], ok[probe_row])
+        for s, (v, ok) in filt.lanes.items():
+            lanes[s] = (v[build_row], ok[build_row] & matched)
+        f = compile_expr(node.filter, self.lowering)
+        fv, fok = f(lanes)
+        pair_ok = (
+            matched
+            & (jnp.arange(capacity) < total)
+            & fv
+            & fok
+            & src.sel[probe_row]
+        )
+        marks = jax.ops.segment_sum(
+            pair_ok.astype(jnp.int32), probe_row, num_segments=n_src
+        )
+        return marks > 0
 
     def _visit_scalarjoin(self, node: P.ScalarJoin) -> Batch:
         src = self.visit(node.source)

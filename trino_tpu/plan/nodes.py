@@ -166,6 +166,9 @@ class SemiJoin(PlanNode):
     source_keys: Tuple[str, ...]
     filtering_keys: Tuple[str, ...]
     output: str
+    # residual predicate over (source row, filtering row) pairs — the
+    # "mark join" form needed by EXISTS with non-equality correlation
+    filter: Optional[ir.Expr] = None
 
     @property
     def sources(self):

@@ -200,12 +200,10 @@ def _push_predicates(node: P.PlanNode) -> P.PlanNode:
         below = [c for c in conj if mark not in ir.referenced_columns(c)]
         stay = [c for c in conj if mark in ir.referenced_columns(c)]
         if below:
-            new_src = P.SemiJoin(
-                P.Filter(src.source, _combine(below)),
-                src.filtering,
-                src.source_keys,
-                src.filtering_keys,
-                src.output,
+            import dataclasses
+
+            new_src = dataclasses.replace(
+                src, source=P.Filter(src.source, _combine(below))
             )
             rest = _combine(stay)
             return P.Filter(new_src, rest) if rest else new_src
@@ -375,11 +373,19 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
                 right=prune(node.right, need & rsyms),
             )
         if isinstance(node, P.SemiJoin):
-            need = (set(required) - {node.output}) | set(node.source_keys)
+            fref = (
+                set(ir.referenced_columns(node.filter))
+                if node.filter is not None
+                else set()
+            )
+            ssyms = set(node.source.output_symbols())
+            need = ((set(required) - {node.output}) | set(node.source_keys)
+                    | (fref & ssyms))
+            fneed = set(node.filtering_keys) | (fref - ssyms)
             return dataclasses.replace(
                 node,
                 source=prune(node.source, need),
-                filtering=prune(node.filtering, set(node.filtering_keys)),
+                filtering=prune(node.filtering, fneed),
             )
         if isinstance(node, P.ScalarJoin):
             sub_syms = set(node.subquery.output_symbols())

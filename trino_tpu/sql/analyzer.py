@@ -325,18 +325,22 @@ class Analyzer:
     def _decorrelate(self, root: P.PlanNode, outer_syms: Dict[str, T.Type]):
         """Extract correlated equality conjuncts from the subplan.
 
-        Returns (new_root, pairs) where pairs = [(outer_symbol, inner_symbol)]
-        and new_root exposes every inner symbol at its top (pass-through
-        projections added; Aggregates gain the inner symbols as group keys,
-        turning a correlated scalar aggregate into a grouped one).
+        Returns (new_root, pairs, residuals) where pairs =
+        [(outer_symbol, inner_symbol)], residuals are correlated
+        non-equality conjuncts (kept verbatim, referencing outer + inner
+        symbols — the mark-join filter), and new_root exposes every inner
+        symbol at its top (pass-through projections added; Aggregates gain
+        the inner symbols as group keys, turning a correlated scalar
+        aggregate into a grouped one).
         """
         outer = set(outer_syms)
 
         def rec(node: P.PlanNode):
             if isinstance(node, P.Filter):
-                src2, pairs = rec(node.source)
+                src2, pairs, residuals = rec(node.source)
                 rest: List[ir.Expr] = []
                 my_pairs: List[Tuple[str, str]] = []
+                my_res: List[ir.Expr] = []
                 extra_proj: List[Tuple[str, ir.Expr]] = []
                 for c in _flatten_ir_and(node.predicate):
                     refs = set(ir.referenced_columns(c)) & outer
@@ -345,10 +349,8 @@ class Analyzer:
                         continue
                     pair = _as_correlated_equality(c, outer)
                     if pair is None:
-                        raise SemanticError(
-                            f"unsupported correlated predicate: {c!r} "
-                            "(only outer_col = inner_expr is decorrelatable)"
-                        )
+                        my_res.append(c)
+                        continue
                     osym, inner = pair
                     if isinstance(inner, ir.ColumnRef):
                         my_pairs.append((osym, inner.name))
@@ -364,26 +366,38 @@ class Analyzer:
                     ]
                     src3 = P.Project(src2, tuple(passthrough + extra_proj))
                 out = P.Filter(src3, _combine_ir(rest)) if rest else src3
-                return out, pairs + my_pairs
+                return out, pairs + my_pairs, residuals + my_res
             if isinstance(node, P.Project):
-                src2, pairs = rec(node.source)
-                if not pairs:
-                    return dataclasses.replace(node, source=src2), pairs
+                src2, pairs, residuals = rec(node.source)
+                if not pairs and not residuals:
+                    return dataclasses.replace(node, source=src2), pairs, residuals
                 types = src2.output_types()
                 have = {s for s, _ in node.assignments}
+                need = [isym for _, isym in pairs]
+                for r in residuals:
+                    need.extend(
+                        c for c in ir.referenced_columns(r)
+                        if c not in outer and c in types
+                    )
                 extra = tuple(
                     (isym, ir.ColumnRef(types[isym], isym))
-                    for _, isym in pairs
+                    for isym in dict.fromkeys(need)
                     if isym not in have
                 )
                 return (
                     P.Project(src2, tuple(node.assignments) + extra),
                     pairs,
+                    residuals,
                 )
             if isinstance(node, P.Aggregate):
-                src2, pairs = rec(node.source)
+                src2, pairs, residuals = rec(node.source)
+                if residuals:
+                    raise SemanticError(
+                        "non-equality correlation below an aggregate is not "
+                        "decorrelatable"
+                    )
                 if not pairs:
-                    return dataclasses.replace(node, source=src2), pairs
+                    return dataclasses.replace(node, source=src2), pairs, residuals
                 new_keys = tuple(
                     dict.fromkeys(
                         list(node.keys) + [isym for _, isym in pairs]
@@ -392,15 +406,16 @@ class Analyzer:
                 return (
                     P.Aggregate(src2, new_keys, node.aggs, node.step),
                     pairs,
+                    residuals,
                 )
             if isinstance(node, (P.Limit, P.TopN, P.Sort, P.Distinct)):
-                src2, pairs = rec(node.sources[0])
-                if pairs:
+                src2, pairs, residuals = rec(node.sources[0])
+                if pairs or residuals:
                     raise SemanticError(
                         "correlation below ORDER BY/LIMIT/DISTINCT is not "
                         "decorrelatable"
                     )
-                return node, pairs
+                return node, pairs, residuals
             # joins/scans/semijoins: correlation must not appear below
             for s in node.sources:
                 for t in _walk_plan_exprs(s):
@@ -408,7 +423,7 @@ class Analyzer:
                         raise SemanticError(
                             "correlated reference in unsupported position"
                         )
-            return node, []
+            return node, [], []
 
         return rec(root)
 
@@ -417,7 +432,7 @@ class Analyzer:
     ) -> RelationPlan:
         sub, _, corr = self._plan_subquery_correlated(query, rel.scope)
         if corr:
-            new_root, pairs = self._decorrelate(sub.root, corr)
+            new_root, pairs, residuals = self._decorrelate(sub.root, corr)
             if not pairs:
                 raise SemanticError("correlated EXISTS without usable equality")
             out = self.symbols.new("semi")
@@ -427,6 +442,7 @@ class Analyzer:
                 tuple(o for o, _ in pairs),
                 tuple(i for _, i in pairs),
                 out,
+                filter=_combine_ir(residuals) if residuals else None,
             )
             mark = ir.ColumnRef(T.BOOLEAN, out)
             pred: ir.Expr = ir.Not(mark) if negate else mark
@@ -950,9 +966,13 @@ class ExprAnalyzer:
         if corr:
             # correlated scalar aggregate -> grouped aggregate + LEFT join
             # (TransformCorrelatedScalarAggregationToJoin)
-            new_root, pairs = self.a._decorrelate(sub.root, corr)
+            new_root, pairs, residuals = self.a._decorrelate(sub.root, corr)
             if not pairs:
                 raise SemanticError("correlated scalar subquery without equality")
+            if residuals:
+                raise SemanticError(
+                    "non-equality correlation in scalar subquery unsupported"
+                )
             node = P.Join(
                 "left",
                 self.relation.root,
