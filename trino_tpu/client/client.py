@@ -1,0 +1,47 @@
+"""Statement client: the nextUri pull loop.
+
+Reference parity: client/trino-client StatementClientV1.java:69 —
+POST /v1/statement (:141), advance() loop (:349) following nextUri until
+FINISHED/FAILED, accumulating data pages.
+"""
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import List, Optional, Tuple
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class StatementClient:
+    def __init__(self, server: str):
+        self.server = server.rstrip("/")
+
+    def execute(self, sql: str) -> Tuple[List[dict], List[list]]:
+        """Returns (columns, rows)."""
+        req = urllib.request.Request(
+            f"{self.server}/v1/statement",
+            data=sql.encode(),
+            method="POST",
+            headers={"X-Trino-User": "trino-tpu"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            doc = json.load(resp)
+        columns: List[dict] = []
+        rows: List[list] = []
+        while True:
+            if "columns" in doc:
+                columns = doc["columns"]
+            if "data" in doc:
+                rows.extend(doc["data"])
+            err = doc.get("error")
+            if err:
+                raise ClientError(err.get("message", "query failed"))
+            nxt = doc.get("nextUri")
+            if not nxt:
+                break
+            with urllib.request.urlopen(self.server + nxt) as resp:
+                doc = json.load(resp)
+        return columns, rows

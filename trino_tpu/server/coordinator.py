@@ -1,0 +1,235 @@
+"""Coordinator HTTP server: statement protocol + introspection endpoints.
+
+Reference parity:
+  - POST /v1/statement + GET /v1/statement/executing/{id}/{slug}/{token}
+    (dispatcher/QueuedStatementResource.java:158,
+     server/protocol/ExecutingStatementResource.java:154)
+  - DELETE cancel (:283), /v1/info, /v1/status, /v1/query list
+    (ServerInfoResource, StatusResource, QueryResource)
+  - query lifecycle states mirror QueryState.java:21
+    (QUEUED -> PLANNING -> RUNNING -> FINISHED/FAILED)
+  - dispatch/queue/track roles of DispatchManager.java:67 + QueryTracker
+
+Implementation: stdlib ThreadingHTTPServer; queries execute on a worker
+thread pool; results paged to the client in fixed-size chunks via nextUri
+tokens (the long-poll pull loop of StatementClientV1.advance()).
+"""
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import time
+import traceback
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ..page import Page
+from ..session import Session
+from . import protocol
+
+PAGE_ROWS = 4096
+
+
+class QueryExecution:
+    """One tracked query (QueryStateMachine + QueryTracker entry)."""
+
+    def __init__(self, query_id: str, sql: str):
+        self.query_id = query_id
+        self.slug = secrets.token_hex(8)
+        self.sql = sql
+        self.state = "QUEUED"
+        self.error: Optional[str] = None
+        self.page: Optional[Page] = None
+        self.types = None
+        self.created = time.time()
+        self.finished: Optional[float] = None
+        self.lock = threading.Lock()
+
+    def uri(self, token: int) -> str:
+        return f"/v1/statement/executing/{self.query_id}/{self.slug}/{token}"
+
+
+class Coordinator:
+    def __init__(self, session: Session, workers: int = 4):
+        self.session = session
+        self.queries: Dict[str, QueryExecution] = {}
+        self.pool = ThreadPoolExecutor(max_workers=workers)
+        self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
+        self.started = time.time()
+
+    # -- lifecycle ------------------------------------------------------
+    def submit(self, sql: str) -> QueryExecution:
+        q = QueryExecution(f"q_{uuid.uuid4().hex[:16]}", sql)
+        self.queries[q.query_id] = q
+        self.pool.submit(self._run, q)
+        return q
+
+    def _run(self, q: QueryExecution):
+        with q.lock:
+            if q.state == "FAILED":  # cancelled while queued
+                return
+            q.state = "PLANNING"
+        try:
+            page = self.session.execute(q.sql)
+            with q.lock:
+                q.page = page
+                q.types = [c.type for c in page.columns]
+                q.state = "FINISHED"
+                q.finished = time.time()
+        except Exception as e:  # surfaced via the protocol error field
+            with q.lock:
+                q.error = f"{type(e).__name__}: {e}"
+                q.state = "FAILED"
+                q.finished = time.time()
+
+    def cancel(self, query_id: str):
+        q = self.queries.get(query_id)
+        if q:
+            with q.lock:
+                if q.state not in ("FINISHED", "FAILED"):
+                    q.state = "FAILED"
+                    q.error = "Query was canceled"
+
+    # -- protocol documents ---------------------------------------------
+    def results_doc(self, q: QueryExecution, token: int) -> dict:
+        with q.lock:
+            state = q.state
+            if state in ("QUEUED", "PLANNING", "RUNNING"):
+                return protocol.query_results(
+                    q.query_id, state, next_uri=q.uri(token)
+                )
+            if state == "FAILED":
+                return protocol.query_results(
+                    q.query_id, "FAILED", error=q.error
+                )
+            # FINISHED: page out rows in chunks
+            page = q.page
+            start = token * PAGE_ROWS
+            end = min(start + PAGE_ROWS, page.count)
+            chunk = Page(
+                [c.__class__(c.type, c.values[start:end],
+                             None if c.validity is None else c.validity[start:end],
+                             c.dictionary)
+                 for c in page.columns],
+                end - start,
+                page.names,
+            )
+            next_uri = q.uri(token + 1) if end < page.count else None
+            return protocol.query_results(
+                q.query_id, "FINISHED", chunk, q.types, next_uri,
+                stats={
+                    "elapsedTimeMillis": int(
+                        ((q.finished or time.time()) - q.created) * 1000
+                    ),
+                    "processedRows": page.count,
+                },
+            )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    coordinator: Coordinator = None  # set by serve()
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _json(self, code: int, doc: dict):
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        if self.path == "/v1/statement":
+            n = int(self.headers.get("Content-Length", 0))
+            sql = self.rfile.read(n).decode()
+            q = self.coordinator.submit(sql)
+            self._json(200, self.coordinator.results_doc(q, 0))
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_GET(self):
+        parts = self.path.strip("/").split("/")
+        co = self.coordinator
+        if self.path == "/v1/info":
+            self._json(200, {
+                "nodeId": co.node_id,
+                "nodeVersion": {"version": "trino-tpu 0.1"},
+                "environment": "tpu",
+                "coordinator": True,
+                "uptime": f"{time.time() - co.started:.0f}s",
+            })
+            return
+        if self.path == "/v1/status":
+            self._json(200, {
+                "nodeId": co.node_id,
+                "activeQueries": sum(
+                    1 for q in co.queries.values()
+                    if q.state in ("QUEUED", "PLANNING", "RUNNING")
+                ),
+                "totalQueries": len(co.queries),
+            })
+            return
+        if self.path == "/v1/query":
+            self._json(200, [
+                {
+                    "queryId": q.query_id,
+                    "state": q.state,
+                    "query": q.sql[:200],
+                    "error": q.error,
+                }
+                for q in co.queries.values()
+            ])
+            return
+        if (
+            len(parts) == 6
+            and parts[:3] == ["v1", "statement", "executing"]
+        ):
+            _, _, _, qid, slug, token = parts
+            q = co.queries.get(qid)
+            if q is None or q.slug != slug:
+                self._json(404, {"error": "query not found"})
+                return
+            # long-poll: wait briefly for progress (StatementClient advance)
+            deadline = time.time() + 1.0
+            while time.time() < deadline and q.state in (
+                "QUEUED", "PLANNING", "RUNNING",
+            ):
+                time.sleep(0.02)
+            self._json(200, co.results_doc(q, int(token)))
+            return
+        self._json(404, {"error": "not found"})
+
+    def do_DELETE(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
+            self.coordinator.cancel(parts[3])
+            self._json(204, {})
+        else:
+            self._json(404, {"error": "not found"})
+
+
+class CoordinatorServer:
+    """In-process server handle (TestingTrinoServer analog)."""
+
+    def __init__(self, session: Session, port: int = 0):
+        self.coordinator = Coordinator(session)
+        handler = type("Handler", (_Handler,), {"coordinator": self.coordinator})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> "CoordinatorServer":
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"

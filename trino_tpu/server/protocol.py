@@ -1,0 +1,70 @@
+"""Client protocol types: query results JSON model.
+
+Reference parity: client/trino-client (QueryResults/QueryData JSON model,
+StatementClientV1.java:69) and server/protocol/
+(QueuedStatementResource.java:105, ExecutingStatementResource.java:71).
+
+The wire format is a compatible subset of the reference's /v1/statement
+protocol: POST returns a QueryResults document; paging via nextUri; column
+type names use the reference's spelling (bigint, decimal(p,s), varchar,
+date, double).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import types as T
+from ..page import Page
+
+
+def type_name(t: T.Type) -> str:
+    return str(t)
+
+
+def columns_json(page: Page, types: List[T.Type]) -> list:
+    return [
+        {
+            "name": name,
+            "type": type_name(t),
+            "typeSignature": {"rawType": t.name, "arguments": []},
+        }
+        for name, t in zip(page.names, types)
+    ]
+
+
+def data_json(page: Page) -> list:
+    return [list(row) for row in page.to_pylist()]
+
+
+def query_results(
+    query_id: str,
+    state: str,
+    page: Optional[Page] = None,
+    types: Optional[List[T.Type]] = None,
+    next_uri: Optional[str] = None,
+    error: Optional[str] = None,
+    stats: Optional[dict] = None,
+) -> dict:
+    doc = {
+        "id": query_id,
+        "infoUri": f"/ui/query/{query_id}",
+        "stats": {
+            "state": state,
+            "queued": state == "QUEUED",
+            "scheduled": state not in ("QUEUED",),
+            **(stats or {}),
+        },
+    }
+    if next_uri:
+        doc["nextUri"] = next_uri
+    if page is not None:
+        doc["columns"] = columns_json(page, types)
+        doc["data"] = data_json(page)
+    if error:
+        doc["error"] = {
+            "message": error,
+            "errorCode": 1,
+            "errorName": "GENERIC_INTERNAL_ERROR",
+            "errorType": "INTERNAL_ERROR",
+        }
+    return doc
