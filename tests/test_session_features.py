@@ -1,0 +1,108 @@
+"""Session machinery: properties, events, tracing, memory, connectors,
+utility statements."""
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.session import Session, tpch_session
+from trino_tpu.utils.events import EventListener
+from trino_tpu.utils.memory import ExceededMemoryLimitError, MemoryContext, MemoryPool
+
+
+def test_set_show_session():
+    s = tpch_session(0.001)
+    s.execute("set session group_capacity = 8192")
+    assert s.properties.get("group_capacity") == 8192
+    rows = s.execute("show session").to_pylist()
+    assert any(r[0] == "group_capacity" and r[1] == "8192" for r in rows)
+
+
+def test_unknown_session_property():
+    s = tpch_session(0.001)
+    with pytest.raises(KeyError):
+        s.execute("set session nonsense = 1")
+
+
+def test_show_tables_and_columns():
+    s = tpch_session(0.001)
+    tables = [r[0] for r in s.execute("show tables").to_pylist()]
+    assert "lineitem" in tables and "orders" in tables
+    cols = s.execute("show columns from lineitem").to_pylist()
+    assert ("l_orderkey", "bigint") in cols
+    assert ("l_extendedprice", "decimal(12,2)") in cols
+
+
+def test_event_listener_receives_lifecycle():
+    s = tpch_session(0.001)
+    events = []
+
+    class L(EventListener):
+        def query_created(self, ev):
+            events.append(("created", ev.query_id))
+
+        def query_completed(self, ev):
+            events.append(("completed", ev.state, ev.output_rows))
+
+    s.events.add(L())
+    s.execute("select count(*) from nation")
+    assert events[0][0] == "created"
+    assert events[1][:2] == ("completed", "FINISHED")
+    assert events[1][2] == 1
+    with pytest.raises(Exception):
+        s.execute("select bogus from nation")
+    assert events[-1][1] == "FAILED"
+
+
+def test_tracing_spans():
+    s = tpch_session(0.001)
+    s.tracer.clear()
+    s.execute("select count(*) from region")
+    names = [sp.name for sp in s.tracer.spans]
+    assert {"parse", "analyze+plan", "optimize", "execute", "query"} <= set(names)
+    q = [sp for sp in s.tracer.spans if sp.name == "query"][0]
+    children = [sp for sp in s.tracer.spans if sp.parent_id == q.span_id]
+    assert len(children) >= 2
+
+
+def test_memory_pool_accounting():
+    pool = MemoryPool(1000)
+    root = MemoryContext("query", pool=pool, query_id="q1")
+    op = root.new_child("op")
+    op.set_bytes(400)
+    assert pool.reserved == 400
+    with pytest.raises(ExceededMemoryLimitError):
+        op2 = root.new_child("op2")
+        op2.set_bytes(700)
+    root.close()
+    assert pool.reserved == 0
+
+
+def test_memory_connector():
+    s = Session()
+    s.create_catalog("mem", "memory", {})
+    conn = s.catalogs.get("mem")
+    conn.create_table(
+        "people",
+        [("name", T.VARCHAR), ("age", T.BIGINT)],
+        {"name": ["ada", "bob", None], "age": [30, 25, 99]},
+    )
+    rows = s.execute("select name, age from people where age > 26 order by age").to_pylist()
+    assert rows == [("ada", 30), (None, 99)]
+
+
+def test_blackhole_connector():
+    s = Session()
+    s.create_catalog("bh", "blackhole", {"blackhole.rows-per-table": 5000})
+    r = s.execute("select count(*), sum(n) from numbers").to_pylist()
+    assert r == [(5000, 5000 * 4999 // 2)]
+
+
+def test_distributed_session_property():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device mesh")
+    s = tpch_session(0.001)
+    local = s.execute("select count(*) from orders").to_pylist()
+    s.execute("set session distributed = true")
+    dist = s.execute("select count(*) from orders").to_pylist()
+    assert dist == local

@@ -1,0 +1,104 @@
+"""Typed configuration + session properties.
+
+Reference parity: airlift @Config binding (369 setters; TaskManagerConfig,
+QueryManagerConfig, FeaturesConfig...) and SystemSessionProperties.java
+(151 typed session properties) — reduced to the properties this engine
+actually consults.  Unknown keys fail at startup, like airlift's strict
+config binding; session properties are validated and typed at SET time.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class PropertyMetadata:
+    name: str
+    description: str
+    parse: Callable[[str], Any]
+    default: Any
+
+
+def _bool(s: str) -> bool:
+    if str(s).lower() in ("true", "1", "yes"):
+        return True
+    if str(s).lower() in ("false", "0", "no"):
+        return False
+    raise ValueError(f"not a boolean: {s}")
+
+
+SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
+    p.name: p
+    for p in [
+        PropertyMetadata(
+            "group_capacity",
+            "initial group-by hash capacity (recompile-on-overflow)",
+            int, 4096,
+        ),
+        PropertyMetadata(
+            "join_expansion_factor",
+            "initial expansion-join output capacity as a multiple of probe rows",
+            int, 1,
+        ),
+        PropertyMetadata(
+            "query_max_memory_bytes",
+            "per-query device memory reservation limit",
+            int, 8 << 30,
+        ),
+        PropertyMetadata(
+            "distributed",
+            "execute over the full device mesh instead of one device",
+            _bool, False,
+        ),
+        PropertyMetadata(
+            "num_devices",
+            "mesh size for distributed execution (0 = all devices)",
+            int, 0,
+        ),
+        PropertyMetadata(
+            "explain_analyze_rows",
+            "collect per-operator row counts during execution",
+            _bool, False,
+        ),
+        PropertyMetadata(
+            "join_build_side",
+            "build-side selection: auto | right (disable stats swap)",
+            str, "auto",
+        ),
+        PropertyMetadata(
+            "split_count",
+            "scan splits per table (0 = one per device)",
+            int, 0,
+        ),
+    ]
+}
+
+
+class SessionProperties:
+    """Per-session typed property bag (Session.java + SET SESSION)."""
+
+    def __init__(self, overrides: Dict[str, Any] | None = None):
+        self._values: Dict[str, Any] = {}
+        for k, v in (overrides or {}).items():
+            self.set(k, v)
+
+    def set(self, name: str, value):
+        meta = SESSION_PROPERTIES.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        self._values[name] = (
+            meta.parse(value) if isinstance(value, str) else value
+        )
+
+    def get(self, name: str):
+        meta = SESSION_PROPERTIES.get(name)
+        if meta is None:
+            raise KeyError(f"unknown session property: {name}")
+        return self._values.get(name, meta.default)
+
+    def show(self) -> list:
+        return [
+            (name, str(self.get(name)), str(meta.default), meta.description)
+            for name, meta in sorted(SESSION_PROPERTIES.items())
+        ]

@@ -1,0 +1,119 @@
+"""In-memory table connector.
+
+Reference parity: plugin/trino-memory (MemoryConnector, MemoryMetadata,
+MemoryPagesStore) — tables held as host numpy columns, used by engine
+tests as a scriptable data source (the MockConnector/memory role).
+Rows are inserted through the python API (create_table) since the engine's
+DML surface is read-oriented for now.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .. import types as T
+from ..page import Column, Page, column_from_pylist
+from ..spi import (
+    ColumnSchema,
+    ColumnStatistics,
+    Connector,
+    ConnectorFactory,
+    ConnectorMetadata,
+    PageSource,
+    PageSourceProvider,
+    Split,
+    SplitManager,
+    TableSchema,
+    TableStatistics,
+)
+
+
+class _Store:
+    def __init__(self):
+        self.tables: Dict[str, Page] = {}
+        self.schemas: Dict[str, TableSchema] = {}
+
+
+class MemoryMetadata(ConnectorMetadata):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def list_tables(self) -> List[str]:
+        return list(self.store.tables)
+
+    def get_table_schema(self, table: str) -> TableSchema:
+        return self.store.schemas[table]
+
+    def get_table_statistics(self, table: str) -> TableStatistics:
+        page = self.store.tables[table]
+        return TableStatistics(float(page.count), {})
+
+
+class MemorySplitManager(SplitManager):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def get_splits(self, table: str, desired: int) -> List[Split]:
+        return [Split(table, 0, 1)]
+
+
+class MemoryPageSource(PageSource):
+    def __init__(self, store: _Store, split: Split, columns: Sequence[str]):
+        self.store = store
+        self.split = split
+        self.columns = list(columns)
+
+    def pages(self):
+        page = self.store.tables[self.split.table]
+        cols = [page.by_name(c) for c in self.columns]
+        yield Page(cols, page.count, self.columns)
+
+    def dictionaries(self) -> Dict[str, np.ndarray]:
+        page = self.store.tables[self.split.table]
+        out = {}
+        for c in self.columns:
+            col = page.by_name(c)
+            if col.dictionary is not None:
+                out[c] = col.dictionary
+        return out
+
+
+class MemoryPageSourceProvider(PageSourceProvider):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def create_page_source(self, split: Split, columns) -> MemoryPageSource:
+        return MemoryPageSource(self.store, split, columns)
+
+
+class MemoryConnector(Connector):
+    def __init__(self, name: str):
+        self.name = name
+        self.store = _Store()
+
+    def create_table(self, name: str, schema, data: dict):
+        """schema: list of (col, Type); data: col -> python values."""
+        cols = [column_from_pylist(t, data[c]) for c, t in schema]
+        counts = {len(c) for c in cols}
+        assert len(counts) == 1
+        self.store.tables[name] = Page(cols, counts.pop(), [c for c, _ in schema])
+        self.store.schemas[name] = TableSchema(
+            name, tuple(ColumnSchema(c, t) for c, t in schema)
+        )
+
+    def metadata(self):
+        return MemoryMetadata(self.store)
+
+    def split_manager(self):
+        return MemorySplitManager(self.store)
+
+    def page_source_provider(self):
+        return MemoryPageSourceProvider(self.store)
+
+
+class MemoryConnectorFactory(ConnectorFactory):
+    name = "memory"
+
+    def create(self, catalog_name: str, config: dict) -> MemoryConnector:
+        return MemoryConnector(catalog_name)

@@ -1,23 +1,34 @@
 """Session facade: SQL in, Pages out.
 
 Reference parity: the in-process query path of testing/PlanTester.java:250 /
-StandaloneQueryRunner — parse -> analyze/plan -> optimize -> execute without
-a server.  The distributed path (coordinator/worker) layers on top of the
-same pipeline (server/).
+StandaloneQueryRunner — parse -> analyze/plan -> optimize -> execute, plus
+the session machinery around it:
+  - typed session properties + SET/SHOW SESSION (SystemSessionProperties)
+  - OpenTelemetry-style spans per phase (DispatchManager querySpan)
+  - query events to registered listeners (EventListenerManager)
+  - per-query memory reservation against a shared pool (MemoryPool)
+  - utility statements: SHOW TABLES / SHOW COLUMNS / EXPLAIN
+The coordinator HTTP server (server/coordinator.py) wraps this same path.
 """
 from __future__ import annotations
 
+import uuid
 from typing import Optional
 
+from . import types as T
 from .catalog import CatalogManager, Metadata
+from .config import SessionProperties
 from .connectors.tpch import TpchConnectorFactory
 from .exec.local import LocalExecutor
-from .page import Page
+from .page import Page, column_from_pylist, page_from_pydict
 from .plan import nodes as P
 from .plan.optimizer import optimize
 from .sql import ast
 from .sql.analyzer import Analyzer
 from .sql.parser import parse
+from .utils.events import EventListenerManager
+from .utils.memory import MemoryPool, estimate_batch_bytes
+from .utils.tracing import TRACER
 
 
 class Session:
@@ -28,15 +39,39 @@ class Session:
     ):
         self.catalogs = CatalogManager()
         self.catalogs.register_factory(TpchConnectorFactory())
+        try:
+            from .connectors.memory import MemoryConnectorFactory
+            from .connectors.blackhole import BlackholeConnectorFactory
+
+            self.catalogs.register_factory(MemoryConnectorFactory())
+            self.catalogs.register_factory(BlackholeConnectorFactory())
+        except ImportError:
+            pass
         self.default_catalog = catalog
-        self.config = dict(config or {})
+        self.properties = SessionProperties(config)
         self.metadata = Metadata(self.catalogs)
-        self.executor = LocalExecutor(self.catalogs, self.config)
+        self.events = EventListenerManager()
+        self.memory_pool = MemoryPool(
+            self.properties.get("query_max_memory_bytes")
+        )
+        self.tracer = TRACER
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
         if self.default_catalog is None:
             self.default_catalog = name
+
+    # ------------------------------------------------------------------
+    def _executor(self):
+        exec_config = {
+            "group_capacity": self.properties.get("group_capacity"),
+        }
+        if self.properties.get("distributed"):
+            from .parallel.mesh_executor import MeshExecutor, default_mesh
+
+            n = self.properties.get("num_devices") or None
+            return MeshExecutor(self.catalogs, default_mesh(n), exec_config)
+        return LocalExecutor(self.catalogs, exec_config)
 
     # ------------------------------------------------------------------
     def plan(self, sql: str, optimized: bool = True) -> P.PlanNode:
@@ -52,23 +87,78 @@ class Session:
     def explain(self, sql: str) -> str:
         return P.plan_to_string(self.plan(sql))
 
+    # ------------------------------------------------------------------
     def execute(self, sql: str) -> Page:
-        stmt = parse(sql)
-        if isinstance(stmt, ast.Explain):
-            from .page import column_from_pylist
-            from . import types as T
+        query_id = f"q_{uuid.uuid4().hex[:12]}"
+        created = self.events.query_created(query_id, sql)
+        try:
+            with self.tracer.span("query", query_id=query_id):
+                with self.tracer.span("parse"):
+                    stmt = parse(sql)
+                page = self._execute_statement(stmt, sql, query_id)
+            self.events.query_completed(
+                query_id, sql, "FINISHED", created, page.count
+            )
+            return page
+        except Exception as e:
+            self.events.query_completed(
+                query_id, sql, "FAILED", created, error=str(e)
+            )
+            raise
 
-            text = self.explain(sql[sql.lower().index("explain") + 7 :])
+    def _execute_statement(self, stmt, sql: str, query_id: str) -> Page:
+        if isinstance(stmt, ast.SetSession):
+            self.properties.set(stmt.name, stmt.value)
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.ShowSession):
+            rows = self.properties.show()
+            return page_from_pydict(
+                [("name", T.VARCHAR), ("value", T.VARCHAR),
+                 ("default", T.VARCHAR), ("description", T.VARCHAR)],
+                {
+                    "name": [r[0] for r in rows],
+                    "value": [r[1] for r in rows],
+                    "default": [r[2] for r in rows],
+                    "description": [r[3] for r in rows],
+                },
+            )
+        if isinstance(stmt, ast.ShowTables):
+            conn = self.catalogs.get(self.default_catalog)
+            tables = sorted(conn.metadata().list_tables())
+            return page_from_pydict([("table", T.VARCHAR)], {"table": tables})
+        if isinstance(stmt, ast.ShowColumns):
+            _, schema = self.metadata.resolve_table(
+                stmt.table, self.default_catalog
+            )
+            return page_from_pydict(
+                [("column", T.VARCHAR), ("type", T.VARCHAR)],
+                {
+                    "column": [c.name for c in schema.columns],
+                    "type": [str(c.type) for c in schema.columns],
+                },
+            )
+        if isinstance(stmt, ast.Explain):
+            text = P.plan_to_string(self._plan_stmt(stmt.query))
             col = column_from_pylist(T.VARCHAR, text.split("\n"))
             return Page([col], len(text.split("\n")), ["Query Plan"])
-        analyzer = Analyzer(self.metadata, self.default_catalog)
-        plan = analyzer.plan_statement(stmt)
-        plan = optimize(plan, self.metadata)
-        return self.executor.execute(plan)
+
+        plan = self._plan_stmt(stmt)
+        executor = self._executor()
+        with self.tracer.span("execute", query_id=query_id):
+            page = executor.execute(plan)
+        return page
+
+    def _plan_stmt(self, stmt) -> P.PlanNode:
+        with self.tracer.span("analyze+plan"):
+            analyzer = Analyzer(self.metadata, self.default_catalog)
+            plan = analyzer.plan_statement(stmt)
+        with self.tracer.span("optimize"):
+            plan = optimize(plan, self.metadata)
+        return plan
 
 
 def tpch_session(sf: float = 0.01, **config) -> Session:
     """One-liner dev entry (TpchQueryRunner analog, SURVEY appendix A)."""
-    s = Session()
+    s = Session(config=config)
     s.create_catalog("tpch", "tpch", {"tpch.scale-factor": sf})
     return s

@@ -253,3 +253,14 @@ class ShowTables(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowColumns(Node):
     table: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class SetSession(Node):
+    name: str = ""
+    value: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowSession(Node):
+    pass

@@ -38,6 +38,7 @@ KEYWORDS = {
     "timestamp", "interval", "year", "month", "day", "hour", "minute",
     "second", "quarter", "explain", "analyze", "show", "tables", "columns",
     "substring", "for", "fetch", "offset", "rows", "row", "only", "values",
+    "set", "session",
 }
 
 
@@ -147,7 +148,23 @@ class Parser:
                 name = self.qualified_name()
                 self._finish()
                 return ast.ShowColumns(name)
-            raise ParseError("SHOW TABLES | SHOW COLUMNS FROM t")
+            if self.accept_kw("session"):
+                self._finish()
+                return ast.ShowSession()
+            raise ParseError("SHOW TABLES | SHOW COLUMNS FROM t | SHOW SESSION")
+        if self.accept_kw("set"):
+            self.expect_kw("session")
+            name = self.ident()
+            self.expect_op("=")
+            t = self.next()
+            if t.kind == "string":
+                value = t.text[1:-1].replace("''", "'")
+            elif t.kind in ("number", "ident", "kw"):
+                value = t.text
+            else:
+                raise ParseError(f"bad SET SESSION value {t!r}")
+            self._finish()
+            return ast.SetSession(name, value)
         q = self.parse_query()
         self._finish()
         return q

@@ -1,0 +1,69 @@
+"""Query event listener SPI.
+
+Reference parity: spi/eventlistener/ (EventListener, QueryCreatedEvent,
+QueryCompletedEvent, SplitCompletedEvent) dispatched by
+eventlistener/EventListenerManager + event/QueryMonitor.  Listeners are
+plugged into the session/coordinator; payloads carry the reference's core
+fields (query id, sql, state, timing, row counts, error).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCreatedEvent:
+    query_id: str
+    sql: str
+    create_time: float
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCompletedEvent:
+    query_id: str
+    sql: str
+    state: str  # FINISHED | FAILED
+    create_time: float
+    end_time: float
+    output_rows: int = 0
+    error: Optional[str] = None
+
+    @property
+    def wall_ms(self) -> float:
+        return (self.end_time - self.create_time) * 1000
+
+
+class EventListener:
+    """SPI: subclass and register (spi/eventlistener/EventListener)."""
+
+    def query_created(self, event: QueryCreatedEvent):
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent):
+        pass
+
+
+class EventListenerManager:
+    def __init__(self):
+        self.listeners: List[EventListener] = []
+
+    def add(self, listener: EventListener):
+        self.listeners.append(listener)
+
+    def query_created(self, query_id: str, sql: str) -> float:
+        t = time.time()
+        ev = QueryCreatedEvent(query_id, sql, t)
+        for l in self.listeners:
+            l.query_created(ev)
+        return t
+
+    def query_completed(self, query_id: str, sql: str, state: str,
+                        create_time: float, output_rows: int = 0,
+                        error: Optional[str] = None):
+        ev = QueryCompletedEvent(
+            query_id, sql, state, create_time, time.time(), output_rows, error
+        )
+        for l in self.listeners:
+            l.query_completed(ev)

@@ -1,0 +1,77 @@
+"""Tracing: span recording through engine layers.
+
+Reference parity: OpenTelemetry integration (tracing/TracingMetadata.java,
+TrinoAttributes span-attribute schema, query/task spans created in
+DispatchManager.java:155 and SqlTaskManager).  This is an OTel-compatible
+span model (name, trace/span ids, parent, start/end, attributes) with an
+in-memory recorder; an exporter can forward to a real OTel endpoint.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start: float
+    end: Optional[float] = None
+    attributes: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def duration_ms(self) -> float:
+        return ((self.end or time.time()) - self.start) * 1000
+
+
+class Tracer:
+    """Per-process tracer with thread-local span stacks."""
+
+    def __init__(self):
+        self.spans: List[Span] = []
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        s = Span(
+            name=name,
+            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            span_id=uuid.uuid4().hex[:16],
+            parent_id=parent.span_id if parent else None,
+            start=time.time(),
+            attributes=dict(attributes),
+        )
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.time()
+            stack.pop()
+            with self._lock:
+                self.spans.append(s)
+
+    def for_trace(self, trace_id: str) -> List[Span]:
+        with self._lock:
+            return [s for s in self.spans if s.trace_id == trace_id]
+
+    def clear(self):
+        with self._lock:
+            self.spans.clear()
+
+
+TRACER = Tracer()
