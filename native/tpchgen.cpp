@@ -1,0 +1,109 @@
+// Native TPC-H lineitem generator — the host data plane's hot loop.
+//
+// Reference parity: the reference's scan feed path is Java
+// (plugin/trino-tpch TpchRecordSetProvider streaming io.trino.tpch dbgen
+// rows); this engine's equivalent host-side feed is counter-based
+// (splitmix64) column generation.  The numpy implementation
+// (trino_tpu/connectors/tpch.py) makes several vectorized passes with
+// temporaries; this fused single-pass C++ version generates all fixed-width
+// lineitem columns at memory bandwidth and is the model for further native
+// runtime pieces (page serde, exchange buffers).
+//
+// Semantics MUST match trino_tpu/connectors/tpch.py exactly — tests compare
+// both paths element-wise (tests/test_native_gen.py).
+
+#include <cstdint>
+
+namespace {
+
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ULL;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
+
+inline uint64_t h64(uint64_t base, uint64_t idx) { return mix64(idx ^ base); }
+
+inline int64_t uint_in(uint64_t base, uint64_t idx, int64_t lo, int64_t hi) {
+  return lo + static_cast<int64_t>(h64(base, idx) % (uint64_t)(hi - lo + 1));
+}
+
+constexpr int64_t EPOCH_1992 = 8035;
+constexpr int64_t ORDER_DATE_SPAN = 2406 - 151;
+constexpr int64_t CURRENT_DATE = 9298;
+
+}  // namespace
+
+extern "C" {
+
+// bases: column hash bases in fixed order:
+//  0 l_count        1 o_orderdate    2 l_shipdate    3 l_partkey
+//  4 l_supp_slot    5 l_quantity     6 l_discount    7 l_tax
+//  8 l_commitdate   9 l_receiptdate 10 l_returnflag 11 l_shipinstruct
+// 12 l_shipmode    13 l_comment     14 o_custkey (unused here)
+//
+// Output arrays must have capacity >= 7 * (hi_order - lo_order).
+// Returns the number of rows generated.
+int64_t gen_lineitem(
+    int64_t lo_order, int64_t hi_order, int64_t npart, int64_t nsupp,
+    int64_t ncomments, const uint64_t* bases,
+    int64_t* orderkey, int64_t* partkey, int64_t* suppkey,
+    int64_t* linenumber, int64_t* quantity, int64_t* extendedprice,
+    int64_t* discount, int64_t* tax, int32_t* shipdate, int32_t* commitdate,
+    int32_t* receiptdate, int32_t* returnflag, int32_t* linestatus,
+    int32_t* shipinstruct, int32_t* shipmode, int32_t* comment) {
+  int64_t row = 0;
+  for (int64_t j = lo_order; j < hi_order; ++j) {
+    const uint64_t uj = (uint64_t)j;
+    const int64_t lines = 1 + (int64_t)(h64(bases[0], uj) % 7ULL);
+    const int64_t odate = EPOCH_1992 + (int64_t)(h64(bases[1], uj) %
+                                                 (uint64_t)ORDER_DATE_SPAN);
+    const int64_t okey = (j / 8) * 32 + (j % 8) + 1;
+    for (int64_t ln = 0; ln < lines; ++ln, ++row) {
+      const uint64_t lid = uj * 8ULL + (uint64_t)ln;
+      const int64_t pk = 1 + (int64_t)(h64(bases[3], lid) % (uint64_t)npart);
+      const int64_t qty = uint_in(bases[5], lid, 1, 50);
+      const int64_t price_cents =
+          90000 + (pk / 10) % 20001 + 100 * (pk % 1000);
+      const int64_t ship =
+          odate + 1 + (int64_t)(h64(bases[2], lid) % 121ULL);
+      const int64_t receipt = ship + uint_in(bases[9], lid, 1, 30);
+      const int64_t slot = (int64_t)(h64(bases[4], lid) % 4ULL);
+      orderkey[row] = okey;
+      partkey[row] = pk;
+      suppkey[row] = (pk + slot * (nsupp / 4 + (pk - 1) / nsupp)) % nsupp + 1;
+      linenumber[row] = ln + 1;
+      quantity[row] = qty * 100;
+      extendedprice[row] = qty * price_cents;
+      discount[row] = uint_in(bases[6], lid, 0, 10);
+      tax[row] = uint_in(bases[7], lid, 0, 8);
+      shipdate[row] = (int32_t)ship;
+      commitdate[row] = (int32_t)(odate + uint_in(bases[8], lid, 30, 90));
+      receiptdate[row] = (int32_t)receipt;
+      returnflag[row] = receipt <= CURRENT_DATE
+                            ? (int32_t)((h64(bases[10], lid) % 2ULL) * 2)
+                            : 1;
+      linestatus[row] = ship > CURRENT_DATE ? 1 : 0;
+      shipinstruct[row] = (int32_t)(h64(bases[11], lid) % 4ULL);
+      shipmode[row] = (int32_t)(h64(bases[12], lid) % 7ULL);
+      comment[row] = (int32_t)(h64(bases[13], lid) % (uint64_t)ncomments);
+    }
+  }
+  return row;
+}
+
+// Generic column fillers reused by other tables --------------------------
+
+void fill_h64_mod(uint64_t base, int64_t lo, int64_t hi, int64_t mod,
+                  int32_t* out) {
+  for (int64_t i = lo; i < hi; ++i)
+    out[i - lo] = (int32_t)(h64(base, (uint64_t)i) % (uint64_t)mod);
+}
+
+void fill_uint_in(uint64_t base, int64_t lo, int64_t hi, int64_t a, int64_t b,
+                  int64_t* out) {
+  for (int64_t i = lo; i < hi; ++i) out[i - lo] = uint_in(base, (uint64_t)i, a, b);
+}
+
+}  // extern "C"

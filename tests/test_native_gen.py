@@ -1,0 +1,41 @@
+"""Native (C++) generator must be bit-identical to the numpy path."""
+import numpy as np
+import pytest
+
+from trino_tpu.connectors import native_gen, tpch
+
+
+@pytest.fixture(scope="module")
+def lib_available():
+    if not native_gen.available():
+        pytest.skip("native toolchain unavailable")
+
+
+def test_native_matches_numpy(lib_available):
+    g = tpch._Gen(0.001)
+    idx = np.arange(0, 1500, dtype=np.int64)
+    cols = native_gen.LINEITEM_COLS
+    numpy_raw, count = g.lineitem_for_orders(idx, cols)
+    native = native_gen.gen_lineitem(
+        0, 1500, g.n["part"], g.n["supplier"], len(tpch.COMMENTS)
+    )
+    assert len(native["l_orderkey"]) == count
+    for c in cols:
+        assert np.array_equal(
+            np.asarray(numpy_raw[c], dtype=native[c].dtype), native[c]
+        ), c
+
+
+def test_native_split_independence(lib_available):
+    whole = native_gen.gen_lineitem(0, 1000, 200, 10, len(tpch.COMMENTS))
+    a = native_gen.gen_lineitem(0, 500, 200, 10, len(tpch.COMMENTS))
+    b = native_gen.gen_lineitem(500, 1000, 200, 10, len(tpch.COMMENTS))
+    cat = np.concatenate([a["l_orderkey"], b["l_orderkey"]])
+    assert np.array_equal(cat, whole["l_orderkey"])
+
+
+def test_generate_uses_native(lib_available):
+    vals, dicts, n = tpch.generate("lineitem", 0.001)
+    # invariants still hold through the native path
+    assert ((vals["l_orderkey"] - 1) % 32 < 8).all()
+    assert (vals["l_receiptdate"] > vals["l_shipdate"]).all()

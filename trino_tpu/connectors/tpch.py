@@ -559,7 +559,17 @@ def generate(
     hi = (n * (split + 1)) // num_splits
     idx = np.arange(lo, hi, dtype=np.int64)
     if table == "lineitem":
-        raw, count = g.lineitem_for_orders(idx, cols)
+        # native (C++) fused generator when available; numpy fallback
+        from . import native_gen
+
+        native = native_gen.gen_lineitem(
+            lo, hi, g.n["part"], g.n["supplier"], len(COMMENTS)
+        )
+        if native is not None:
+            raw = {c: native[c] for c in cols}
+            count = len(native["l_orderkey"])
+        else:
+            raw, count = g.lineitem_for_orders(idx, cols)
     else:
         raw = getattr(g, table)(idx, cols)
         count = hi - lo
