@@ -1,0 +1,101 @@
+"""TPC-DS connector + benchmark queries Q3/Q7 vs the sqlite oracle."""
+import sqlite3
+
+import pytest
+
+from oracle import assert_rows_match
+from trino_tpu.connectors import tpcds
+from trino_tpu.page import Column, Page
+from trino_tpu.session import tpcds_session
+
+SF = 0.003
+
+Q3 = """
+select dt.d_year, item.i_brand_id brand_id, item.i_brand brand,
+       sum(ss_ext_sales_price) sum_agg
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manufact_id = 128 and dt.d_moy = 11
+group by dt.d_year, item.i_brand_id, item.i_brand
+order by dt.d_year, sum_agg desc, brand_id
+limit 100
+"""
+
+Q7 = """
+select i_item_id, avg(ss_quantity) agg1, avg(ss_list_price) agg2,
+       avg(ss_coupon_amt) agg3, avg(ss_sales_price) agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+"""
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpcds_session(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    for table in (
+        "date_dim", "item", "store_sales", "customer_demographics", "promotion"
+    ):
+        schema = tpcds.SCHEMAS[table]
+        conn.execute(
+            f"CREATE TABLE {table} ({', '.join(c for c, _ in schema)})"
+        )
+        values, validity, dicts, count = tpcds.generate(table, SF)
+        page = Page(
+            [
+                Column(t, values[c], validity.get(c), dicts.get(c))
+                for c, t in schema
+            ],
+            count,
+            [c for c, _ in schema],
+        )
+        ph = ", ".join(["?"] * len(schema))
+        conn.executemany(
+            f"INSERT INTO {table} VALUES ({ph})", page.to_pylist()
+        )
+    conn.commit()
+    return conn
+
+
+def test_generator_basics():
+    values, validity, dicts, n = tpcds.generate("date_dim", SF)
+    assert n == tpcds.DATE_DIM_ROWS
+    assert values["d_year"].min() == 1900
+    values, validity, dicts, n = tpcds.generate("store_sales", SF)
+    assert "ss_sold_date_sk" in validity  # nullable FK
+    assert 0 < (~validity["ss_sold_date_sk"]).sum() < n * 0.1
+
+
+def test_nullable_fk_join_drops_nulls(session, oracle_conn):
+    sql = (
+        "select count(*) from store_sales, date_dim "
+        "where ss_sold_date_sk = d_date_sk"
+    )
+    actual = session.execute(sql).to_pylist()
+    expected = oracle_conn.execute(sql).fetchall()
+    assert actual == [tuple(expected[0])]
+
+
+def test_tpcds_q3(session, oracle_conn):
+    actual = session.execute(Q3).to_pylist()
+    expected = oracle_conn.execute(Q3).fetchall()
+    assert_rows_match(actual, expected, tol=2e-2)
+
+
+def test_tpcds_q7(session, oracle_conn):
+    actual = session.execute(Q7).to_pylist()
+    expected = oracle_conn.execute(Q7).fetchall()
+    assert_rows_match(actual, expected, tol=2e-2)

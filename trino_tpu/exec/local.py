@@ -110,12 +110,18 @@ class LocalExecutor:
             splits = conn.split_manager().get_splits(node.table, 1)
             provider = conn.page_source_provider()
             values: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+            valids: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
             total = 0
             for sp in splits:
                 src = provider.create_page_source(sp, cols)
                 for page in src.pages():
                     for c, col in zip(page.names, page.columns):
                         values[c].append(np.asarray(col.values)[: page.count])
+                        valids[c].append(
+                            np.ones(page.count, dtype=bool)
+                            if col.validity is None
+                            else np.asarray(col.validity)[: page.count]
+                        )
                     total += page.count
                 for c, d in src.dictionaries().items():
                     dicts_key = self._sym_for(node, c)
@@ -125,12 +131,12 @@ class LocalExecutor:
                             f"split dictionaries diverge for {c}"
                         )
                     dicts[dicts_key] = d
-            merged = {
-                self._sym_for(node, c): (
-                    np.concatenate(v) if len(v) != 1 else v[0]
-                )
-                for c, v in values.items()
-            }
+            merged = {}
+            for c, v in values.items():
+                sym = self._sym_for(node, c)
+                vals = np.concatenate(v) if len(v) != 1 else v[0]
+                ok = np.concatenate(valids[c]) if len(v) != 1 else valids[c][0]
+                merged[sym] = (vals, None if ok.all() else ok)
             scans[id(node)] = merged
             counts[id(node)] = total
             return
@@ -195,12 +201,18 @@ class _TraceCtx:
         count = self.counts[id(node)]
         cap = _pad_capacity(count)
         lanes = {}
-        for sym, arr in arrays.items():
+        for sym, (arr, valid) in arrays.items():
             if arr.shape[0] < cap:
                 pad = np.zeros(cap - arr.shape[0], dtype=arr.dtype)
                 arr = np.concatenate([arr, pad])
             v = jnp.asarray(arr)
-            lanes[sym] = (v, jnp.ones(cap, dtype=bool))
+            if valid is None:
+                ok = jnp.ones(cap, dtype=bool)
+            else:
+                vv = np.zeros(cap, dtype=bool)
+                vv[: valid.shape[0]] = valid
+                ok = jnp.asarray(vv)
+            lanes[sym] = (v, ok)
         sel = jnp.arange(cap) < count
         return Batch(lanes, sel)
 

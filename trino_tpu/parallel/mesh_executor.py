@@ -168,11 +168,17 @@ class MeshExecutor(LocalExecutor):
                     sp = Split(node.table, d, ndev)
                     src = provider.create_page_source(sp, cols)
                     vals: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+                    oks: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
                     total = 0
                     for page in src.pages():
                         for c, col in zip(page.names, page.columns):
                             vals[c].append(
                                 np.asarray(col.values)[: page.count]
+                            )
+                            oks[c].append(
+                                np.ones(page.count, dtype=bool)
+                                if col.validity is None
+                                else np.asarray(col.validity)[: page.count]
                             )
                         total += page.count
                     for c, dct in src.dictionaries().items():
@@ -188,17 +194,23 @@ class MeshExecutor(LocalExecutor):
                             )
                         dicts[sym] = dct
                     per_dev.append(
-                        {c: np.concatenate(v) for c, v in vals.items()}
+                        {c: (np.concatenate(v), np.concatenate(oks[c]))
+                         for c, v in vals.items()}
                     )
                     dev_counts.append(total)
                 cap = _pad_capacity(max(max(dev_counts), 1))
                 merged: Dict[str, np.ndarray] = {}
                 for c in cols:
                     sym = self._sym_for(node, c)
-                    stacked = np.zeros((ndev, cap), dtype=per_dev[0][c].dtype)
+                    stacked = np.zeros(
+                        (ndev, cap), dtype=per_dev[0][c][0].dtype
+                    )
+                    okstack = np.zeros((ndev, cap), dtype=bool)
                     for d in range(ndev):
-                        stacked[d, : dev_counts[d]] = per_dev[d][c]
+                        stacked[d, : dev_counts[d]] = per_dev[d][c][0]
+                        okstack[d, : dev_counts[d]] = per_dev[d][c][1]
                     merged[sym] = stacked
+                    merged[sym + "$ok"] = okstack
                 scans[str(id(node))] = merged
                 counts[str(id(node))] = np.array(dev_counts, dtype=np.int64)
                 return
@@ -229,9 +241,12 @@ class _MeshTraceCtx(_TraceCtx):
         lanes = {}
         cap = None
         for sym, arr in arrays.items():
+            if sym.endswith("$ok"):
+                continue
             v = arr[0]  # local shard [1, cap] -> [cap]
             cap = v.shape[0]
-            lanes[sym] = (v, jnp.ones(cap, dtype=bool))
+            ok = arrays[sym + "$ok"][0]
+            lanes[sym] = (v, ok)
         sel = jnp.arange(cap) < count
         return Batch(lanes, sel, replicated=False)
 

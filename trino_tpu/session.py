@@ -39,6 +39,9 @@ class Session:
     ):
         self.catalogs = CatalogManager()
         self.catalogs.register_factory(TpchConnectorFactory())
+        from .connectors.tpcds import TpcdsConnectorFactory
+
+        self.catalogs.register_factory(TpcdsConnectorFactory())
         try:
             from .connectors.memory import MemoryConnectorFactory
             from .connectors.blackhole import BlackholeConnectorFactory
@@ -161,4 +164,10 @@ def tpch_session(sf: float = 0.01, **config) -> Session:
     """One-liner dev entry (TpchQueryRunner analog, SURVEY appendix A)."""
     s = Session(config=config)
     s.create_catalog("tpch", "tpch", {"tpch.scale-factor": sf})
+    return s
+
+
+def tpcds_session(sf: float = 0.01, **config) -> Session:
+    s = Session(config=config)
+    s.create_catalog("tpcds", "tpcds", {"tpcds.scale-factor": sf})
     return s

@@ -997,6 +997,7 @@ class AggCollector(ExprAnalyzer):
     def __init__(self, analyzer, relation, key_map, pre_assigns):
         super().__init__(analyzer, relation)
         self.key_map = key_map  # [(key ir expr, key symbol ref)]
+        self.pre_relation = relation  # pre-aggregation scope for resolution
         self.pre_assigns = pre_assigns
         self.aggs: List[P.AggInfo] = []
         self._agg_cache: Dict[tuple, ir.ColumnRef] = {}
@@ -1134,8 +1135,9 @@ class PostAggAnalyzer:
         for iid, expr in self._cache.items():
             if self._items.get(iid) is not None and self._items[iid].expr is e:
                 return expr
-        # order-by style expression referencing keys/aggs
-        self.collector.relation = self.relation
+        # order-by style expression referencing keys/aggs: resolve against
+        # the pre-aggregation scope so group-key expressions match
+        self.collector.relation = self.collector.pre_relation
         return self.collector.analyze_post(e)
 
 
