@@ -125,3 +125,14 @@ def test_distinct_distributed(session, mesh_exec):
         session, mesh_exec,
         "select distinct o_orderpriority from orders order by 1",
     )
+
+
+def test_window_gathering_exchange(session, mesh_exec):
+    # Window gathers to single distribution, then runs the sorted kernel
+    run_both(
+        session, mesh_exec,
+        "select o_custkey, o_orderkey, "
+        "row_number() over (partition by o_custkey order by o_orderkey) rn, "
+        "sum(o_totalprice) over (partition by o_custkey) tot "
+        "from orders order by o_custkey, o_orderkey limit 50",
+    )

@@ -1,0 +1,177 @@
+"""Window function tests vs the sqlite oracle (sqlite >= 3.25 windows).
+
+Reference parity: operator/TestWindowOperator + AbstractTestWindowQueries
+(testing/trino-testing) — same SQL on the engine and oracle over identical
+TPC-H data.
+"""
+import sqlite3
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from trino_tpu.session import tpch_session
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["nation", "customer", "orders", "lineitem"])
+    return conn
+
+
+def check(session, oracle_conn, sql, tol=1e-2):
+    actual = session.execute(sql).to_pylist()
+    expected = oracle_conn.execute(sql).fetchall()
+    assert_rows_match(actual, expected, tol=tol)
+    return actual
+
+
+def test_row_number_global(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderkey, row_number() over (order by o_orderkey) "
+        "from orders order by o_orderkey limit 50",
+    )
+
+
+def test_row_number_partitioned(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "row_number() over (partition by o_custkey order by o_orderkey) rn "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_rank_dense_rank(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderpriority, "
+        "rank() over (partition by o_custkey order by o_orderpriority) r, "
+        "dense_rank() over (partition by o_custkey order by o_orderpriority) dr "
+        "from orders order by o_custkey, o_orderpriority, r limit 100",
+    )
+
+
+def test_running_sum(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "sum(o_totalprice) over (partition by o_custkey order by o_orderkey) s "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_partition_total_no_order(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "sum(o_totalprice) over (partition by o_custkey) total, "
+        "count(*) over (partition by o_custkey) cnt "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_avg_min_max_over(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "avg(o_totalprice) over (partition by o_custkey) a, "
+        "min(o_totalprice) over (partition by o_custkey) lo, "
+        "max(o_totalprice) over (partition by o_custkey) hi "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_lag_lead(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "lag(o_orderkey) over (partition by o_custkey order by o_orderkey) lg, "
+        "lead(o_orderkey, 1, -1) over (partition by o_custkey order by o_orderkey) ld "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_first_last_value(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "first_value(o_orderkey) over (partition by o_custkey order by o_orderkey) f, "
+        "last_value(o_orderkey) over (partition by o_custkey order by o_orderkey "
+        "rows between unbounded preceding and unbounded following) l "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_rows_frame_sliding_sum(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderkey, "
+        "sum(o_totalprice) over (order by o_orderkey "
+        "rows between 2 preceding and current row) s "
+        "from orders order by o_orderkey limit 100",
+    )
+
+
+def test_ntile(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderkey, ntile(4) over (order by o_orderkey) nt "
+        "from orders order by o_orderkey limit 100",
+    )
+
+
+def test_percent_rank_cume_dist(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "percent_rank() over (partition by o_custkey order by o_orderkey) pr, "
+        "cume_dist() over (partition by o_custkey order by o_orderkey) cd "
+        "from orders order by o_custkey, o_orderkey limit 100",
+    )
+
+
+def test_window_over_aggregation(session, oracle_conn):
+    # window consuming aggregate outputs (sum(...) as the window arg)
+    check(
+        session, oracle_conn,
+        "select o_custkey, sum(o_totalprice) s, "
+        "rank() over (order by sum(o_totalprice) desc) r "
+        "from orders group by o_custkey order by r, o_custkey limit 50",
+    )
+
+
+def test_window_in_expression(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderkey, o_totalprice - avg(o_totalprice) over () diff "
+        "from orders order by o_orderkey limit 50",
+    )
+
+
+def test_window_then_filter_subquery(session, oracle_conn):
+    # top-1-per-group via derived table (common windowed pattern)
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey from ("
+        "  select o_custkey, o_orderkey, "
+        "  row_number() over (partition by o_custkey order by o_totalprice desc) rn"
+        "  from orders) t where rn = 1 order by o_custkey limit 50",
+    )
+
+
+def test_varchar_partition_key(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderpriority, o_orderkey, "
+        "row_number() over (partition by o_orderpriority order by o_orderkey) rn "
+        "from orders order by o_orderpriority, o_orderkey limit 100",
+    )

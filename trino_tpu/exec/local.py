@@ -35,6 +35,7 @@ from ..expr.lower import LoweringContext, compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
+from ..ops import window as window_ops
 from ..page import Column, Page
 from ..plan import nodes as P
 
@@ -590,6 +591,88 @@ class _TraceCtx:
             else:
                 out.append(k)
         return out
+
+    # -- window functions ------------------------------------------------
+    def _visit_window(self, node: P.Window) -> Batch:
+        """WindowOperator: one sort groups partitions and orders peers,
+        then every function is a vector program over the sorted arrays
+        (ops/window.py)."""
+        b = self.visit(node.source)
+        part_keys = tuple(
+            sort_ops.SortKey(s) for s in node.partition_by
+        )
+        order_keys = tuple(self._rank_sort_keys(node.order_by, b))
+        perm = sort_ops.sort_perm(part_keys + order_keys, b.lanes, b.sel)
+        lanes, sel = sort_ops.apply_perm(b.lanes, perm, b.sel)
+        part_lanes = [lanes[s] for s in node.partition_by]
+        ord_lanes = [lanes[k.column] for k in order_keys]
+        bounds = window_ops.compute_bounds(part_lanes, ord_lanes, sel)
+        for f in node.functions:
+            lanes[f.output] = self._window_output(f, lanes, sel, bounds)
+            if f.args:
+                d = self.ex.dicts.get(f.args[0])
+                if d is not None and f.output_type.is_dictionary:
+                    self.ex.dicts[f.output] = d
+        return Batch(lanes, sel, ordered=False, replicated=b.replicated)
+
+    def _window_output(self, f: P.WindowFunc, lanes, sel, b):
+        W = window_ops
+        if f.kind == "row_number":
+            return W.row_number(b)
+        if f.kind == "rank":
+            return W.rank(b)
+        if f.kind == "dense_rank":
+            return W.dense_rank(b)
+        if f.kind == "percent_rank":
+            return W.percent_rank(b, sel)
+        if f.kind == "cume_dist":
+            return W.cume_dist(b, sel)
+        if f.kind == "ntile":
+            return W.ntile(b, sel, f.constants[0])
+        if f.kind in ("lag", "lead"):
+            off, default = f.constants
+            return W.shift_value(
+                lanes[f.args[0]], b, off, default, f.kind == "lead"
+            )
+        start, end = W.frame_range(f.frame, b)
+        nonempty = end >= start
+        if f.kind == "first_value":
+            return W.value_at(lanes[f.args[0]], start, nonempty)
+        if f.kind == "last_value":
+            return W.value_at(lanes[f.args[0]], end, nonempty)
+        if f.kind == "nth_value":
+            return W.nth_value(lanes[f.args[0]], start, end, f.constants[0])
+        if f.kind in ("count", "count_star"):
+            lane = lanes[f.args[0]] if f.args else None
+            _, cnt = W.framed_sum_count(
+                lane, sel, start, end, count_star=f.kind == "count_star"
+            )
+            return cnt, jnp.ones(cnt.shape, bool)
+        if f.kind in ("min", "max"):
+            v, cnt = W.framed_minmax(lanes[f.args[0]], sel, b, f.frame, f.kind)
+            return jnp.where(cnt > 0, v, jnp.zeros_like(v)), cnt > 0
+        if f.kind in ("sum", "avg"):
+            ssum, cnt = W.framed_sum_count(lanes[f.args[0]], sel, start, end)
+            if f.kind == "sum":
+                return ssum, cnt > 0
+            den = jnp.maximum(cnt, 1)
+            ot, it_ = f.output_type, f.input_type
+            if ssum.dtype.kind == "f":
+                v = ssum / den
+            elif ot.name in ("double", "real"):
+                v = ssum.astype(ot.np_dtype) / den
+            elif ot.is_decimal and it_ is not None:
+                shift = 10 ** (ot.scale - it_.scale)
+                num = ssum * shift
+                sign = jnp.sign(num)
+                anum = jnp.abs(num)
+                q = anum // den
+                rem = anum - q * den
+                v = sign * (q + (2 * rem >= den))
+            else:
+                v = ssum // den
+            return v, cnt > 0
+        raise ExecutionError(f"window function {f.kind} not implemented")
 
     # -- set ops ---------------------------------------------------------
     def _visit_setoperation(self, node: P.SetOperation) -> Batch:

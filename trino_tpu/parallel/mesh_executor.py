@@ -415,6 +415,26 @@ class _MeshTraceCtx(_TraceCtx):
             )
         return Batch(lanes, src.sel, src.ordered, src.replicated)
 
+    # -- window ----------------------------------------------------------
+    def _visit_window(self, node: P.Window) -> Batch:
+        """Gathering exchange (single distribution) before the window sort;
+        hash-repartition by partition keys is the planned next increment."""
+        b = self.visit(node.source)
+        if not b.replicated:
+            b = _gather_batch(b)
+        saved_visit = self.visit
+
+        def patched_visit(n):
+            return b if n is node.source else saved_visit(n)
+
+        self.visit = patched_visit
+        try:
+            out = _TraceCtx._visit_window(self, node)
+        finally:
+            self.visit = saved_visit
+        out.replicated = True
+        return out
+
     # -- ordering --------------------------------------------------------
     def _visit_sort(self, node: P.Sort) -> Batch:
         b = self.visit(node.source)

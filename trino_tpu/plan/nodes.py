@@ -205,6 +205,57 @@ class ScalarJoin(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowFrame:
+    """Per-function frame (reference WindowNode.Frame / spi FrameBound)."""
+
+    unit: str = "range"  # rows | range
+    start_kind: str = "unbounded_preceding"
+    start_offset: int = 0
+    end_kind: str = "current"
+    end_offset: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFunc:
+    """One window function instance (WindowNode.Function analog)."""
+
+    output: str
+    kind: str  # row_number|rank|dense_rank|percent_rank|cume_dist|ntile|
+    #            lag|lead|first_value|last_value|nth_value|
+    #            sum|count|count_star|min|max|avg
+    args: Tuple[str, ...]  # input symbols (value argument)
+    constants: Tuple[object, ...]  # ntile buckets / lag offset+default / nth
+    frame: WindowFrame
+    input_type: Optional[T.Type]
+    output_type: T.Type
+
+
+@dataclasses.dataclass(frozen=True)
+class Window(PlanNode):
+    """WindowNode: adds one output column per function; rows preserved."""
+
+    source: PlanNode
+    partition_by: Tuple[str, ...]
+    order_by: Tuple[SortKey, ...]
+    functions: Tuple[WindowFunc, ...]
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols() + [
+            f.output for f in self.functions
+        ]
+
+    def output_types(self):
+        out = dict(self.source.output_types())
+        for f in self.functions:
+            out[f.output] = f.output_type
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
 class Sort(PlanNode):
     source: PlanNode
     keys: Tuple[SortKey, ...]
@@ -361,6 +412,12 @@ def plan_to_string(node: PlanNode) -> str:
             extra = f" n={n.count} keys={[k.column for k in n.keys]}"
         elif isinstance(n, Limit):
             extra = f" n={n.count}"
+        elif isinstance(n, Window):
+            extra = (
+                f" partition={list(n.partition_by)}"
+                f" order={[k.column for k in n.order_by]}"
+                f" fns={[f.output for f in n.functions]}"
+            )
         elif isinstance(n, Exchange):
             extra = f" {n.partitioning} keys={list(n.keys)}"
         elif isinstance(n, Output):

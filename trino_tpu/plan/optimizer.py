@@ -66,7 +66,8 @@ def _rewrite_sources(node: P.PlanNode, new_sources: Tuple[P.PlanNode, ...]):
     import dataclasses
 
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
-                         P.Limit, P.Distinct, P.Output, P.Exchange)):
+                         P.Limit, P.Distinct, P.Output, P.Exchange,
+                         P.Window)):
         return dataclasses.replace(node, source=new_sources[0])
     if isinstance(node, P.Join):
         return dataclasses.replace(node, left=new_sources[0], right=new_sources[1])
@@ -194,6 +195,25 @@ def _push_predicates(node: P.PlanNode) -> P.PlanNode:
             return P.Filter(newj, rest) if rest else newj
         return node
 
+    if isinstance(src, P.Window):
+        # conjuncts over partition keys only commute with the window
+        # (PushPredicateThroughProjectIntoWindow analog)
+        psyms = set(src.partition_by)
+        down = []
+        stay = []
+        for c in conj:
+            refs = set(ir.referenced_columns(c))
+            (down if refs and refs <= psyms else stay).append(c)
+        if down:
+            import dataclasses
+
+            new_src = dataclasses.replace(
+                src, source=P.Filter(src.source, _combine(down))
+            )
+            rest = _combine(stay)
+            return P.Filter(new_src, rest) if rest else new_src
+        return node
+
     if isinstance(src, P.SemiJoin):
         # predicates not on the mark push below
         mark = src.output
@@ -272,7 +292,8 @@ def _key_unique(node: P.PlanNode, symbol: str, metadata: Metadata) -> bool:
         for s in node.sources:
             if symbol in s.output_symbols():
                 return _key_unique(s, symbol, metadata)
-    if isinstance(node, (P.SemiJoin, P.ScalarJoin, P.Sort, P.TopN, P.Limit)):
+    if isinstance(node, (P.SemiJoin, P.ScalarJoin, P.Sort, P.TopN, P.Limit,
+                         P.Window)):
         return _key_unique(node.sources[0], symbol, metadata)
     return False
 
@@ -397,6 +418,21 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
         if isinstance(node, (P.Sort, P.TopN)):
             need = set(required) | {k.column for k in node.keys}
             return dataclasses.replace(node, source=prune(node.source, need))
+        if isinstance(node, P.Window):
+            kept = tuple(
+                f for f in node.functions if f.output in required
+            )
+            if not kept:
+                # no surviving function: the node adds nothing — drop it
+                return prune(node.source, set(required))
+            need = set(required) - {f.output for f in node.functions}
+            need |= set(node.partition_by)
+            need |= {k.column for k in node.order_by}
+            for f in kept:
+                need.update(f.args)
+            return dataclasses.replace(
+                node, source=prune(node.source, need), functions=kept
+            )
         if isinstance(node, (P.Limit, P.Exchange)):
             return dataclasses.replace(
                 node, source=prune(node.source, set(required))

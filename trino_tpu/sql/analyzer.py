@@ -38,6 +38,11 @@ from . import ast
 
 AGGREGATES = {"sum", "count", "min", "max", "avg"}
 
+WINDOW_ONLY_FUNCTIONS = {
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+    "ntile", "lag", "lead", "first_value", "last_value", "nth_value",
+}
+
 SCALAR_FUNCTIONS = {
     "abs", "sqrt", "round", "floor", "ceil", "ceiling", "year", "month",
     "day", "quarter", "length", "like",
@@ -105,6 +110,15 @@ class Analyzer:
         # are recorded per level (ApplyNode correlation list analog)
         self.outer_scopes: List[Scope] = []
         self.correlation_used: List[Dict[str, T.Type]] = []
+        # window placeholder symbol -> output type ($w names are not
+        # reachable from SQL identifiers, so visibility is harmless
+        # across nested query specs)
+        self.window_fields: Dict[str, T.Type] = {}
+        # id(ast node) -> analyzed ir for window sub-expressions whose
+        # aggregates were extracted during _plan_aggregation
+        self.win_ir_cache: Dict[int, ir.Expr] = {}
+        # stack of analyzed-but-unattached window state (nested specs)
+        self._pending_windows: List[tuple] = []
 
     def _plan_subquery_correlated(self, q: ast.Query, outer: Scope):
         """Plan q with `outer` visible; returns (RelationPlan, names,
@@ -230,18 +244,37 @@ class Analyzer:
             else:
                 items.append(it)
 
+        # window calls are pulled out of the select items and planned as
+        # WindowNodes after aggregation (QueryPlanner.planWindowFunctions)
+        win_calls: List[Tuple[str, ast.FunctionCall]] = []
+        if any(_contains_window(it.expr) for it in items):
+            items = [
+                ast.SelectItem(
+                    self._rewrite_windows(it.expr, win_calls), it.alias
+                )
+                for it in items
+            ]
+
         has_aggs = bool(spec.group_by) or any(
             _contains_aggregate(it.expr) for it in items
-        ) or (spec.having is not None and _contains_aggregate(spec.having))
+        ) or (spec.having is not None and _contains_aggregate(spec.having)) or any(
+            _contains_aggregate(x)
+            for _, c in win_calls
+            for x in _window_subexprs(c)
+        )
 
         ea = ExprAnalyzer(self, rel)
         if has_aggs:
-            rel, post = self._plan_aggregation(rel, spec, items, ea)
+            rel, post = self._plan_aggregation(rel, spec, items, ea, win_calls)
             proj_analyzer = post
         else:
             if spec.having is not None:
                 raise SemanticError("HAVING without aggregation")
             proj_analyzer = ea
+            if win_calls:
+                self._analyze_windows(win_calls, ea.analyze)
+        if win_calls:
+            self._attach_windows(proj_analyzer)
 
         # SELECT projection
         names: List[str] = []
@@ -471,7 +504,8 @@ class Analyzer:
         return RelationPlan(P.Filter(node, pred), rel.scope)
 
     # ------------------------------------------------------------------
-    def _plan_aggregation(self, rel, spec, items, ea: "ExprAnalyzer"):
+    def _plan_aggregation(self, rel, spec, items, ea: "ExprAnalyzer",
+                          win_calls=()):
         # group keys: ordinals or expressions
         key_exprs: List[ir.Expr] = []
         for g in spec.group_by:
@@ -502,6 +536,14 @@ class Analyzer:
                 key_map.append((ke, ref))
 
         agg_collector = AggCollector(self, rel, key_map, pre_assigns)
+        # window args/partition/order are evaluated over the aggregation
+        # output: extract their aggregates first (before the Aggregate node
+        # is frozen) and register placeholder types for the item analysis
+        if win_calls:
+            for _, call in win_calls:
+                for x in _window_subexprs(call):
+                    self.win_ir_cache[id(x)] = agg_collector.analyze_post(x)
+            self._analyze_windows(win_calls, agg_collector.analyze_post)
         # analyze select + having with aggregate extraction
         post_exprs = {}
         for it in items:
@@ -529,6 +571,206 @@ class Analyzer:
             self, rel2, agg_collector, post_exprs, dict((id(it), it) for it in items)
         )
         return rel2, post_analyzer
+
+    # -- window planning (QueryPlanner.planWindowFunctions analog) -------
+    def _rewrite_windows(self, e: ast.Node, out: List) -> ast.Node:
+        """Replace windowed FunctionCalls with placeholder identifiers
+        ($w symbols, unreachable from SQL text); collects (placeholder,
+        call) pairs.  Does not descend into subqueries — their windows are
+        planned when the subquery is planned."""
+        if isinstance(e, ast.FunctionCall) and e.window is not None:
+            ph = self.symbols.new("$w")
+            out.append((ph, e))
+            return ast.Identifier((ph,))
+        if isinstance(e, ast.Query) or not isinstance(e, ast.Node):
+            return e
+        kwargs = {}
+        changed = False
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            if isinstance(v, ast.Node):
+                nv = self._rewrite_windows(v, out)
+            elif isinstance(v, tuple):
+                nv = tuple(self._rewrite_windows(x, out) for x in v)
+                if all(a is b for a, b in zip(nv, v)):
+                    nv = v
+            else:
+                nv = v
+            if nv is not v:
+                changed = True
+            kwargs[f.name] = nv
+        return dataclasses.replace(e, **kwargs) if changed else e
+
+    def _analyze_windows(self, win_calls, analyze) -> None:
+        """Phase 1 of window planning: analyze partition/order/arg
+        expressions (via `analyze`, the agg-aware analyzer when grouping),
+        build WindowFunc specs, and register placeholder output types so
+        the select projection can reference them.  The built state is
+        pushed for _attach_windows (a stack: subquery planning nests)."""
+
+        def an(x: ast.Node) -> ir.Expr:
+            cached = self.win_ir_cache.get(id(x))
+            return cached if cached is not None else analyze(x)
+
+        computed: List[Tuple[str, ir.Expr]] = []
+        seen: Dict[ir.Expr, str] = {}
+
+        def as_symbol(e: ir.Expr) -> str:
+            if isinstance(e, ir.ColumnRef):
+                return e.name
+            if e in seen:
+                return seen[e]
+            sym = self.symbols.new("winarg")
+            computed.append((sym, e))
+            seen[e] = sym
+            return sym
+
+        groups: Dict[tuple, List[P.WindowFunc]] = {}
+        for ph, call in win_calls:
+            spec = call.window
+            psyms = tuple(as_symbol(an(p)) for p in spec.partition_by)
+            okeys = []
+            for si in spec.order_by:
+                sym = as_symbol(an(si.expr))
+                asc = si.ascending
+                nf = si.nulls_first if si.nulls_first is not None else (not asc)
+                okeys.append(SortKey(sym, asc, nf))
+            func = self._window_func(ph, call, an, as_symbol)
+            groups.setdefault((psyms, tuple(okeys)), []).append(func)
+            self.window_fields[ph] = func.output_type
+        self._pending_windows.append((computed, groups))
+
+    def _attach_windows(self, pa) -> None:
+        """Phase 2: place the pre-projection + Window nodes on top of the
+        (possibly aggregated) relation the projection analyzer sees."""
+        computed, groups = self._pending_windows.pop()
+        rel = pa.relation
+        root = rel.root
+        if computed:
+            passthrough = [
+                (s, ir.ColumnRef(t, s))
+                for s, t in root.output_types().items()
+            ]
+            root = P.Project(root, tuple(passthrough + computed))
+        for (psyms, okeys), funcs in groups.items():
+            root = P.Window(root, psyms, okeys, tuple(funcs))
+        pa.relation = RelationPlan(root, rel.scope)
+
+    def _window_func(self, ph, call: ast.FunctionCall, an, as_symbol):
+        kind = call.name
+        if call.distinct:
+            raise SemanticError("DISTINCT in window functions is not supported")
+        frame = self._window_frame(call.window.frame)
+        args: Tuple[str, ...] = ()
+        constants: Tuple[object, ...] = ()
+        in_t: Optional[T.Type] = None
+        if kind in ("row_number", "rank", "dense_rank", "percent_rank",
+                    "cume_dist"):
+            if call.args:
+                raise SemanticError(f"{kind}() takes no arguments")
+            out_t = T.DOUBLE if kind in ("percent_rank", "cume_dist") else T.BIGINT
+        elif kind == "ntile":
+            if len(call.args) != 1:
+                raise SemanticError("ntile(n) takes one argument")
+            n = self._const_int(call.args[0], "ntile")
+            if n < 1:
+                raise SemanticError("ntile buckets must be positive")
+            constants = (n,)
+            out_t = T.BIGINT
+        elif kind in ("lag", "lead"):
+            if not call.args:
+                raise SemanticError(f"{kind}() requires a value argument")
+            v = an(call.args[0])
+            args = (as_symbol(v),)
+            in_t = out_t = v.type
+            off = 1
+            if len(call.args) > 1:
+                off = self._const_int(call.args[1], kind)
+            default = None
+            if len(call.args) > 2:
+                d = an(call.args[2])
+                if not isinstance(d, ir.Constant):
+                    raise SemanticError(f"{kind} default must be a constant")
+                default = d.value
+                if default is not None and in_t.is_dictionary:
+                    raise SemanticError(
+                        f"{kind} with a non-null varchar default is not "
+                        "supported"
+                    )
+                if default is not None and in_t.is_decimal:
+                    src_scale = d.type.scale if d.type.is_decimal else 0
+                    default = default * 10 ** (in_t.scale - src_scale)
+            constants = (off, default)
+        elif kind in ("first_value", "last_value"):
+            if len(call.args) != 1:
+                raise SemanticError(f"{kind}(x) takes one argument")
+            v = an(call.args[0])
+            args = (as_symbol(v),)
+            in_t = out_t = v.type
+        elif kind == "nth_value":
+            if len(call.args) != 2:
+                raise SemanticError("nth_value(x, n) takes two arguments")
+            v = an(call.args[0])
+            args = (as_symbol(v),)
+            n = self._const_int(call.args[1], "nth_value")
+            if n < 1:
+                raise SemanticError("nth_value offset must be positive")
+            constants = (n,)
+            in_t = out_t = v.type
+        elif kind in AGGREGATES:
+            if call.is_star:
+                kind = "count_star"
+                out_t = T.BIGINT
+            else:
+                v = an(call.args[0])
+                args = (as_symbol(v),)
+                in_t = v.type
+                out_t = _agg_output_type(kind, in_t)
+                if kind in ("min", "max"):
+                    if in_t.is_dictionary:
+                        raise SemanticError(
+                            f"window {kind}(varchar) is not supported"
+                        )
+                    if (frame.start_kind != "unbounded_preceding"
+                            and frame.end_kind != "unbounded_following"):
+                        raise SemanticError(
+                            f"window {kind} requires a frame unbounded at "
+                            "one end"
+                        )
+        else:
+            raise SemanticError(f"unknown window function: {kind}")
+        return P.WindowFunc(ph, kind, args, constants, frame, in_t, out_t)
+
+    def _window_frame(self, f: Optional[ast.WindowFrame]) -> P.WindowFrame:
+        if f is None:
+            # SQL default: RANGE UNBOUNDED PRECEDING .. CURRENT ROW
+            # (without ORDER BY all rows are peers, so this spans the
+            # whole partition — compute_bounds' peer geometry covers both)
+            return P.WindowFrame()
+        if f.unit == "groups":
+            raise SemanticError("GROUPS frames are not supported")
+
+        def bound(b: ast.FrameBound, which: str) -> Tuple[str, int]:
+            if b.kind in ("preceding", "following"):
+                if f.unit == "range":
+                    raise SemanticError(
+                        "RANGE frames support only UNBOUNDED/CURRENT ROW "
+                        "bounds"
+                    )
+                return b.kind, self._const_int(b.value, f"frame {which}")
+            return b.kind, 0
+
+        sk, so = bound(f.start, "start")
+        ek, eo = bound(f.end, "end")
+        if sk == "unbounded_following" or ek == "unbounded_preceding":
+            raise SemanticError("invalid window frame bounds")
+        return P.WindowFrame(f.unit, sk, so, ek, eo)
+
+    @staticmethod
+    def _const_int(e: ast.Node, what: str) -> int:
+        if isinstance(e, ast.Literal) and e.kind == "integer":
+            return int(e.value)
+        raise SemanticError(f"{what} requires a constant integer")
 
     # ------------------------------------------------------------------
     def _apply_order_limit(
@@ -746,23 +988,45 @@ def _derive_name(e: ast.Node, i: int) -> str:
     return f"_col{i}"
 
 
-def _contains_aggregate(e: ast.Node) -> bool:
-    if isinstance(e, ast.FunctionCall) and e.name in AGGREGATES:
-        return True
-    for f in dataclasses.fields(e) if dataclasses.is_dataclass(e) else ():
+def _ast_children(e: ast.Node):
+    """Direct AST children, not descending into subqueries (their
+    aggregates/windows belong to the inner query)."""
+    if not dataclasses.is_dataclass(e):
+        return
+    for f in dataclasses.fields(e):
         v = getattr(e, f.name)
-        if isinstance(v, ast.Node) and _contains_aggregate(v):
-            return True
-        if isinstance(v, tuple):
+        if isinstance(v, ast.Node) and not isinstance(v, ast.Query):
+            yield v
+        elif isinstance(v, tuple):
             for x in v:
-                if isinstance(x, ast.Node) and _contains_aggregate(x):
-                    return True
-                if isinstance(x, ast.WhenClause):
-                    if _contains_aggregate(x.condition) or _contains_aggregate(
-                        x.result
-                    ):
-                        return True
-    return False
+                if isinstance(x, ast.Node) and not isinstance(x, ast.Query):
+                    yield x
+
+
+def _contains_aggregate(e: ast.Node) -> bool:
+    if (
+        isinstance(e, ast.FunctionCall)
+        and e.name in AGGREGATES
+        and e.window is None
+    ):
+        return True
+    return any(_contains_aggregate(c) for c in _ast_children(e))
+
+
+def _contains_window(e: ast.Node) -> bool:
+    if isinstance(e, ast.FunctionCall) and e.window is not None:
+        return True
+    return any(_contains_window(c) for c in _ast_children(e))
+
+
+def _window_subexprs(call: ast.FunctionCall):
+    """Value/partition/order expressions of a windowed call (the parts
+    evaluated against the window's input relation)."""
+    if not call.is_star:
+        yield from call.args
+    yield from call.window.partition_by
+    for si in call.window.order_by:
+        yield si.expr
 
 
 def _extract_equi_criteria(cond: ir.Expr, lsyms, rsyms):
@@ -820,6 +1084,9 @@ class ExprAnalyzer:
 
     def _resolve_column(self, parts) -> ir.Expr:
         key = tuple(p.lower() for p in parts)
+        if len(key) == 1 and key[0] in self.a.window_fields:
+            # placeholder for an extracted window function output
+            return ir.ColumnRef(self.a.window_fields[key[0]], key[0])
         try:
             f = self.relation.scope.resolve(key)
         except SemanticError:
@@ -919,6 +1186,12 @@ class ExprAnalyzer:
         return ir.Case(rt, tuple(whens), default)
 
     def _function(self, e: ast.FunctionCall) -> ir.Expr:
+        if e.window is not None:
+            raise SemanticError(
+                "window functions are only allowed in the SELECT list"
+            )
+        if e.name in WINDOW_ONLY_FUNCTIONS:
+            raise SemanticError(f"{e.name}() requires an OVER clause")
         if e.name in AGGREGATES:
             raise SemanticError(
                 f"aggregate {e.name}() not allowed here"
@@ -1025,7 +1298,11 @@ class AggCollector(ExprAnalyzer):
         return out
 
     def _post(self, e: ast.Node) -> ir.Expr:
-        if isinstance(e, ast.FunctionCall) and e.name in AGGREGATES:
+        if (
+            isinstance(e, ast.FunctionCall)
+            and e.name in AGGREGATES
+            and e.window is None
+        ):
             return self._aggregate_call(e)
         # try: whole expression equals a group key
         try:
@@ -1112,6 +1389,7 @@ class AggCollector(ExprAnalyzer):
             {r.name for _, r in self.key_map}
             | {a.output for a in self.aggs}
             | self.scalar_syms
+            | set(self.a.window_fields)
         )
         for n in ir.walk(e):
             if isinstance(n, ir.ColumnRef) and n.name not in allowed:

@@ -124,11 +124,34 @@ class LikeOp(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class FrameBound(Node):
+    kind: str  # unbounded_preceding|preceding|current|following|unbounded_following
+    value: Optional[Node] = None  # offset expression for k PRECEDING/FOLLOWING
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowFrame(Node):
+    unit: str  # rows | range | groups
+    start: FrameBound
+    end: FrameBound
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSpec(Node):
+    """OVER ( [PARTITION BY ...] [ORDER BY ...] [frame] )"""
+
+    partition_by: Tuple[Node, ...]
+    order_by: Tuple["SortItem", ...]
+    frame: Optional[WindowFrame] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class FunctionCall(Node):
     name: str
     args: Tuple[Node, ...]
     distinct: bool = False
     is_star: bool = False  # count(*)
+    window: Optional[WindowSpec] = None  # OVER clause -> window function
 
 
 @dataclasses.dataclass(frozen=True)

@@ -38,7 +38,8 @@ KEYWORDS = {
     "timestamp", "interval", "year", "month", "day", "hour", "minute",
     "second", "quarter", "explain", "analyze", "show", "tables", "columns",
     "substring", "for", "fetch", "offset", "rows", "row", "only", "values",
-    "set", "session",
+    "set", "session", "over", "partition", "range", "groups", "unbounded",
+    "preceding", "following", "current",
 }
 
 
@@ -648,7 +649,12 @@ class Parser:
                 while self.accept_op(","):
                     args.append(self.expr())
             self.expect_op(")")
-            return ast.FunctionCall(name.lower(), tuple(args), distinct, is_star)
+            window = None
+            if self.accept_kw("over"):
+                window = self.window_spec()
+            return ast.FunctionCall(
+                name.lower(), tuple(args), distinct, is_star, window
+            )
         parts = [name]
         while (
             self.peek().kind == "op"
@@ -658,6 +664,51 @@ class Parser:
             self.next()
             parts.append(self.ident())
         return ast.Identifier(tuple(parts))
+
+    def window_spec(self) -> ast.WindowSpec:
+        """OVER ( [PARTITION BY e,..] [ORDER BY s,..] [frame] )
+        (SqlBase.g4 windowSpecification)."""
+        self.expect_op("(")
+        partition: List[ast.Node] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept_op(","):
+                partition.append(self.expr())
+        order: List[ast.SortItem] = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.sort_item())
+            while self.accept_op(","):
+                order.append(self.sort_item())
+        frame = None
+        if self.at_kw("rows", "range", "groups"):
+            unit = self.next().text
+            if self.accept_kw("between"):
+                start = self.frame_bound()
+                self.expect_kw("and")
+                end = self.frame_bound()
+            else:
+                start = self.frame_bound()
+                end = ast.FrameBound("current")
+            frame = ast.WindowFrame(unit, start, end)
+        self.expect_op(")")
+        return ast.WindowSpec(tuple(partition), tuple(order), frame)
+
+    def frame_bound(self) -> ast.FrameBound:
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ast.FrameBound("unbounded_preceding")
+            self.expect_kw("following")
+            return ast.FrameBound("unbounded_following")
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return ast.FrameBound("current")
+        v = self.expr()
+        if self.accept_kw("preceding"):
+            return ast.FrameBound("preceding", v)
+        self.expect_kw("following")
+        return ast.FrameBound("following", v)
 
     def type_name(self) -> str:
         base = self.next().text.lower()
