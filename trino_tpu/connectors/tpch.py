@@ -661,7 +661,9 @@ class TpchSplitManager(SplitManager):
 
     def get_splits(self, table: str, desired: int) -> List[Split]:
         n = _counts(self.sf)["orders" if table == "lineitem" else table]
-        k = max(1, min(desired, (n + 65535) // 65536))
+        # honor the engine's desired parallelism down to 512-row splits so
+        # multi-node tests exercise real split distribution at tiny SF
+        k = max(1, min(desired, (n + 511) // 512))
         return [Split(table, i, k, {"sf": self.sf}) for i in range(k)]
 
 

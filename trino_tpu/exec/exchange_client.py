@@ -1,0 +1,112 @@
+"""Direct exchange client: pull serialized pages from upstream tasks.
+
+Reference parity: operator/DirectExchangeClient.java:56 (addLocation:154,
+pollPage:221) and HttpPageBufferClient.java:98 — async long-poll GET of
+``/v1/task/{id}/results/{bufferId}/{token}``, token-acknowledged, with
+upstream failure propagation.  Here the pull loop is synchronous per source
+with concurrent sources fetched on a small thread pool (the sliding-window
+prefetch collapses to "fetch all, fragments are monolithic XLA programs").
+"""
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List
+
+from ..page import Page
+from ..serde import deserialize_page
+
+
+class RemoteTaskError(RuntimeError):
+    pass
+
+
+class ExchangeTimeout(RuntimeError):
+    pass
+
+
+CREATE_WAIT = 30.0  # max time to wait for an upstream task to appear
+
+
+def _fetch_buffer(uri: str, task: str, buffer: int, timeout: float) -> List[Page]:
+    """Poll one upstream (task, buffer) until complete; returns its pages."""
+    pages: List[Page] = []
+    token = 0
+    seen_task = False
+    deadline = time.time() + timeout
+    create_deadline = time.time() + CREATE_WAIT
+    while True:
+        url = f"{uri}/v1/task/{task}/results/{buffer}/{token}"
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as resp:
+                seen_task = True
+                state = resp.headers.get("X-Task-State", "RUNNING")
+                if resp.status == 200:
+                    body = resp.read()
+                    if body:
+                        pages.append(deserialize_page(body))
+                    if resp.headers.get("X-Buffer-Complete") == "true":
+                        return pages
+                    token = int(resp.headers.get("X-Next-Token", token + 1))
+                    continue
+                # 204: not ready yet
+        except urllib.error.HTTPError as e:
+            if e.code == 410:
+                raise RemoteTaskError(
+                    f"upstream task {task} failed: "
+                    f"{e.read().decode(errors='replace')}"
+                )
+            if e.code != 404:
+                raise
+            if seen_task:
+                # the task existed and is now gone: the query was aborted
+                # and the task deleted — stop polling immediately
+                raise RemoteTaskError(f"upstream task {task} was deleted")
+            if time.time() > create_deadline:
+                raise RemoteTaskError(
+                    f"upstream task {task} never appeared on {uri}"
+                )
+            # 404 before first contact: task not created yet — keep polling
+        except (urllib.error.URLError, ConnectionError, OSError) as e:
+            raise RemoteTaskError(f"upstream worker {uri} unreachable: {e}")
+        if time.time() > deadline:
+            raise ExchangeTimeout(f"exchange timeout on {url}")
+        time.sleep(0.02)
+
+
+class ExchangeClient:
+    """Fetches all pages for a task's remote sources."""
+
+    def __init__(self, timeout: float = 300.0, concurrency: int = 8):
+        self.timeout = timeout
+        self.concurrency = concurrency
+
+    def fetch_sources(
+        self, sources: Dict[int, List[dict]]
+    ) -> Dict[int, List[Page]]:
+        """sources: fragment_id -> [{uri, task, buffer}, ...]."""
+        out: Dict[int, List[Page]] = {}
+        flat = [
+            (fid, loc) for fid, locs in sources.items() for loc in locs
+        ]
+        if not flat:
+            return out
+        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            futures = [
+                (
+                    fid,
+                    pool.submit(
+                        _fetch_buffer,
+                        loc["uri"],
+                        loc["task"],
+                        int(loc["buffer"]),
+                        self.timeout,
+                    ),
+                )
+                for fid, loc in flat
+            ]
+            for fid, fut in futures:
+                out.setdefault(fid, []).extend(fut.result())
+        return out

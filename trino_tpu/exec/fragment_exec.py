@@ -1,0 +1,133 @@
+"""Fragment execution on a worker: assigned splits + remote exchange inputs.
+
+Reference parity: execution/SqlTaskExecution.java:85 (splits -> drivers over
+one fragment's operator chain) and operator/ExchangeOperator.java:44 (remote
+source pages pulled from upstream tasks).  The whole fragment still compiles
+to one XLA program (exec/local.py); this subclass only changes where leaf
+data comes from:
+
+  - TableScans read only the splits assigned to this task
+    (SqlTaskExecution.addSplitAssignments:256)
+  - RemoteSources read deserialized pages fetched by the exchange client,
+    with per-producer string dictionaries merged and codes remapped (the
+    engine-side analog of DictionaryBlock unnesting across tasks)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..catalog import CatalogManager
+from ..page import Page
+from ..plan import nodes as P
+from ..spi import Split
+from .local import ExecutionError, LocalExecutor, _TraceCtx
+
+
+def merge_pages_to_arrays(
+    pages: List[Page], symbols, types, dicts: Dict[str, np.ndarray]
+) -> Tuple[Dict[str, tuple], int]:
+    """Concatenate remote pages column-wise; merge varchar dictionaries
+    (remapping codes) when producers shipped different ones."""
+    tmap = dict(types)
+    merged: Dict[str, tuple] = {}
+    total = sum(p.count for p in pages)
+    for sym in symbols:
+        t = tmap[sym]
+        vals_parts: List[np.ndarray] = []
+        ok_parts: List[np.ndarray] = []
+        if t.is_dictionary:
+            index: Dict[str, int] = {}
+            entries: List[str] = []
+            for p in pages:
+                if p.count == 0:
+                    continue
+                col = p.by_name(sym)
+                d = col.dictionary
+                codes = np.asarray(col.values)[: p.count]
+                if d is None:
+                    raise ExecutionError(f"remote varchar {sym} without dict")
+                remap = np.empty(len(d), dtype=np.int32)
+                for i, s in enumerate(d):
+                    s = str(s)
+                    if s not in index:
+                        index[s] = len(entries)
+                        entries.append(s)
+                    remap[i] = index[s]
+                safe = np.clip(codes, 0, max(len(d) - 1, 0))
+                vals_parts.append(
+                    np.where(codes >= 0, remap[safe], -1).astype(np.int32)
+                )
+                ok_parts.append(
+                    np.ones(p.count, bool)
+                    if col.validity is None
+                    else np.asarray(col.validity)[: p.count]
+                )
+            dicts[sym] = np.array(entries, dtype=object)
+        else:
+            for p in pages:
+                if p.count == 0:
+                    continue
+                col = p.by_name(sym)
+                vals_parts.append(np.asarray(col.values)[: p.count])
+                ok_parts.append(
+                    np.ones(p.count, bool)
+                    if col.validity is None
+                    else np.asarray(col.validity)[: p.count]
+                )
+        if vals_parts:
+            vals = np.concatenate(vals_parts)
+            ok = np.concatenate(ok_parts)
+        else:
+            vals = np.zeros(0, dtype=t.np_dtype)
+            ok = np.zeros(0, dtype=bool)
+        merged[sym] = (vals, None if ok.all() else ok)
+    return merged, total
+
+
+class _FragmentTraceCtx(_TraceCtx):
+    def _visit_remotesource(self, node: P.RemoteSource):
+        return self._visit_tablescan(node)  # same padded-array load path
+
+
+class FragmentExecutor(LocalExecutor):
+    """Executes one PlanFragment's local plan for one task."""
+
+    trace_ctx_cls = _FragmentTraceCtx
+
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        config: Optional[dict],
+        splits_by_scan: Dict[int, List[Split]],
+        remote_pages: Dict[int, List[Page]],
+    ):
+        super().__init__(catalogs, config)
+        self.splits_by_scan = splits_by_scan
+        self.remote_pages = remote_pages
+
+    # ------------------------------------------------------------------
+    def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
+        self._scan_idx = 0
+        self._load_walk(node, scans, dicts, counts)
+
+    def _load_walk(self, node: P.PlanNode, scans, dicts, counts):
+        if isinstance(node, P.TableScan):
+            idx = self._scan_idx
+            self._scan_idx += 1
+            # shared loader from LocalExecutor, restricted to this task's
+            # assigned splits
+            self._load_one_scan(node, self.splits_by_scan.get(idx, []),
+                                scans, dicts, counts)
+            return
+        if isinstance(node, P.RemoteSource):
+            pages = self.remote_pages.get(node.fragment_id, [])
+            merged, total = merge_pages_to_arrays(
+                pages, node.symbols, node.types_, dicts
+            )
+            scans[id(node)] = merged
+            counts[id(node)] = total
+            return
+        for s in node.sources:
+            self._load_walk(s, scans, dicts, counts)

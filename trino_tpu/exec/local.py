@@ -38,6 +38,7 @@ from ..ops import sort as sort_ops
 from ..ops import window as window_ops
 from ..page import Column, Page
 from ..plan import nodes as P
+from ..spi import Split
 
 DEFAULT_GROUP_CAPACITY = 4096
 
@@ -62,6 +63,8 @@ def _pad_capacity(n: int) -> int:
 class LocalExecutor:
     """Executes an optimized logical plan on the local device(s)."""
 
+    trace_ctx_cls: type  # bound after _TraceCtx definition
+
     def __init__(self, catalogs: CatalogManager, config: Optional[dict] = None):
         self.catalogs = catalogs
         self.metadata = Metadata(catalogs)
@@ -82,7 +85,7 @@ class LocalExecutor:
         self.join_factor = 1
 
         for attempt in range(5):
-            ctx = _TraceCtx(self, scans, counts)
+            ctx = self.trace_ctx_cls(self, scans, counts)
             out_lanes, sel, ordered, checks = self._run(plan, ctx)
             for join_node, dup in ctx.dup_checks:
                 if int(dup) > 0:
@@ -107,42 +110,65 @@ class LocalExecutor:
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
         if isinstance(node, P.TableScan):
             conn = self.catalogs.get(node.catalog)
-            cols = [c for _, c in node.assignments]
             splits = conn.split_manager().get_splits(node.table, 1)
-            provider = conn.page_source_provider()
-            values: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-            valids: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-            total = 0
-            for sp in splits:
-                src = provider.create_page_source(sp, cols)
-                for page in src.pages():
-                    for c, col in zip(page.names, page.columns):
-                        values[c].append(np.asarray(col.values)[: page.count])
-                        valids[c].append(
-                            np.ones(page.count, dtype=bool)
-                            if col.validity is None
-                            else np.asarray(col.validity)[: page.count]
-                        )
-                    total += page.count
-                for c, d in src.dictionaries().items():
-                    dicts_key = self._sym_for(node, c)
-                    prev = dicts.get(dicts_key)
-                    if prev is not None and prev is not d and not np.array_equal(prev, d):
-                        raise ExecutionError(
-                            f"split dictionaries diverge for {c}"
-                        )
-                    dicts[dicts_key] = d
-            merged = {}
-            for c, v in values.items():
-                sym = self._sym_for(node, c)
-                vals = np.concatenate(v) if len(v) != 1 else v[0]
-                ok = np.concatenate(valids[c]) if len(v) != 1 else valids[c][0]
-                merged[sym] = (vals, None if ok.all() else ok)
-            scans[id(node)] = merged
-            counts[id(node)] = total
+            self._load_one_scan(node, splits, scans, dicts, counts)
             return
         for s in node.sources:
             self._load_scans(s, scans, dicts, counts)
+
+    def _load_one_scan(self, node: P.TableScan, splits, scans, dicts, counts):
+        """Load the given splits of one scan into host arrays (shared by
+        local execution — all splits — and per-task fragment execution —
+        the assigned subset, SqlTaskExecution.addSplitAssignments:256)."""
+        conn = self.catalogs.get(node.catalog)
+        cols = [c for _, c in node.assignments]
+        provider = conn.page_source_provider()
+        values: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+        valids: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
+        total = 0
+        for sp in splits:
+            src = provider.create_page_source(sp, cols)
+            for page in src.pages():
+                for c, col in zip(page.names, page.columns):
+                    values[c].append(np.asarray(col.values)[: page.count])
+                    valids[c].append(
+                        np.ones(page.count, dtype=bool)
+                        if col.validity is None
+                        else np.asarray(col.validity)[: page.count]
+                    )
+                total += page.count
+            for c, d in src.dictionaries().items():
+                dicts_key = self._sym_for(node, c)
+                prev = dicts.get(dicts_key)
+                if prev is not None and prev is not d and not np.array_equal(prev, d):
+                    raise ExecutionError(
+                        f"split dictionaries diverge for {c}"
+                    )
+                dicts[dicts_key] = d
+        if not splits:
+            # a task may legitimately receive zero splits; dictionaries must
+            # still exist for downstream dict-typed operations
+            src = provider.create_page_source(Split(node.table, 0, 1), cols)
+            for c, d in src.dictionaries().items():
+                dicts.setdefault(self._sym_for(node, c), d)
+        tmap = dict(node.types)
+        merged = {}
+        for c in cols:
+            sym = self._sym_for(node, c)
+            parts = values[c]
+            if parts:
+                vals = np.concatenate(parts) if len(parts) != 1 else parts[0]
+                ok = (
+                    np.concatenate(valids[c])
+                    if len(parts) != 1
+                    else valids[c][0]
+                )
+            else:
+                vals = np.zeros(0, dtype=tmap[sym].np_dtype)
+                ok = np.zeros(0, dtype=bool)
+            merged[sym] = (vals, None if ok.all() else ok)
+        scans[id(node)] = merged
+        counts[id(node)] = total
 
     @staticmethod
     def _sym_for(scan: P.TableScan, col: str) -> str:
@@ -277,6 +303,9 @@ class _TraceCtx:
 
     # -- aggregation -----------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate, b: Optional[Batch] = None) -> Batch:
+        """Handles all three steps (AggregationNode.java:346): SINGLE and
+        PARTIAL accumulate raw rows; FINAL merges shipped accumulator
+        columns (the distributed merge path)."""
         if b is None:
             b = self.visit(node.source)
         types = node.source.output_types()
@@ -287,12 +316,29 @@ class _TraceCtx:
             )
             for a in node.aggs
         ]
+        final = node.step == "final"
+        partial = node.step == "partial"
+
+        def reduce_rows(lanes, gid, sel, cap):
+            if final:
+                acc_in = {
+                    n: lanes[n] for s in specs for n in s.accumulator_names
+                }
+                return agg_ops.merge_accumulators(specs, acc_in, gid, sel, cap)
+            return agg_ops.accumulate(specs, lanes, gid, sel, cap)
+
+        def out_lanes(accs):
+            if partial:
+                return {
+                    n: (v, jnp.ones(v.shape, bool)) for n, v in accs.items()
+                }
+            return agg_ops.finalize(specs, accs)
+
         if not node.keys:
             # global aggregation: one group
             gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)
-            accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, 1)
-            out = agg_ops.finalize(specs, accs)
-            lanes = {s: out[s] for s in out}
+            accs = reduce_rows(b.lanes, gid, b.sel, 1)
+            lanes = out_lanes(accs)
             sel = jnp.ones(1, dtype=bool)
             # pad to 128 for consistency
             return Batch(
@@ -304,7 +350,7 @@ class _TraceCtx:
         domains = self._direct_domains(node.keys, types)
         if domains is not None:
             gid, cap = agg_ops.direct_group_ids(key_lanes, domains)
-            accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, cap)
+            accs = reduce_rows(b.lanes, gid, b.sel, cap)
             present = (
                 jax.ops.segment_sum(
                     b.sel.astype(jnp.int64), gid, num_segments=cap
@@ -320,12 +366,12 @@ class _TraceCtx:
             sorted_lanes = {
                 s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
             }
-            accs = agg_ops.accumulate(specs, sorted_lanes, gid, sel_sorted, cap)
+            accs = reduce_rows(sorted_lanes, gid, sel_sorted, cap)
             present = jnp.arange(cap) < ngroups
             keys_out = agg_ops.group_keys_output(
                 [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap
             )
-        out = agg_ops.finalize(specs, accs)
+        out = out_lanes(accs)
         lanes = {}
         for k, kl in zip(node.keys, keys_out):
             lanes[k] = kl
@@ -725,3 +771,6 @@ class _TraceCtx:
             lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes.items()}
             batch = Batch(lanes, sel[perm] & boundary)
         return batch
+
+
+LocalExecutor.trace_ctx_cls = _TraceCtx

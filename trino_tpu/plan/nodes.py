@@ -101,12 +101,48 @@ class AggInfo:
     input_type: Optional[T.Type]
     output_type: T.Type
 
+    def accumulator_schema(self) -> List[Tuple[str, T.Type]]:
+        """Intermediate (PARTIAL-step output) columns for this aggregate —
+        the analog of the reference's serialized accumulator state shipped
+        between PARTIAL and FINAL HashAggregationOperators.  Names come from
+        the kernel's AggSpec.accumulator_names (the single source of truth
+        for the accumulator layout); only the wire types are decided here."""
+        from ..ops.aggregation import AggSpec
+
+        names = AggSpec(
+            self.kind, self.arg, self.output, self.input_type,
+            self.output_type, self.distinct,
+        ).accumulator_names
+        it = self.input_type
+        if it is not None and it.name in ("double", "real"):
+            sum_t = T.DOUBLE
+        elif it is not None and it.is_decimal:
+            sum_t = it
+        else:
+            sum_t = T.BIGINT
+
+        def type_for(name: str) -> T.Type:
+            if name.endswith("$count") or name.endswith("$valid"):
+                return T.BIGINT
+            if self.kind in ("min", "max"):  # $val keeps the input type
+                return it if it is not None else T.BIGINT
+            return sum_t  # sum's $val / avg's $sum promote
+
+        return [(n, type_for(n)) for n in names]
+
+    @property
+    def partializable(self) -> bool:
+        return not self.distinct and self.kind in (
+            "sum", "count", "count_star", "min", "max", "avg",
+        )
+
 
 @dataclasses.dataclass(frozen=True)
 class Aggregate(PlanNode):
     """AggregationNode. step follows the reference's PARTIAL/FINAL/SINGLE
     (plan/AggregationNode.java:346); the planner emits SINGLE and the
-    optimizer/fragmenter splits around exchanges."""
+    fragmenter splits partial/final around exchanges
+    (PushPartialAggregationThroughExchange analog)."""
 
     source: PlanNode
     keys: Tuple[str, ...]
@@ -118,11 +154,20 @@ class Aggregate(PlanNode):
         return (self.source,)
 
     def output_symbols(self):
+        if self.step == "partial":
+            out = list(self.keys)
+            for a in self.aggs:
+                out.extend(name for name, _ in a.accumulator_schema())
+            return out
         return list(self.keys) + [a.output for a in self.aggs]
 
     def output_types(self):
         src = self.source.output_types()
         out = {k: src[k] for k in self.keys}
+        if self.step == "partial":
+            for a in self.aggs:
+                out.update(dict(a.accumulator_schema()))
+            return out
         for a in self.aggs:
             out[a.output] = a.output_type
         return out
@@ -364,6 +409,23 @@ class Output(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class RemoteSource(PlanNode):
+    """RemoteSourceNode: reads the output of another fragment's tasks over
+    the exchange (operator/ExchangeOperator.java:44 pulling via
+    DirectExchangeClient.java:56)."""
+
+    fragment_id: int
+    symbols: Tuple[str, ...]
+    types_: Tuple[Tuple[str, T.Type], ...]
+
+    def output_symbols(self):
+        return list(self.symbols)
+
+    def output_types(self):
+        return dict(self.types_)
+
+
+@dataclasses.dataclass(frozen=True)
 class Exchange(PlanNode):
     """ExchangeNode (distribution boundary; added by the optimizer's
     AddExchanges analog). partitioning: 'single' gathers everything,
@@ -420,6 +482,8 @@ def plan_to_string(node: PlanNode) -> str:
             )
         elif isinstance(n, Exchange):
             extra = f" {n.partitioning} keys={list(n.keys)}"
+        elif isinstance(n, RemoteSource):
+            extra = f" fragment={n.fragment_id}"
         elif isinstance(n, Output):
             extra = f" {list(n.names)}"
         lines.append(f"{pad}{name}{extra}")

@@ -28,7 +28,10 @@ from typing import Dict, Optional
 
 from ..page import Page
 from ..session import Session
+from ..sql import ast
+from ..sql.parser import parse
 from . import protocol
+from .discovery import HeartbeatFailureDetector, NodeManager
 
 PAGE_ROWS = 4096
 
@@ -53,12 +56,24 @@ class QueryExecution:
 
 
 class Coordinator:
-    def __init__(self, session: Session, workers: int = 4):
+    def __init__(
+        self,
+        session: Session,
+        workers: int = 4,
+        distributed: bool = False,
+    ):
         self.session = session
         self.queries: Dict[str, QueryExecution] = {}
         self.pool = ThreadPoolExecutor(max_workers=workers)
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
+        self.distributed = distributed
+        self.node_manager = NodeManager() if distributed else None
+        self.failure_detector = (
+            HeartbeatFailureDetector(self.node_manager).start()
+            if distributed
+            else None
+        )
 
     # -- lifecycle ------------------------------------------------------
     def submit(self, sql: str) -> QueryExecution:
@@ -73,7 +88,7 @@ class Coordinator:
                 return
             q.state = "PLANNING"
         try:
-            page = self.session.execute(q.sql)
+            page = self._execute(q)
             with q.lock:
                 q.page = page
                 q.types = [c.type for c in page.columns]
@@ -84,6 +99,33 @@ class Coordinator:
                 q.error = f"{type(e).__name__}: {e}"
                 q.state = "FAILED"
                 q.finished = time.time()
+
+    def _execute(self, q: QueryExecution) -> Page:
+        """Distributed mode routes plain queries through the fragment
+        scheduler over announced workers (SqlQueryExecution.planDistribution
+        -> PipelinedQueryScheduler); utility statements and worker-less
+        clusters run in-process (coordinator-only execution)."""
+        if self.distributed:
+            stmt = parse(q.sql)
+            if isinstance(stmt, ast.Query):
+                from .scheduler import DistributedScheduler, SchedulerError
+
+                workers = self.node_manager.alive()
+                if not workers:
+                    raise SchedulerError(
+                        "NO_NODES_AVAILABLE: no alive workers to schedule on"
+                    )
+                plan = self.session._plan_stmt(stmt)
+                with q.lock:
+                    q.state = "RUNNING"
+                sched = DistributedScheduler(
+                    self.session.catalogs,
+                    workers,
+                    {"group_capacity":
+                     self.session.properties.get("group_capacity")},
+                )
+                return sched.run(plan, q.query_id)
+        return self.session.execute(q.sql)
 
     def cancel(self, query_id: str):
         q = self.queries.get(query_id)
@@ -149,6 +191,14 @@ class _Handler(BaseHTTPRequestHandler):
             sql = self.rfile.read(n).decode()
             q = self.coordinator.submit(sql)
             self._json(200, self.coordinator.results_doc(q, 0))
+        elif self.path == "/v1/announcement":
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n))
+            if self.coordinator.node_manager is not None:
+                self.coordinator.node_manager.announce(
+                    doc["nodeId"], doc["uri"]
+                )
+            self._json(202, {})
         else:
             self._json(404, {"error": "not found"})
 
@@ -216,8 +266,8 @@ class _Handler(BaseHTTPRequestHandler):
 class CoordinatorServer:
     """In-process server handle (TestingTrinoServer analog)."""
 
-    def __init__(self, session: Session, port: int = 0):
-        self.coordinator = Coordinator(session)
+    def __init__(self, session: Session, port: int = 0, distributed: bool = False):
+        self.coordinator = Coordinator(session, distributed=distributed)
         handler = type("Handler", (_Handler,), {"coordinator": self.coordinator})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
@@ -229,6 +279,8 @@ class CoordinatorServer:
 
     def stop(self):
         self.httpd.shutdown()
+        if self.coordinator.failure_detector is not None:
+            self.coordinator.failure_detector.stop()
 
     @property
     def uri(self) -> str:

@@ -1,0 +1,111 @@
+"""Discovery + heartbeat failure detection (coordinator side).
+
+Reference parity: airlift discovery announcements maintained by
+DiscoveryNodeManager plus active HTTP heartbeats with an exponentially
+decayed failure ratio in failuredetector/HeartbeatFailureDetector.java:76
+(ping:344, failureRatio:377 vs threshold) — failed nodes are removed from
+scheduling until they recover.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Tuple
+
+ANNOUNCEMENT_TTL = 5.0
+NODE_EXPIRY = 30.0  # forget nodes silent this long (restart churn cleanup)
+FAILURE_RATIO_THRESHOLD = 0.5
+DECAY = 0.7  # EMA weight of history per heartbeat
+
+
+class NodeState:
+    def __init__(self, node_id: str, uri: str):
+        self.node_id = node_id
+        self.uri = uri
+        self.last_announced = time.time()
+        self.failure_ratio = 0.0
+        self.last_ping_ok = True
+
+
+class NodeManager:
+    """Tracks announced workers and their health."""
+
+    def __init__(self):
+        self.nodes: Dict[str, NodeState] = {}
+        self.lock = threading.Lock()
+
+    def announce(self, node_id: str, uri: str):
+        with self.lock:
+            n = self.nodes.get(node_id)
+            if n is None:
+                n = NodeState(node_id, uri)
+                self.nodes[node_id] = n
+            n.uri = uri
+            n.last_announced = time.time()
+
+    def record_ping(self, node_id: str, ok: bool):
+        with self.lock:
+            n = self.nodes.get(node_id)
+            if n is not None:
+                n.failure_ratio = DECAY * n.failure_ratio + (1 - DECAY) * (
+                    0.0 if ok else 1.0
+                )
+                n.last_ping_ok = ok
+
+    def alive(self) -> List[Tuple[str, str]]:
+        """(node_id, uri) of schedulable workers, stable order."""
+        now = time.time()
+        with self.lock:
+            out = [
+                (n.node_id, n.uri)
+                for n in self.nodes.values()
+                if now - n.last_announced < ANNOUNCEMENT_TTL
+                and n.failure_ratio < FAILURE_RATIO_THRESHOLD
+            ]
+        return sorted(out)
+
+    def all_nodes(self) -> List[NodeState]:
+        """Live view for the heartbeat loop; prunes long-dead entries so
+        restart churn (fresh node ids per restart) doesn't accumulate."""
+        now = time.time()
+        with self.lock:
+            dead = [
+                nid
+                for nid, n in self.nodes.items()
+                if now - n.last_announced > NODE_EXPIRY
+            ]
+            for nid in dead:
+                del self.nodes[nid]
+            return list(self.nodes.values())
+
+
+class HeartbeatFailureDetector:
+    """Actively pings every announced worker's /v1/info."""
+
+    def __init__(self, nodes: NodeManager, interval: float = 0.25):
+        self.nodes = nodes
+        self.interval = interval
+        self._stop = threading.Event()
+        self.thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self) -> "HeartbeatFailureDetector":
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            for n in self.nodes.all_nodes():
+                ok = True
+                try:
+                    with urllib.request.urlopen(
+                        f"{n.uri}/v1/info", timeout=1.0
+                    ) as resp:
+                        ok = resp.status == 200
+                except Exception:
+                    ok = False
+                self.nodes.record_ping(n.node_id, ok)
+            self._stop.wait(self.interval)

@@ -1,0 +1,341 @@
+"""Worker server: task API, fragment execution, output buffers, announcer.
+
+Reference parity:
+  - POST /v1/task/{taskId} create-or-update with fragment+splits+buffers
+    (server/TaskResource.java:136 -> SqlTaskManager.updateTask:479)
+  - GET  /v1/task/{taskId} task status (TaskState.java:21 states)
+  - GET  /v1/task/{taskId}/results/{bufferId}/{token} page pull with
+    long-poll + completion marker (TaskResource:  getResults; served from
+    OutputBuffer variants — here: per-buffer lists of serialized frames)
+  - DELETE /v1/task/{taskId} abort
+  - worker announcement to the coordinator's discovery endpoint
+    (airlift discovery "trino" service announcements, DiscoveryNodeManager)
+  - fault injection hook (execution/FailureInjector.java:39,61 wired into
+    TaskResource.injectFailure:183): POST /v1/task/{taskId}/fail before the
+    task exists makes its creation fail once (task-retry testing).
+
+Execution: each task runs on its own thread; the fragment compiles/executes
+as one XLA program (exec/fragment_exec.py); output pages are hash/broadcast
+partitioned into buffers (exec/partitioner.py) and served as binary frames.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from ..catalog import CatalogManager
+from ..exec.exchange_client import ExchangeClient, RemoteTaskError
+from ..exec.fragment_exec import FragmentExecutor
+from ..exec.partitioner import chunk_page, partition_page
+from ..page import Page
+from ..serde import decode_value, plan_from_json, serialize_page
+from ..spi import Split
+
+TASK_STATES = (
+    "PLANNED", "RUNNING", "FLUSHING", "FINISHED", "CANCELED", "ABORTED",
+    "FAILED",
+)
+
+
+class TaskExecution:
+    """One task: fragment + splits + output buffers (SqlTask analog)."""
+
+    def __init__(self, task_id: str, doc: dict):
+        self.task_id = task_id
+        self.doc = doc
+        self.state = "PLANNED"
+        self.error: Optional[str] = None
+        # buffer id -> list of serialized page frames
+        self.buffers: Dict[int, List[bytes]] = {}
+        self.complete = False
+        self.lock = threading.Lock()
+        self.created = time.time()
+
+
+class TaskManager:
+    """Executes tasks against this worker's catalogs (SqlTaskManager)."""
+
+    def __init__(self, catalogs: CatalogManager):
+        self.catalogs = catalogs
+        self.tasks: Dict[str, TaskExecution] = {}
+        self.injected_failures: Dict[str, str] = {}
+        self.lock = threading.Lock()
+
+    def create_or_update(self, task_id: str, doc: dict) -> TaskExecution:
+        with self.lock:
+            t = self.tasks.get(task_id)
+            if t is not None:
+                return t  # idempotent re-POST (HttpRemoteTask retries)
+            t = TaskExecution(task_id, doc)
+            self.tasks[task_id] = t
+        threading.Thread(target=self._run, args=(t,), daemon=True).start()
+        return t
+
+    def inject_failure(self, task_id: str, mode: str):
+        with self.lock:
+            self.injected_failures[task_id] = mode
+
+    def abort(self, task_id: str):
+        t = self.tasks.get(task_id)
+        if t:
+            with t.lock:
+                if t.state not in ("FINISHED", "FAILED"):
+                    t.state = "ABORTED"
+                    t.error = "task aborted"
+
+    def delete(self, task_id: str):
+        self.abort(task_id)
+        with self.lock:
+            self.tasks.pop(task_id, None)
+
+    # ------------------------------------------------------------------
+    def _run(self, t: TaskExecution):
+        with t.lock:
+            if t.state != "PLANNED":
+                return
+            t.state = "RUNNING"
+        try:
+            with self.lock:
+                mode = self.injected_failures.pop(t.task_id, None)
+            if mode is not None:
+                raise RuntimeError(f"injected task failure ({mode})")
+            doc = t.doc
+            plan = plan_from_json(doc["fragment"])
+            splits_by_scan: Dict[int, List[Split]] = {}
+            for k, sps in (doc.get("splits") or {}).items():
+                splits_by_scan[int(k)] = [decode_value(s) for s in sps]
+            sources = doc.get("sources") or {}
+            client = ExchangeClient()
+            remote_pages = client.fetch_sources(
+                {int(fid): list(locs) for fid, locs in sources.items()}
+            )
+            with t.lock:
+                if t.state == "ABORTED":
+                    return
+            config = dict(doc.get("properties") or {})
+            ex = FragmentExecutor(
+                self.catalogs, config, splits_by_scan, remote_pages
+            )
+            page = ex.execute(plan)
+            out = doc.get("output") or {}
+            part = out.get("partitioning", "single")
+            nbuffers = int(out.get("nbuffers", 1))
+            keys = list(out.get("keys") or [])
+            if part == "hash" and nbuffers > 1:
+                parts = partition_page(page, keys, nbuffers)
+            else:
+                # single and broadcast: everything in buffer 0 (broadcast
+                # consumers all read buffer 0 — BroadcastOutputBuffer)
+                parts = [page]
+            with t.lock:
+                if t.state == "ABORTED":
+                    return
+                t.state = "FLUSHING"
+                for bid, p in enumerate(parts):
+                    t.buffers[bid] = [
+                        serialize_page(c) for c in chunk_page(p)
+                    ]
+                for bid in range(len(parts), nbuffers):
+                    t.buffers[bid] = []
+                t.complete = True
+                t.state = "FINISHED"
+        except Exception as e:  # propagated to consumers + coordinator
+            with t.lock:
+                if t.state != "ABORTED":
+                    t.state = "FAILED"
+                    if isinstance(e, RemoteTaskError):
+                        t.error = str(e)
+                    else:
+                        t.error = f"{type(e).__name__}: {e}"
+                    t.traceback = traceback.format_exc()
+
+
+class _WorkerHandler(BaseHTTPRequestHandler):
+    worker: "WorkerServer" = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, doc: dict, headers: Optional[dict] = None):
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _binary(self, code: int, body: bytes, headers: dict):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ------------------------------------------------------------------
+    def do_POST(self):
+        parts = self.path.strip("/").split("/")
+        tm = self.worker.task_manager
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n))
+            t = tm.create_or_update(parts[2], doc)
+            self._json(200, {"taskId": t.task_id, "state": t.state})
+            return
+        if (
+            len(parts) == 4
+            and parts[:2] == ["v1", "task"]
+            and parts[3] == "fail"
+        ):
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n) or b"{}")
+            tm.inject_failure(parts[2], doc.get("mode", "TASK_FAILURE"))
+            self._json(200, {"injected": parts[2]})
+            return
+        self._json(404, {"error": "not found"})
+
+    def do_GET(self):
+        parts = self.path.strip("/").split("/")
+        w = self.worker
+        if self.path == "/v1/info":
+            self._json(200, {
+                "nodeId": w.node_id,
+                "nodeVersion": {"version": "trino-tpu 0.1"},
+                "environment": "tpu",
+                "coordinator": False,
+                "uptime": f"{time.time() - w.started:.0f}s",
+            })
+            return
+        if self.path == "/v1/status":
+            self._json(200, {
+                "nodeId": w.node_id,
+                "activeTasks": sum(
+                    1
+                    for t in w.task_manager.tasks.values()
+                    if t.state in ("PLANNED", "RUNNING", "FLUSHING")
+                ),
+                "totalTasks": len(w.task_manager.tasks),
+            })
+            return
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            t = w.task_manager.tasks.get(parts[2])
+            if t is None:
+                self._json(404, {"error": "no such task"})
+                return
+            self._json(200, {
+                "taskId": t.task_id,
+                "state": t.state,
+                "error": t.error,
+            })
+            return
+        if len(parts) == 6 and parts[:2] == ["v1", "task"] and parts[3] == "results":
+            self._serve_results(parts[2], int(parts[4]), int(parts[5]))
+            return
+        self._json(404, {"error": "not found"})
+
+    def _serve_results(self, task_id: str, buffer_id: int, token: int):
+        """Long-poll page pull (HttpPageBufferClient GET)."""
+        w = self.worker
+        deadline = time.time() + 1.0
+        while True:
+            t = w.task_manager.tasks.get(task_id)
+            if t is None:
+                self._json(404, {"error": "no such task"})
+                return
+            with t.lock:
+                state = t.state
+                if state == "FAILED" or state == "ABORTED":
+                    err = (t.error or "task failed").encode()
+                    self._binary(410, err, {"X-Task-State": state})
+                    return
+                if t.complete:
+                    frames = t.buffers.get(buffer_id, [])
+                    if token < len(frames):
+                        body = frames[token]
+                        last = token + 1 >= len(frames)
+                        self._binary(200, body, {
+                            "X-Task-State": state,
+                            "X-Next-Token": str(token + 1),
+                            "X-Buffer-Complete": "true" if last else "false",
+                        })
+                    else:
+                        self._binary(200, b"", {
+                            "X-Task-State": state,
+                            "X-Buffer-Complete": "true",
+                        })
+                    return
+            if time.time() > deadline:
+                self._binary(204, b"", {"X-Task-State": state})
+                return
+            time.sleep(0.01)
+
+    def do_DELETE(self):
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            self.worker.task_manager.delete(parts[2])
+            self._json(200, {})
+            return
+        self._json(404, {"error": "not found"})
+
+
+class WorkerServer:
+    """One worker node (TestingTrinoServer worker-role analog)."""
+
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        coordinator_uri: Optional[str] = None,
+        port: int = 0,
+        announce_interval: float = 0.25,
+    ):
+        self.node_id = f"worker-{uuid.uuid4().hex[:8]}"
+        self.task_manager = TaskManager(catalogs)
+        self.started = time.time()
+        handler = type("Handler", (_WorkerHandler,), {"worker": self})
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        self.port = self.httpd.server_address[1]
+        self.thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+        self.coordinator_uri = coordinator_uri
+        self.announce_interval = announce_interval
+        self._stop = threading.Event()
+        self.announcer = threading.Thread(target=self._announce_loop, daemon=True)
+
+    @property
+    def uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "WorkerServer":
+        self.thread.start()
+        if self.coordinator_uri:
+            self.announcer.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self.httpd.shutdown()
+
+    # ------------------------------------------------------------------
+    def _announce_loop(self):
+        body = json.dumps({"nodeId": self.node_id, "uri": self.uri}).encode()
+        while not self._stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    f"{self.coordinator_uri}/v1/announcement",
+                    data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=2.0).read()
+            except Exception:
+                pass  # coordinator not up yet / transient
+            self._stop.wait(self.announce_interval)

@@ -1,0 +1,3 @@
+from .runner import DistributedQueryRunner
+
+__all__ = ["DistributedQueryRunner"]

@@ -1,0 +1,101 @@
+"""DistributedQueryRunner: N servers in one process with real transport.
+
+Reference parity: testing/trino-testing/.../DistributedQueryRunner.java:94 —
+one coordinator + N workers as real HTTP servers on ephemeral ports in a
+single process, real discovery announcements, real page exchanges; the
+standard way the reference tests its multi-node story (SURVEY §4).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..catalog import CatalogManager
+from ..client.client import StatementClient
+from ..connectors.blackhole import BlackholeConnectorFactory
+from ..connectors.memory import MemoryConnectorFactory
+from ..connectors.tpcds import TpcdsConnectorFactory
+from ..connectors.tpch import TpchConnectorFactory
+from ..server.coordinator import CoordinatorServer
+from ..server.worker import WorkerServer
+from ..session import Session
+
+DEFAULT_CATALOGS: Tuple[Tuple[str, str, dict], ...] = (
+    ("tpch", "tpch", {"tpch.scale-factor": 0.01}),
+)
+
+
+def _build_catalogs(catalogs: Sequence[Tuple[str, str, dict]]) -> CatalogManager:
+    cm = CatalogManager()
+    cm.register_factory(TpchConnectorFactory())
+    cm.register_factory(TpcdsConnectorFactory())
+    cm.register_factory(MemoryConnectorFactory())
+    cm.register_factory(BlackholeConnectorFactory())
+    for name, connector, config in catalogs:
+        cm.create_catalog(name, connector, config)
+    return cm
+
+
+class DistributedQueryRunner:
+    """Coordinator + N workers, all in-process, real HTTP between them."""
+
+    def __init__(
+        self,
+        workers: int = 2,
+        catalogs: Sequence[Tuple[str, str, dict]] = DEFAULT_CATALOGS,
+        properties: Optional[dict] = None,
+        startup_timeout: float = 10.0,
+    ):
+        self.session = Session(config=properties)
+        for name, connector, config in catalogs:
+            self.session.create_catalog(name, connector, config)
+        self.coordinator = CoordinatorServer(
+            self.session, distributed=True
+        ).start()
+        self.workers: List[WorkerServer] = []
+        for _ in range(workers):
+            w = WorkerServer(
+                _build_catalogs(catalogs), self.coordinator.uri
+            ).start()
+            self.workers.append(w)
+        self._wait_for_workers(workers, startup_timeout)
+        self.client = StatementClient(self.coordinator.uri)
+
+    def _wait_for_workers(self, n: int, timeout: float):
+        deadline = time.time() + timeout
+        nm = self.coordinator.coordinator.node_manager
+        while time.time() < deadline:
+            if len(nm.alive()) >= n:
+                return
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"only {len(nm.alive())}/{n} workers announced in {timeout}s"
+        )
+
+    # ------------------------------------------------------------------
+    def execute(self, sql: str):
+        """Returns (columns, rows) via the real statement protocol."""
+        return self.client.execute(sql)
+
+    def rows(self, sql: str) -> List[tuple]:
+        _, rows = self.execute(sql)
+        return [tuple(r) for r in rows]
+
+    def alive_workers(self) -> int:
+        return len(self.coordinator.coordinator.node_manager.alive())
+
+    def kill_worker(self, index: int = -1) -> WorkerServer:
+        w = self.workers.pop(index)
+        w.stop()
+        return w
+
+    def stop(self):
+        for w in self.workers:
+            w.stop()
+        self.coordinator.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
