@@ -1,0 +1,149 @@
+"""Spill (out-of-core aggregation) + dynamic filtering tests.
+
+Reference parity: spiller/ + MemoryRevokingScheduler (spill under memory
+pressure; TestSpilledAggregations role) and DynamicFilterService /
+LocalDynamicFiltersCollector (build-domain scan pruning).
+"""
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.exec.dynamic_filter import collect_dynamic_filters
+from trino_tpu.exec.fragment_exec import FragmentExecutor
+from trino_tpu.page import page_from_pydict
+from trino_tpu.plan import nodes as P
+from trino_tpu.session import tpch_session
+from trino_tpu.utils.memory import ExceededMemoryLimitError
+
+SF = 0.001
+
+Q1ISH = (
+    "select l_returnflag, l_linestatus, sum(l_quantity) as sq, "
+    "count(*) as c, avg(l_extendedprice) as ae, min(l_tax) as mn, "
+    "max(l_discount) as mx from lineitem "
+    "where l_shipdate <= date '1998-09-02' "
+    "group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus"
+)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    s = tpch_session(SF)
+    return s.execute(Q1ISH).to_pylist()
+
+
+def test_spilled_aggregation_matches_in_memory(baseline):
+    # tight limit forces split-batched partial aggregation with host merge
+    s = tpch_session(SF, query_max_memory_bytes=100_000)
+    got = s.execute(Q1ISH).to_pylist()
+    assert got == baseline
+
+
+def test_spill_plan_detection():
+    from trino_tpu.exec.spill import plan_spill
+
+    s = tpch_session(SF)
+    plan = s.plan(Q1ISH)
+    ex = s._executor()
+    sp = plan_spill(ex, plan, 100_000)
+    assert sp is not None
+    agg, scan, splits, batch = sp
+    assert scan.table == "lineitem"
+    assert len(splits) > 1
+    # generous limit: no spill
+    assert plan_spill(ex, plan, 8 << 30) is None
+
+
+def test_non_spillable_query_exceeds_limit():
+    s = tpch_session(SF, query_max_memory_bytes=50_000)
+    with pytest.raises(ExceededMemoryLimitError):
+        s.execute(
+            "select l_orderkey, l_partkey from lineitem order by l_orderkey"
+        )
+
+
+def test_spill_disabled_enforces_limit():
+    s = tpch_session(
+        SF, query_max_memory_bytes=100_000, spill_enabled=False
+    )
+    with pytest.raises(ExceededMemoryLimitError):
+        s.execute(Q1ISH)
+
+
+# ---------------------------------------------------------------------------
+# dynamic filtering
+# ---------------------------------------------------------------------------
+
+
+def _probe_plan_with_remote_build(session):
+    """Scan lineitem(l_partkey, l_quantity) inner-joined to a remote build
+    side of part keys — the worker-side shape of a distributed broadcast
+    join fragment."""
+    conn = session.catalogs.get("tpch")
+    schema = conn.metadata().get_table_schema("lineitem")
+    scan = P.TableScan(
+        "tpch",
+        "lineitem",
+        (("l_partkey", "l_partkey"), ("l_quantity", "l_quantity")),
+        (
+            ("l_partkey", schema.column_type("l_partkey")),
+            ("l_quantity", schema.column_type("l_quantity")),
+        ),
+    )
+    rs = P.RemoteSource(7, ("p_partkey",), (("p_partkey", T.BIGINT),))
+    join = P.Join("inner", scan, rs, (("l_partkey", "p_partkey"),))
+    syms = tuple(join.output_symbols())
+    return P.Output(join, syms, syms)
+
+
+def test_dynamic_filter_collection_and_pruning():
+    s = tpch_session(SF)
+    plan = _probe_plan_with_remote_build(s)
+    build = page_from_pydict(
+        [("p_partkey", T.BIGINT)], {"p_partkey": [1, 2, 3]}
+    )
+    remote = {7: [build]}
+    dfs = collect_dynamic_filters(plan, remote)
+    assert (0, "l_partkey") in dfs
+    d = dfs[(0, "l_partkey")][0]
+    assert d.lo == 1 and d.hi == 3
+
+    conn = s.catalogs.get("tpch")
+    splits = conn.split_manager().get_splits("lineitem", 1)
+    ex = FragmentExecutor(s.catalogs, {}, {0: splits}, remote, dfs)
+    page = ex.execute(plan)
+    assert ex.df_rows_pruned > 0
+    # every surviving probe key is in the build domain
+    keys = set(r[0] for r in page.to_pylist())
+    assert keys <= {1, 2, 3}
+    # result matches the unpruned execution
+    ex2 = FragmentExecutor(s.catalogs, {}, {0: splits}, remote)
+    assert sorted(page.to_pylist()) == sorted(ex2.execute(plan).to_pylist())
+    assert ex2.df_rows_pruned == 0
+
+
+def test_dynamic_filter_not_applied_to_left_join():
+    s = tpch_session(SF)
+    plan = _probe_plan_with_remote_build(s)
+    join = plan.source
+    left_join = P.Join("left", join.left, join.right, join.criteria)
+    plan2 = P.Output(left_join, plan.names, plan.symbols)
+    dfs = collect_dynamic_filters(
+        plan2,
+        {7: [page_from_pydict([("p_partkey", T.BIGINT)],
+                              {"p_partkey": [1]})]},
+    )
+    assert dfs == {}
+
+
+def test_dynamic_filter_empty_build_prunes_all():
+    s = tpch_session(SF)
+    plan = _probe_plan_with_remote_build(s)
+    build = page_from_pydict([("p_partkey", T.BIGINT)], {"p_partkey": []})
+    remote = {7: [build]}
+    dfs = collect_dynamic_filters(plan, remote)
+    conn = s.catalogs.get("tpch")
+    splits = conn.split_manager().get_splits("lineitem", 1)
+    ex = FragmentExecutor(s.catalogs, {}, {0: splits}, remote, dfs)
+    page = ex.execute(plan)
+    assert page.count == 0

@@ -71,6 +71,16 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "scan splits per table (0 = one per device)",
             int, 0,
         ),
+        PropertyMetadata(
+            "spill_enabled",
+            "allow out-of-core execution when input exceeds the memory limit",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "dynamic_filtering",
+            "prune probe-side scans with build-side join domains",
+            _bool, True,
+        ),
     ]
 }
 

@@ -102,10 +102,14 @@ class FragmentExecutor(LocalExecutor):
         config: Optional[dict],
         splits_by_scan: Dict[int, List[Split]],
         remote_pages: Dict[int, List[Page]],
+        dynamic_filters: Optional[Dict] = None,
     ):
         super().__init__(catalogs, config)
         self.splits_by_scan = splits_by_scan
         self.remote_pages = remote_pages
+        # {(scan_preorder_index, symbol): [Domain]} from exec/dynamic_filter
+        self.dynamic_filters = dynamic_filters or {}
+        self.df_rows_pruned = 0
 
     # ------------------------------------------------------------------
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
@@ -120,6 +124,7 @@ class FragmentExecutor(LocalExecutor):
             # assigned splits
             self._load_one_scan(node, self.splits_by_scan.get(idx, []),
                                 scans, dicts, counts)
+            self._apply_dynamic_filters(node, idx, scans, dicts, counts)
             return
         if isinstance(node, P.RemoteSource):
             pages = self.remote_pages.get(node.fragment_id, [])
@@ -131,3 +136,38 @@ class FragmentExecutor(LocalExecutor):
             return
         for s in node.sources:
             self._load_walk(s, scans, dicts, counts)
+
+    def _apply_dynamic_filters(self, node, scan_idx, scans, dicts, counts):
+        """Prune loaded scan rows by build-side domains before padding —
+        the DynamicFilter-SPI pushdown point (rows never reach HBM tiles)."""
+        doms_by_sym = {
+            sym: doms
+            for (i, sym), doms in self.dynamic_filters.items()
+            if i == scan_idx
+        }
+        if not doms_by_sym:
+            return
+        arrays = scans[id(node)]
+        n = counts[id(node)]
+        if n == 0:
+            return
+        keep = np.ones(n, bool)
+        for sym, doms in doms_by_sym.items():
+            v, ok = arrays[sym]
+            m = np.ones(n, bool)
+            for d in doms:
+                m &= d.keep_mask(v[:n], dicts.get(sym))
+            if ok is not None:
+                m &= ok[:n]  # NULL keys never match an inner equi-join
+            keep &= m
+        kept = int(keep.sum())
+        if kept == n:
+            return
+        self.df_rows_pruned += n - kept
+        idx = np.nonzero(keep)[0]
+        for sym, (v, ok) in arrays.items():
+            arrays[sym] = (
+                v[:n][idx],
+                None if ok is None else ok[:n][idx],
+            )
+        counts[id(node)] = kept

@@ -69,42 +69,60 @@ class LocalExecutor:
         self.catalogs = catalogs
         self.metadata = Metadata(catalogs)
         self.config = config or {}
+        self.query_id = str(self.config.get("query_id", "query"))
+        self.scan_bytes = 0
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
         assert isinstance(plan, P.Output)
+        # out-of-core path: when the estimated scan working set exceeds the
+        # memory limit and the plan allows it, aggregate in split batches
+        # (MemoryRevokingScheduler -> spill, host RAM as the spill tier)
+        limit = self.config.get("memory_limit_bytes")
+        if limit and self.config.get("spill_enabled", True):
+            from . import spill
+
+            sp = spill.plan_spill(self, plan, int(limit))
+            if sp is not None:
+                return spill.execute_spilled_aggregation(self, plan, *sp)
         # 1. host side: load scans, collect dictionaries
         scans: Dict[int, Dict[str, np.ndarray]] = {}
         dicts: Dict[str, np.ndarray] = {}
         counts: Dict[int, int] = {}
         self._load_scans(plan, scans, dicts, counts)
-        self.dicts = dicts
-        self.group_capacity = int(
-            self.config.get("group_capacity", DEFAULT_GROUP_CAPACITY)
-        )
-        self.join_factor = 1
+        self._account_memory(scans, limit)
+        pool = self.config.get("memory_pool")
+        try:
+            self.dicts = dicts
+            self.group_capacity = int(
+                self.config.get("group_capacity", DEFAULT_GROUP_CAPACITY)
+            )
+            self.join_factor = 1
 
-        for attempt in range(5):
-            ctx = self.trace_ctx_cls(self, scans, counts)
-            out_lanes, sel, ordered, checks = self._run(plan, ctx)
-            for join_node, dup in ctx.dup_checks:
-                if int(dup) > 0:
-                    raise ExecutionError(
-                        "join build side has duplicate keys (many-to-many "
-                        f"join not yet supported): {join_node.criteria}"
-                    )
-            overflow = False
-            for ngroups, cap in checks:
-                if int(ngroups) > cap:
-                    overflow = True
-            if not overflow:
-                break
-            self.group_capacity *= 8
-            self.join_factor *= 8
-        else:
-            raise ExecutionError("group capacity overflow after retries")
+            for attempt in range(5):
+                ctx = self.trace_ctx_cls(self, scans, counts)
+                out_lanes, sel, ordered, checks = self._run(plan, ctx)
+                for join_node, dup in ctx.dup_checks:
+                    if int(dup) > 0:
+                        raise ExecutionError(
+                            "join build side has duplicate keys (many-to-many "
+                            f"join not yet supported): {join_node.criteria}"
+                        )
+                overflow = False
+                for ngroups, cap in checks:
+                    if int(ngroups) > cap:
+                        overflow = True
+                if not overflow:
+                    break
+                self.group_capacity *= 8
+                self.join_factor *= 8
+            else:
+                raise ExecutionError("group capacity overflow after retries")
 
-        return self._materialize(plan, out_lanes, sel, ordered)
+            return self._materialize(plan, out_lanes, sel, ordered)
+        finally:
+            if pool is not None:
+                pool.free(self.query_id, self.scan_bytes)
 
     # ------------------------------------------------------------------
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
@@ -115,6 +133,27 @@ class LocalExecutor:
             return
         for s in node.sources:
             self._load_scans(s, scans, dicts, counts)
+
+    def _account_memory(self, scans, limit):
+        """Reserve the scan working set against the pool and enforce the
+        per-query limit (MemoryPool.reserve + ExceededMemoryLimitException).
+        Scan arrays dominate this engine's footprint; kernel temporaries are
+        proportional and covered by the limit's headroom."""
+        from ..utils.memory import ExceededMemoryLimitError
+
+        total = 0
+        for arrays in scans.values():
+            for v, ok in arrays.values():
+                total += int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
+        self.scan_bytes = total
+        if limit and total > int(limit):
+            raise ExceededMemoryLimitError(
+                f"query exceeded memory limit: scan working set {total} "
+                f"> {limit} bytes (and plan is not spillable)"
+            )
+        pool = self.config.get("memory_pool")
+        if pool is not None:
+            pool.reserve(self.query_id, total)  # freed after materialize
 
     def _load_one_scan(self, node: P.TableScan, splits, scans, dicts, counts):
         """Load the given splits of one scan into host arrays (shared by
@@ -316,8 +355,8 @@ class _TraceCtx:
             )
             for a in node.aggs
         ]
-        final = node.step == "final"
-        partial = node.step == "partial"
+        final = node.step in ("final", "intermediate")  # merges accumulators
+        partial = node.step in ("partial", "intermediate")  # emits them
 
         def reduce_rows(lanes, gid, sel, cap):
             if final:

@@ -1,0 +1,200 @@
+"""Out-of-core (spilled) aggregation: split-batched partials merged on host.
+
+Reference parity: spiller/ (FileSingleStreamSpiller feeding
+SpillableHashAggregationBuilder -> MergingHashAggregationBuilder) triggered
+by memory/MemoryRevokingScheduler.java:47 when revocable memory exceeds the
+pool.  The reference serializes agg-builder state to local disk and merges
+sorted runs; the TPU-native analog keeps HBM as the scarce tier and *host
+RAM as the spill target* (SURVEY §7 step 7): scan splits are processed in
+batches sized to the memory limit, each batch's PARTIAL aggregation output
+(small accumulator pages) is retained on the host, and one final
+FINAL/INTERMEDIATE merge runs over the concatenated partial pages.
+
+The same partial/final kernels used by the distributed exchange do the
+merging, so spill shares its correctness surface with multi-node execution.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Tuple
+
+from ..plan import nodes as P
+
+SPILL_SOURCE_ID = -1  # RemoteSource id for in-process spilled partials
+SAFETY_FACTOR = 4  # batch working-set headroom under the limit
+
+
+def find_spillable_aggregate(
+    plan: P.Output,
+) -> Optional[Tuple[P.Aggregate, P.TableScan]]:
+    """Match a plan whose (unique) Aggregate sits over a pure scan chain
+    (Filter/Project only down to one TableScan) and is partializable.
+    Anything above the Aggregate is fine — it runs after the merge."""
+    found: List[P.Aggregate] = []
+
+    def find_agg(n: P.PlanNode):
+        if isinstance(n, P.Aggregate):
+            found.append(n)
+            return
+        for s in n.sources:
+            find_agg(s)
+
+    find_agg(plan)
+    if len(found) != 1:
+        return None
+    agg = found[0]
+    if agg.step not in ("single", "partial"):
+        return None
+    if not all(a.partializable for a in agg.aggs):
+        return None
+    node = agg.source
+    while isinstance(node, (P.Filter, P.Project)):
+        node = node.source
+    if not isinstance(node, P.TableScan):
+        return None
+    # the aggregate's scan must be the plan's only scan: the rewritten plan
+    # replaces the whole scan chain, so remaining scans would lose their
+    # split assignment
+    nscans = [0]
+
+    def count_scans(n: P.PlanNode):
+        if isinstance(n, P.TableScan):
+            nscans[0] += 1
+        for s in n.sources:
+            count_scans(s)
+
+    count_scans(plan)
+    if nscans[0] != 1:
+        return None
+    return agg, node
+
+
+def scan_row_bytes(scan: P.TableScan) -> int:
+    return sum(t.np_dtype.itemsize + 1 for _, t in scan.types)
+
+
+def _replace_aggregate(
+    node: P.PlanNode, agg: P.Aggregate, replacement: P.PlanNode
+) -> P.PlanNode:
+    if node is agg:
+        return replacement
+    new_sources = tuple(
+        _replace_aggregate(s, agg, replacement) for s in node.sources
+    )
+    if all(a is b for a, b in zip(new_sources, node.sources)):
+        return node
+    import dataclasses
+
+    if isinstance(node, P.SetOperation):
+        return dataclasses.replace(node, inputs=new_sources)
+    # other plan nodes hold their sources as individual PlanNode fields in
+    # declaration order matching .sources
+    updates = {}
+    src_iter = iter(new_sources)
+    for f in dataclasses.fields(node):
+        if isinstance(getattr(node, f.name), P.PlanNode):
+            updates[f.name] = next(src_iter)
+    return dataclasses.replace(node, **updates)
+
+
+def execute_spilled_aggregation(
+    executor,  # LocalExecutor or FragmentExecutor (late import cycle)
+    plan: P.Output,
+    agg: P.Aggregate,
+    scan: P.TableScan,
+    splits: List,
+    batch_size: int,
+):
+    """Run the scan->partial-agg pipeline per split batch, keep partial
+    pages on host, then run the rewritten plan (Aggregate replaced by a
+    merge over the spilled partials)."""
+    from .fragment_exec import FragmentExecutor
+
+    partial = P.Aggregate(agg.source, agg.keys, agg.aggs, "partial")
+    syms = tuple(partial.output_symbols())
+    partial_plan = P.Output(partial, syms, syms)
+
+    # the plan's only scan is preorder index 0 in both the original fragment
+    # and the partial subplan, so collected dynamic filters carry over
+    dyn_filters = getattr(executor, "dynamic_filters", None)
+    orig_remote = dict(getattr(executor, "remote_pages", {}) or {})
+
+    partial_pages = []
+    rows_pruned = 0
+    scan_bytes = 0
+    batch_config = dict(executor.config)
+    batch_config.pop("memory_limit_bytes", None)  # batches are pre-sized
+    for start in range(0, max(len(splits), 1), batch_size):
+        batch = splits[start : start + batch_size]
+        sub = FragmentExecutor(
+            executor.catalogs, batch_config, {0: batch}, orig_remote,
+            dyn_filters,
+        )
+        partial_pages.append(sub.execute(partial_plan))
+        rows_pruned += sub.df_rows_pruned
+        scan_bytes += sub.scan_bytes
+
+    merged_step = "final" if agg.step == "single" else "intermediate"
+    rs = P.RemoteSource(
+        SPILL_SOURCE_ID, syms, tuple(partial.output_types().items())
+    )
+    merged = P.Aggregate(rs, agg.keys, agg.aggs, merged_step)
+    rewritten = _replace_aggregate(plan, agg, merged)
+
+    # the rewritten plan has no TableScan (single-scan precondition) but may
+    # still hold RemoteSources above the aggregate (e.g. a broadcast build
+    # side of a join over the agg) — keep the fragment's original pages
+    merged_remote = dict(orig_remote)
+    merged_remote[SPILL_SOURCE_ID] = partial_pages
+    final_ex = FragmentExecutor(
+        executor.catalogs, batch_config, {}, merged_remote
+    )
+    page = final_ex.execute(rewritten)
+    # surface batch stats on the outer executor (task info reporting)
+    executor.df_rows_pruned = rows_pruned
+    executor.scan_bytes = scan_bytes
+    return page
+
+
+def plan_spill(
+    executor,
+    plan: P.Output,
+    memory_limit: int,
+) -> Optional[Tuple[P.Aggregate, P.TableScan, List, int]]:
+    """Decide whether to spill: returns (agg, scan, splits, batch_size) when
+    the estimated scan working set exceeds the limit (the same threshold
+    _account_memory enforces) and the plan shape allows out-of-core
+    aggregation.  Batches are sized to limit/SAFETY_FACTOR so each batch
+    plus kernel temporaries stays under the limit."""
+    match = find_spillable_aggregate(plan)
+    if match is None:
+        return None
+    agg, scan = match
+    conn = executor.catalogs.get(scan.catalog)
+    est_table = conn.metadata().get_table_statistics(
+        scan.table
+    ).row_count * scan_row_bytes(scan)
+    batch_budget = max(memory_limit // SAFETY_FACTOR, 1)
+
+    splits_map: Dict[int, List] = getattr(executor, "splits_by_scan", None)
+    if splits_map is not None:
+        # fragment executor: this task's assigned splits of the (single,
+        # preorder-index-0) scan
+        splits = splits_map.get(0, [])
+        if not splits:
+            return None
+        est = est_table * len(splits) / max(splits[0].total, 1)
+        if est <= memory_limit:
+            return None
+        per_split = est / len(splits)
+        batch = max(1, int(batch_budget / max(per_split, 1)))
+        if batch >= len(splits):
+            return None
+        return agg, scan, splits, batch
+    if est_table <= memory_limit:
+        return None
+    nbatches = math.ceil(est_table / batch_budget)
+    splits = conn.split_manager().get_splits(scan.table, nbatches)
+    if len(splits) <= 1:
+        return None
+    return agg, scan, splits, max(1, len(splits) // nbatches)

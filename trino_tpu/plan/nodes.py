@@ -147,14 +147,17 @@ class Aggregate(PlanNode):
     source: PlanNode
     keys: Tuple[str, ...]
     aggs: Tuple[AggInfo, ...]
-    step: str = "single"  # single | partial | final
+    # single | partial | final | intermediate (AggregationNode.java:346-351;
+    # intermediate merges partial states and re-emits accumulator columns —
+    # the out-of-core/spill merge step)
+    step: str = "single"
 
     @property
     def sources(self):
         return (self.source,)
 
     def output_symbols(self):
-        if self.step == "partial":
+        if self.step in ("partial", "intermediate"):
             out = list(self.keys)
             for a in self.aggs:
                 out.extend(name for name, _ in a.accumulator_schema())
@@ -164,7 +167,7 @@ class Aggregate(PlanNode):
     def output_types(self):
         src = self.source.output_types()
         out = {k: src[k] for k in self.keys}
-        if self.step == "partial":
+        if self.step in ("partial", "intermediate"):
             for a in self.aggs:
                 out.update(dict(a.accumulator_schema()))
             return out

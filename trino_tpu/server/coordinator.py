@@ -118,11 +118,17 @@ class Coordinator:
                 plan = self.session._plan_stmt(stmt)
                 with q.lock:
                     q.state = "RUNNING"
+                props = self.session.properties
                 sched = DistributedScheduler(
                     self.session.catalogs,
                     workers,
-                    {"group_capacity":
-                     self.session.properties.get("group_capacity")},
+                    {
+                        "group_capacity": props.get("group_capacity"),
+                        "memory_limit_bytes":
+                            props.get("query_max_memory_bytes"),
+                        "spill_enabled": props.get("spill_enabled"),
+                        "dynamic_filtering": props.get("dynamic_filtering"),
+                    },
                 )
                 return sched.run(plan, q.query_id)
         return self.session.execute(q.sql)

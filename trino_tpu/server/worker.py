@@ -56,6 +56,7 @@ class TaskExecution:
         self.complete = False
         self.lock = threading.Lock()
         self.created = time.time()
+        self.stats: Dict[str, int] = {}
 
 
 class TaskManager:
@@ -119,10 +120,19 @@ class TaskManager:
                 if t.state == "ABORTED":
                     return
             config = dict(doc.get("properties") or {})
+            dfs = None
+            if config.get("dynamic_filtering", True):
+                from ..exec.dynamic_filter import collect_dynamic_filters
+
+                dfs = collect_dynamic_filters(plan, remote_pages)
             ex = FragmentExecutor(
-                self.catalogs, config, splits_by_scan, remote_pages
+                self.catalogs, config, splits_by_scan, remote_pages, dfs
             )
             page = ex.execute(plan)
+            t.stats = {
+                "dynamicFilterRowsPruned": ex.df_rows_pruned,
+                "scanBytes": ex.scan_bytes,
+            }
             out = doc.get("output") or {}
             part = out.get("partitioning", "single")
             nbuffers = int(out.get("nbuffers", 1))
@@ -235,6 +245,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 "taskId": t.task_id,
                 "state": t.state,
                 "error": t.error,
+                "stats": t.stats,
             })
             return
         if len(parts) == 6 and parts[:2] == ["v1", "task"] and parts[3] == "results":

@@ -66,8 +66,16 @@ class Session:
 
     # ------------------------------------------------------------------
     def _executor(self):
+        # SET SESSION query_max_memory_bytes resizes the pool for later
+        # queries (the pool object is shared; only its budget moves)
+        self.memory_pool.size = self.properties.get("query_max_memory_bytes")
         exec_config = {
             "group_capacity": self.properties.get("group_capacity"),
+            "memory_limit_bytes": self.properties.get(
+                "query_max_memory_bytes"
+            ),
+            "spill_enabled": self.properties.get("spill_enabled"),
+            "memory_pool": self.memory_pool,
         }
         if self.properties.get("distributed"):
             from .parallel.mesh_executor import MeshExecutor, default_mesh
