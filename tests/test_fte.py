@@ -1,0 +1,146 @@
+"""Fault-tolerant execution (retry-policy=TASK) tests.
+
+Reference parity: testing/trino-faulttolerant-tests +
+BaseFailureRecoveryTest.java:76 — inject task failures at specific points
+and assert queries still succeed under the task-retry policy; stage outputs
+ride the spooled exchange (trino-exchange-filesystem role).
+"""
+import json
+import sqlite3
+import urllib.request
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from tpch_sql import QUERIES, oracle_dialect
+from trino_tpu.exchange.filesystem import SpoolHandle, read_spool_pages
+from trino_tpu.page import page_from_pydict
+from trino_tpu.serde import serialize_page
+from trino_tpu.server.fte import FaultTolerantScheduler
+from trino_tpu.server.scheduler import SchedulerError
+from trino_tpu.sql.parser import parse
+from trino_tpu import types as T
+from trino_tpu.testing import DistributedQueryRunner
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = DistributedQueryRunner(
+        workers=2,
+        catalogs=(("tpch", "tpch", {"tpch.scale-factor": SF}),),
+        properties={"retry_policy": "task"},
+    )
+    yield r
+    r.stop()
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(
+        conn, SF,
+        ["region", "nation", "customer", "orders", "lineitem", "supplier",
+         "part", "partsupp"],
+    )
+    return conn
+
+
+def test_spool_roundtrip(tmp_path):
+    page = page_from_pydict(
+        [("a", T.BIGINT), ("b", T.VARCHAR)],
+        {"a": [1, 2, None], "b": ["x", None, "y"]},
+    )
+    h = SpoolHandle(str(tmp_path / "t0.0"))
+    assert not h.committed
+    h.write_buffers({0: [serialize_page(page)]})
+    assert h.committed
+    back = read_spool_pages(h.buffer_file(0))
+    assert len(back) == 1
+    assert back[0].to_pylist() == page.to_pylist()
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 6, 12])
+def test_tpch_fte_matches_oracle(runner, oracle_conn, qnum):
+    sql, oracle_sql, ordered, skip = QUERIES[qnum]
+    if skip:
+        pytest.skip(skip)
+    _, rows = runner.execute(sql)
+    expected = oracle_conn.execute(
+        oracle_sql or oracle_dialect(sql)
+    ).fetchall()
+    assert_rows_match(
+        [tuple(r) for r in rows], expected, tol=2e-2, ordered=ordered
+    )
+
+
+def _inject(uri: str, task_id: str):
+    req = urllib.request.Request(
+        f"{uri}/v1/task/{task_id}/fail",
+        data=json.dumps({"mode": "TASK_FAILURE"}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=5.0).read()
+
+
+def test_task_retry_recovers_from_injected_failure(runner, oracle_conn):
+    """Attempt 0 of a stage-1 task fails on every worker; attempt 1 runs
+    elsewhere and the query still succeeds (BaseFailureRecoveryTest)."""
+    nm = runner.coordinator.coordinator.node_manager
+    fte = FaultTolerantScheduler(
+        runner.session.catalogs, nm,
+        properties={"group_capacity": 4096},
+    )
+    qid = "q_fte_inject"
+    for _, uri in nm.alive():
+        _inject(uri, f"{qid}.1.0.0")  # fragment 1, task 0, attempt 0
+    sql = ("select l_returnflag, count(*) c from lineitem "
+           "group by l_returnflag order by l_returnflag")
+    plan = runner.session._plan_stmt(parse(sql))
+    page = fte.run(plan, qid)
+    expected = oracle_conn.execute(oracle_dialect(sql)).fetchall()
+    assert_rows_match(page.to_pylist(), expected, tol=2e-2, ordered=True)
+
+
+def test_query_fails_after_max_attempts(runner):
+    nm = runner.coordinator.coordinator.node_manager
+    fte = FaultTolerantScheduler(
+        runner.session.catalogs, nm,
+        properties={"group_capacity": 4096},
+    )
+    qid = "q_fte_exhaust"
+    # poison every attempt of stage-1 task 0 on every worker
+    for _, uri in nm.alive():
+        for attempt in range(4):
+            _inject(uri, f"{qid}.1.0.{attempt}")
+    plan = runner.session._plan_stmt(
+        parse("select count(*) from lineitem")
+    )
+    with pytest.raises(SchedulerError) as exc:
+        fte.run(plan, qid)
+    assert "after 4 attempts" in str(exc.value)
+
+
+def test_fte_survives_worker_death(runner, oracle_conn):
+    """A worker dying between queries is tolerated: the next FTE query
+    re-picks placement from the alive set."""
+    import time
+    from trino_tpu.server.worker import WorkerServer
+    from trino_tpu.testing.runner import _build_catalogs
+
+    w = WorkerServer(
+        _build_catalogs((("tpch", "tpch", {"tpch.scale-factor": SF}),)),
+        runner.coordinator.uri,
+    ).start()
+    nm = runner.coordinator.coordinator.node_manager
+    deadline = time.time() + 10
+    while time.time() < deadline and len(nm.alive()) < 3:
+        time.sleep(0.05)
+    w.stop()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(nm.alive()) > 2:
+        time.sleep(0.05)
+    sql = "select count(*) from orders"
+    _, rows = runner.execute(sql)
+    assert [tuple(r) for r in rows] == [(1500,)]

@@ -28,6 +28,13 @@ def _bool(s: str) -> bool:
     raise ValueError(f"not a boolean: {s}")
 
 
+def _retry_policy(s: str) -> str:
+    v = str(s).strip().lower()
+    if v not in ("none", "task"):
+        raise ValueError(f"retry_policy must be none|task, got: {s}")
+    return v
+
+
 SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
     p.name: p
     for p in [
@@ -80,6 +87,11 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "dynamic_filtering",
             "prune probe-side scans with build-side join domains",
             _bool, True,
+        ),
+        PropertyMetadata(
+            "retry_policy",
+            "failure recovery: none (pipelined) | task (FTE over spool)",
+            _retry_policy, "none",
         ),
     ]
 }

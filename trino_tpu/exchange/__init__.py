@@ -1,0 +1,3 @@
+from .filesystem import FileSystemExchangeManager, SpoolHandle
+
+__all__ = ["FileSystemExchangeManager", "SpoolHandle"]

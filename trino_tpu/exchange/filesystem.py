@@ -1,0 +1,89 @@
+"""Filesystem exchange spool for fault-tolerant execution.
+
+Reference parity: spi/exchange/ExchangeManager.java implemented by
+plugin/trino-exchange-filesystem (FileSystemExchangeManager) — stage outputs
+are spooled to durable shared storage so failed tasks can be retried without
+re-running their upstreams, and duplicate attempt output is excluded by
+construction: spool paths are addressed by (query, stage, task, attempt) and
+only the attempt the scheduler committed is ever handed to consumers (the
+role of DeduplicatingDirectExchangeBuffer.java:87 / ExchangeSourceOutputSelector).
+
+Layout: {base}/{query_id}/{fragment_id}/{task_index}.{attempt}/
+          buffer_{id}.bin   — length-prefixed page frames
+          _COMMIT           — marker written after all buffers are complete
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import struct
+import tempfile
+from typing import Dict, List, Optional
+
+from ..page import Page
+from ..serde import deserialize_page
+
+
+class SpoolHandle:
+    """One task attempt's spool directory (ExchangeSinkInstanceHandle)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write_buffers(self, buffers: Dict[int, List[bytes]]):
+        os.makedirs(self.path, exist_ok=True)
+        for bid, frames in buffers.items():
+            tmp = os.path.join(self.path, f".buffer_{bid}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(struct.pack("<I", len(frames)))
+                for fr in frames:
+                    f.write(struct.pack("<I", len(fr)))
+                    f.write(fr)
+            os.replace(tmp, os.path.join(self.path, f"buffer_{bid}.bin"))
+        # commit marker makes the attempt visible to the scheduler
+        with open(os.path.join(self.path, "_COMMIT"), "wb"):
+            pass
+
+    @property
+    def committed(self) -> bool:
+        return os.path.exists(os.path.join(self.path, "_COMMIT"))
+
+    def buffer_file(self, buffer_id: int) -> str:
+        return os.path.join(self.path, f"buffer_{buffer_id}.bin")
+
+
+def read_spool_pages(path: str) -> List[Page]:
+    """Read one committed buffer file back into pages."""
+    with open(path, "rb") as f:
+        data = f.read()
+    (n,) = struct.unpack_from("<I", data, 0)
+    off = 4
+    pages = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<I", data, off)
+        off += 4
+        pages.append(deserialize_page(data[off : off + ln]))
+        off += ln
+    return pages
+
+
+class FileSystemExchangeManager:
+    """Creates per-(query, fragment, task, attempt) spool handles."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        self.base = base_dir or os.path.join(
+            tempfile.gettempdir(), "trino_tpu_exchange"
+        )
+
+    def sink(
+        self, query_id: str, fragment_id: int, task_index: int, attempt: int
+    ) -> SpoolHandle:
+        return SpoolHandle(
+            os.path.join(
+                self.base, query_id, str(fragment_id),
+                f"{task_index}.{attempt}",
+            )
+        )
+
+    def cleanup_query(self, query_id: str):
+        shutil.rmtree(os.path.join(self.base, query_id), ignore_errors=True)

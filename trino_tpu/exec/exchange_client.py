@@ -86,27 +86,27 @@ class ExchangeClient:
     def fetch_sources(
         self, sources: Dict[int, List[dict]]
     ) -> Dict[int, List[Page]]:
-        """sources: fragment_id -> [{uri, task, buffer}, ...]."""
+        """sources: fragment_id -> list of locations, each either a live
+        task buffer {uri, task, buffer} (pipelined mode) or a committed
+        spool file {path} (fault-tolerant mode)."""
         out: Dict[int, List[Page]] = {}
         flat = [
             (fid, loc) for fid, locs in sources.items() for loc in locs
         ]
         if not flat:
             return out
+
+        def fetch(loc: dict) -> List[Page]:
+            if "path" in loc:
+                from ..exchange.filesystem import read_spool_pages
+
+                return read_spool_pages(loc["path"])
+            return _fetch_buffer(
+                loc["uri"], loc["task"], int(loc["buffer"]), self.timeout
+            )
+
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
-            futures = [
-                (
-                    fid,
-                    pool.submit(
-                        _fetch_buffer,
-                        loc["uri"],
-                        loc["task"],
-                        int(loc["buffer"]),
-                        self.timeout,
-                    ),
-                )
-                for fid, loc in flat
-            ]
+            futures = [(fid, pool.submit(fetch, loc)) for fid, loc in flat]
             for fid, fut in futures:
                 out.setdefault(fid, []).extend(fut.result())
         return out

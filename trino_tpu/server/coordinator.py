@@ -119,16 +119,23 @@ class Coordinator:
                 with q.lock:
                     q.state = "RUNNING"
                 props = self.session.properties
+                task_props = {
+                    "group_capacity": props.get("group_capacity"),
+                    "memory_limit_bytes":
+                        props.get("query_max_memory_bytes"),
+                    "spill_enabled": props.get("spill_enabled"),
+                    "dynamic_filtering": props.get("dynamic_filtering"),
+                }
+                if props.get("retry_policy") == "task":
+                    from .fte import FaultTolerantScheduler
+
+                    fte = FaultTolerantScheduler(
+                        self.session.catalogs, self.node_manager,
+                        properties=task_props,
+                    )
+                    return fte.run(plan, q.query_id)
                 sched = DistributedScheduler(
-                    self.session.catalogs,
-                    workers,
-                    {
-                        "group_capacity": props.get("group_capacity"),
-                        "memory_limit_bytes":
-                            props.get("query_max_memory_bytes"),
-                        "spill_enabled": props.get("spill_enabled"),
-                        "dynamic_filtering": props.get("dynamic_filtering"),
-                    },
+                    self.session.catalogs, workers, task_props
                 )
                 return sched.run(plan, q.query_id)
         return self.session.execute(q.sql)

@@ -1,0 +1,232 @@
+"""Fault-tolerant (task-retry) query scheduler over the spooled exchange.
+
+Reference parity: execution/scheduler/faulttolerant/
+EventDrivenFaultTolerantQueryScheduler.java:199 — stage-by-stage execution
+where every stage's output is spooled to durable storage (Exchange SPI /
+trino-exchange-filesystem), failed task attempts are re-scheduled on other
+alive workers, and consumers only ever read the spool paths of attempts the
+scheduler committed (structural dedup of duplicate attempt output — the
+DeduplicatingDirectExchangeBuffer / ExchangeSourceOutputSelector role).
+
+Differences from the pipelined scheduler (scheduler.py): stages run with a
+barrier between producer and consumer (no streaming overlap), so a worker
+death or injected task failure only costs the retried task, never the query
+(retry-policy=TASK).  Worker loss between stages is tolerated by re-picking
+placement from the currently-alive node set per attempt.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..catalog import CatalogManager
+from ..exchange.filesystem import FileSystemExchangeManager, read_spool_pages
+from ..exec.partitioner import concat_pages
+from ..page import Page
+from ..plan import nodes as P
+from ..plan.fragment import HASH, SINGLE, SOURCE, PlanFragment, fragment_plan
+from ..serde import encode_value, plan_to_json
+from .scheduler import (
+    SchedulerError,
+    _post_json,
+    assign_splits,
+    source_buffer_index,
+)
+
+MAX_ATTEMPTS = 4
+POLL_INTERVAL = 0.02
+TASK_TIMEOUT = 300.0
+POLL_FAILURE_TOLERANCE = 3  # consecutive status-poll errors = worker lost
+
+
+class FaultTolerantScheduler:
+    """retry-policy=TASK execution of one query."""
+
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        node_manager,
+        exchange: Optional[FileSystemExchangeManager] = None,
+        properties: Optional[dict] = None,
+    ):
+        self.catalogs = catalogs
+        self.node_manager = node_manager
+        self.exchange = exchange or FileSystemExchangeManager()
+        self.properties = properties or {}
+
+    # ------------------------------------------------------------------
+    def run(self, plan: P.Output, query_id: Optional[str] = None) -> Page:
+        query_id = query_id or f"q_{uuid.uuid4().hex[:12]}"
+        fragments = fragment_plan(plan)
+        by_id = {f.id: f for f in fragments}
+        consumer: Dict[int, int] = {}
+        for f in fragments:
+            for sf in f.source_fragments:
+                consumer[sf] = f.id
+
+        # stage width is fixed up-front (task count = buffer addressing),
+        # but *placement* is re-chosen per attempt from the alive set
+        width: Dict[int, int] = {}
+        cluster = self.node_manager.alive()
+        if not cluster:
+            raise SchedulerError("NO_NODES_AVAILABLE: no alive workers")
+        for f in fragments:
+            width[f.id] = len(cluster) if f.partitioning in (SOURCE, HASH) else 1
+
+        # committed spool dirs: fragment -> [task_index -> SpoolHandle path]
+        committed: Dict[int, List[str]] = {}
+        self._created_tasks: List[Tuple[str, str]] = []  # (uri, task_id)
+        try:
+            order = sorted(
+                (f for f in fragments if f.id != 0), key=lambda f: f.id
+            ) + [by_id[0]]
+            for f in order:
+                committed[f.id] = self._run_stage(
+                    query_id, f, width, committed, by_id, consumer
+                )
+            root_pages = read_spool_pages(
+                committed[0][0] + "/buffer_0.bin"
+            )
+            if not root_pages:
+                raise SchedulerError("root stage produced no pages")
+            return concat_pages(root_pages)
+        finally:
+            # abort + delete every attempt on the workers (frees task state;
+            # abandoned attempts stop before re-creating spool dirs)
+            for uri, task_id in self._created_tasks:
+                try:
+                    req = urllib.request.Request(
+                        f"{uri}/v1/task/{task_id}", method="DELETE"
+                    )
+                    urllib.request.urlopen(req, timeout=5.0).read()
+                except Exception:
+                    pass
+            self.exchange.cleanup_query(query_id)
+
+    # ------------------------------------------------------------------
+    def _sources_for(
+        self,
+        f: PlanFragment,
+        task_index: int,
+        committed: Dict[int, List[str]],
+        by_id: Dict[int, PlanFragment],
+    ) -> Dict[str, list]:
+        """Spool-file locations of the committed upstream attempts (same
+        buffer routing as the pipelined scheduler, different location shape)."""
+        sources: Dict[str, list] = {}
+        for sf in f.source_fragments:
+            src = by_id[sf]
+            sources[str(sf)] = [
+                {
+                    "path": f"{path}/buffer_"
+                    f"{source_buffer_index(src, task_index)}.bin"
+                }
+                for path in committed[sf]
+            ]
+        return sources
+
+    def _run_stage(
+        self,
+        query_id: str,
+        f: PlanFragment,
+        width: Dict[int, int],
+        committed: Dict[int, List[str]],
+        by_id: Dict[int, PlanFragment],
+        consumer: Dict[int, int],
+    ) -> List[str]:
+        ntasks = width[f.id]
+        out_buffers = (
+            width[consumer[f.id]] if f.output_partitioning == HASH else 1
+        )
+        per_task_splits = assign_splits(self.catalogs, f, ntasks)
+        frag_json = plan_to_json(f.root)
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(ntasks, 1)) as pool:
+            futures = [
+                pool.submit(
+                    self._run_task_with_retries,
+                    query_id, f, i, frag_json, per_task_splits[i],
+                    out_buffers, committed, by_id,
+                )
+                for i in range(ntasks)
+            ]
+            return [fut.result() for fut in futures]
+
+    def _run_task_with_retries(
+        self, query_id, f, task_index, frag_json, splits, out_buffers,
+        committed, by_id,
+    ) -> str:
+        last_error = None
+        for attempt in range(MAX_ATTEMPTS):
+            workers = self.node_manager.alive()
+            if not workers:
+                raise SchedulerError("NO_NODES_AVAILABLE during retry")
+            node_id, uri = workers[(task_index + attempt) % len(workers)]
+            sink = self.exchange.sink(query_id, f.id, task_index, attempt)
+            task_id = f"{query_id}.{f.id}.{task_index}.{attempt}"
+            doc = {
+                "fragment": frag_json,
+                "splits": {
+                    str(k): [encode_value(s) for s in v]
+                    for k, v in splits.items()
+                },
+                "output": {
+                    "partitioning": f.output_partitioning,
+                    "keys": list(f.output_keys),
+                    "nbuffers": out_buffers,
+                },
+                "sources": self._sources_for(
+                    f, task_index, committed, by_id
+                ),
+                "properties": self.properties,
+                "spool_path": sink.path,
+            }
+            try:
+                _post_json(f"{uri}/v1/task/{task_id}", doc)
+                self._created_tasks.append((uri, task_id))
+                self._await_task(uri, task_id)
+                if not sink.committed:
+                    raise SchedulerError(
+                        f"task {task_id} finished without committing spool"
+                    )
+                return sink.path
+            except Exception as e:
+                last_error = e
+                continue  # next attempt on another worker
+        raise SchedulerError(
+            f"task {query_id}.{f.id}.{task_index} failed after "
+            f"{MAX_ATTEMPTS} attempts: {last_error}"
+        )
+
+    def _await_task(self, uri: str, task_id: str):
+        deadline = time.time() + TASK_TIMEOUT
+        consecutive_failures = 0
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    f"{uri}/v1/task/{task_id}", timeout=5.0
+                ) as resp:
+                    doc = json.loads(resp.read())
+                consecutive_failures = 0
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                # tolerate transient poll blips (a stalled worker thread is
+                # not a dead worker); ContinuousTaskStatusFetcher backoff
+                consecutive_failures += 1
+                if consecutive_failures >= POLL_FAILURE_TOLERANCE:
+                    raise SchedulerError(f"worker {uri} lost: {e}")
+                time.sleep(0.2)
+                continue
+            state = doc.get("state")
+            if state == "FINISHED":
+                return
+            if state in ("FAILED", "ABORTED", "CANCELED"):
+                raise SchedulerError(
+                    f"task {task_id} {state}: {doc.get('error')}"
+                )
+            time.sleep(POLL_INTERVAL)
+        raise SchedulerError(f"task {task_id} timed out")

@@ -62,6 +62,32 @@ def _post_json(url: str, doc: dict, timeout: float = 30.0):
         return json.loads(resp.read() or b"{}")
 
 
+def assign_splits(
+    catalogs: CatalogManager, f: PlanFragment, ntasks: int
+) -> List[Dict[int, list]]:
+    """Round-robin split placement over a stage's tasks (NodeScheduler /
+    UniformNodeSelector role); single-task fragments scan everything.
+    Shared by the pipelined and fault-tolerant schedulers."""
+    per_task: List[Dict[int, list]] = [dict() for _ in range(ntasks)]
+    for scan_idx, (catalog, table) in f.scan_tables.items():
+        conn = catalogs.get(catalog)
+        if f.partitioning == SOURCE:
+            desired = max(ntasks * SPLITS_PER_NODE, 1)
+            splits = conn.split_manager().get_splits(table, desired)
+            for i, sp in enumerate(splits):
+                per_task[i % ntasks].setdefault(scan_idx, []).append(sp)
+        else:
+            splits = conn.split_manager().get_splits(table, 1)
+            per_task[0].setdefault(scan_idx, []).extend(splits)
+    return per_task
+
+
+def source_buffer_index(src_frag: PlanFragment, task_index: int) -> int:
+    """Which producer buffer a consumer task reads: its own index for hash
+    repartitioning, buffer 0 for single/broadcast output."""
+    return task_index if src_frag.output_partitioning == HASH else 0
+
+
 class DistributedScheduler:
     """Schedules one query's fragments onto the alive workers."""
 
@@ -149,19 +175,7 @@ class DistributedScheduler:
         by_id: Dict[int, PlanFragment],
     ) -> List[TaskHandle]:
         n = len(workers)
-        # split assignment (NodeScheduler round-robin over alive workers)
-        splits_per_task: List[Dict[int, list]] = [dict() for _ in range(n)]
-        for scan_idx, (catalog, table) in f.scan_tables.items():
-            conn = self.catalogs.get(catalog)
-            if f.partitioning == SOURCE:
-                desired = max(n * SPLITS_PER_NODE, 1)
-                splits = conn.split_manager().get_splits(table, desired)
-                for i, sp in enumerate(splits):
-                    splits_per_task[i % n].setdefault(scan_idx, []).append(sp)
-            else:
-                # single-task fragments scan everything locally
-                splits = conn.split_manager().get_splits(table, 1)
-                splits_per_task[0].setdefault(scan_idx, []).extend(splits)
+        splits_per_task = assign_splits(self.catalogs, f, n)
 
         frag_json = plan_to_json(f.root)
         handles: List[TaskHandle] = []
@@ -170,15 +184,14 @@ class DistributedScheduler:
             sources: Dict[str, list] = {}
             for sf in f.source_fragments:
                 src_frag = by_id[sf]
-                locs = []
-                for up in tasks[sf]:
-                    if src_frag.output_partitioning == HASH:
-                        buffer = i
-                    else:  # single or broadcast: buffer 0
-                        buffer = 0
-                    locs.append(
-                        {"uri": up.uri, "task": up.task_id, "buffer": buffer}
-                    )
+                locs = [
+                    {
+                        "uri": up.uri,
+                        "task": up.task_id,
+                        "buffer": source_buffer_index(src_frag, i),
+                    }
+                    for up in tasks[sf]
+                ]
                 sources[str(sf)] = locs
             doc = {
                 "fragment": frag_json,

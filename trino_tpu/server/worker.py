@@ -153,6 +153,19 @@ class TaskManager:
                     ]
                 for bid in range(len(parts), nbuffers):
                     t.buffers[bid] = []
+            spool_path = doc.get("spool_path")
+            if spool_path:
+                # FTE mode: durable spool instead of in-memory serving
+                # (spi/exchange ExchangeSink; survives this worker's death).
+                # Consumers read only the spool, so drop the RAM copy.
+                from ..exchange.filesystem import SpoolHandle
+
+                SpoolHandle(spool_path).write_buffers(t.buffers)
+                with t.lock:
+                    t.buffers = {}
+            with t.lock:
+                if t.state == "ABORTED":
+                    return
                 t.complete = True
                 t.state = "FINISHED"
         except Exception as e:  # propagated to consumers + coordinator
