@@ -59,7 +59,7 @@ class BlackholeConnector(Connector):
         conn = self
 
         class SM(SplitManager):
-            def get_splits(self, table, desired):
+            def get_splits(self, table, desired, constraint=None):
                 k = max(1, desired)
                 return [Split(table, i, k) for i in range(k)]
 

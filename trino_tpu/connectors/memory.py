@@ -54,7 +54,7 @@ class MemorySplitManager(SplitManager):
     def __init__(self, store: _Store):
         self.store = store
 
-    def get_splits(self, table: str, desired: int) -> List[Split]:
+    def get_splits(self, table: str, desired: int, constraint=None) -> List[Split]:
         return [Split(table, 0, 1)]
 
 

@@ -335,7 +335,7 @@ class TpcdsSplitManager(SplitManager):
     def __init__(self, sf):
         self.sf = sf
 
-    def get_splits(self, table, desired):
+    def get_splits(self, table, desired, constraint=None):
         n = _counts(self.sf)[table]
         k = max(1, min(desired, (n + 65535) // 65536))
         return [Split(table, i, k) for i in range(k)]

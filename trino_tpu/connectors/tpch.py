@@ -659,7 +659,7 @@ class TpchSplitManager(SplitManager):
     def __init__(self, sf: float):
         self.sf = sf
 
-    def get_splits(self, table: str, desired: int) -> List[Split]:
+    def get_splits(self, table: str, desired: int, constraint=None) -> List[Split]:
         n = _counts(self.sf)["orders" if table == "lineitem" else table]
         # honor the engine's desired parallelism down to 512-row splits so
         # multi-node tests exercise real split distribution at tiny SF

@@ -22,68 +22,12 @@ from ..catalog import CatalogManager
 from ..page import Page
 from ..plan import nodes as P
 from ..spi import Split
-from .local import ExecutionError, LocalExecutor, _TraceCtx
-
-
-def merge_pages_to_arrays(
-    pages: List[Page], symbols, types, dicts: Dict[str, np.ndarray]
-) -> Tuple[Dict[str, tuple], int]:
-    """Concatenate remote pages column-wise; merge varchar dictionaries
-    (remapping codes) when producers shipped different ones."""
-    tmap = dict(types)
-    merged: Dict[str, tuple] = {}
-    total = sum(p.count for p in pages)
-    for sym in symbols:
-        t = tmap[sym]
-        vals_parts: List[np.ndarray] = []
-        ok_parts: List[np.ndarray] = []
-        if t.is_dictionary:
-            index: Dict[str, int] = {}
-            entries: List[str] = []
-            for p in pages:
-                if p.count == 0:
-                    continue
-                col = p.by_name(sym)
-                d = col.dictionary
-                codes = np.asarray(col.values)[: p.count]
-                if d is None:
-                    raise ExecutionError(f"remote varchar {sym} without dict")
-                remap = np.empty(len(d), dtype=np.int32)
-                for i, s in enumerate(d):
-                    s = str(s)
-                    if s not in index:
-                        index[s] = len(entries)
-                        entries.append(s)
-                    remap[i] = index[s]
-                safe = np.clip(codes, 0, max(len(d) - 1, 0))
-                vals_parts.append(
-                    np.where(codes >= 0, remap[safe], -1).astype(np.int32)
-                )
-                ok_parts.append(
-                    np.ones(p.count, bool)
-                    if col.validity is None
-                    else np.asarray(col.validity)[: p.count]
-                )
-            dicts[sym] = np.array(entries, dtype=object)
-        else:
-            for p in pages:
-                if p.count == 0:
-                    continue
-                col = p.by_name(sym)
-                vals_parts.append(np.asarray(col.values)[: p.count])
-                ok_parts.append(
-                    np.ones(p.count, bool)
-                    if col.validity is None
-                    else np.asarray(col.validity)[: p.count]
-                )
-        if vals_parts:
-            vals = np.concatenate(vals_parts)
-            ok = np.concatenate(ok_parts)
-        else:
-            vals = np.zeros(0, dtype=t.np_dtype)
-            ok = np.zeros(0, dtype=bool)
-        merged[sym] = (vals, None if ok.all() else ok)
-    return merged, total
+from .local import (
+    ExecutionError,
+    LocalExecutor,
+    _TraceCtx,
+    merge_pages_to_arrays,
+)
 
 
 class _FragmentTraceCtx(_TraceCtx):
@@ -131,6 +75,9 @@ class FragmentExecutor(LocalExecutor):
             merged, total = merge_pages_to_arrays(
                 pages, node.symbols, node.types_, dicts
             )
+            for s, t in node.types_:
+                if t.is_dictionary and s not in dicts:
+                    dicts[s] = np.array([], dtype=object)
             scans[id(node)] = merged
             counts[id(node)] = total
             return

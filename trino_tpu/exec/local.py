@@ -60,6 +60,88 @@ def _pad_capacity(n: int) -> int:
     return max(128, ((n + 127) // 128) * 128)
 
 
+def merge_pages_to_arrays(pages, symbols, types, dicts):
+    """Concatenate pages column-wise into host arrays; varchar dictionaries
+    from different producers (splits / exchange tasks) are merged with codes
+    remapped (the cross-task DictionaryBlock unification).  Fast path: when
+    every page shares one dictionary (the common same-connector case) codes
+    pass through untouched."""
+    tmap = dict(types)
+    merged = {}
+    total = sum(p.count for p in pages)
+    for sym in symbols:
+        t = tmap[sym]
+        vals_parts: List[np.ndarray] = []
+        ok_parts: List[np.ndarray] = []
+        live = [p for p in pages if p.count > 0]
+        if t.is_dictionary:
+            page_dicts = []
+            for p in live:
+                d = p.by_name(sym).dictionary
+                if d is None:
+                    raise ExecutionError(f"varchar column {sym} without dict")
+                page_dicts.append(d)
+            shared = True
+            for d in page_dicts[1:]:
+                if d is not page_dicts[0] and not np.array_equal(
+                    page_dicts[0], d
+                ):
+                    shared = False
+                    break
+            if shared:
+                dicts[sym] = (
+                    page_dicts[0]
+                    if page_dicts
+                    else np.array([], dtype=object)
+                )
+                for p in live:
+                    col = p.by_name(sym)
+                    vals_parts.append(
+                        np.asarray(col.values)[: p.count].astype(np.int32)
+                    )
+                    ok_parts.append(_valid_of(col, p.count))
+            else:
+                index: Dict[str, int] = {}
+                entries: List[str] = []
+                for p, d in zip(live, page_dicts):
+                    col = p.by_name(sym)
+                    codes = np.asarray(col.values)[: p.count]
+                    remap = np.empty(len(d), dtype=np.int32)
+                    for i, s in enumerate(d):
+                        s = str(s)
+                        if s not in index:
+                            index[s] = len(entries)
+                            entries.append(s)
+                        remap[i] = index[s]
+                    safe = np.clip(codes, 0, max(len(d) - 1, 0))
+                    vals_parts.append(
+                        np.where(codes >= 0, remap[safe], -1).astype(np.int32)
+                    )
+                    ok_parts.append(_valid_of(col, p.count))
+                dicts[sym] = np.array(entries, dtype=object)
+        else:
+            for p in live:
+                col = p.by_name(sym)
+                vals_parts.append(np.asarray(col.values)[: p.count])
+                ok_parts.append(_valid_of(col, p.count))
+        if vals_parts:
+            vals = np.concatenate(vals_parts)
+            ok = np.concatenate(ok_parts)
+        else:
+            vals = np.zeros(0, dtype=t.np_dtype)
+            ok = np.zeros(0, dtype=bool)
+        merged[sym] = (vals, None if ok.all() else ok)
+    return merged, total
+
+
+def _valid_of(col: Column, n: int) -> np.ndarray:
+    return (
+        np.ones(n, bool)
+        if col.validity is None
+        else np.asarray(col.validity)[:n]
+    )
+
+
 class LocalExecutor:
     """Executes an optimized logical plan on the local device(s)."""
 
@@ -128,7 +210,9 @@ class LocalExecutor:
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
         if isinstance(node, P.TableScan):
             conn = self.catalogs.get(node.catalog)
-            splits = conn.split_manager().get_splits(node.table, 1)
+            splits = conn.split_manager().get_splits(
+                node.table, 1, node.constraint
+            )
             self._load_one_scan(node, splits, scans, dicts, counts)
             return
         for s in node.sources:
@@ -158,54 +242,42 @@ class LocalExecutor:
     def _load_one_scan(self, node: P.TableScan, splits, scans, dicts, counts):
         """Load the given splits of one scan into host arrays (shared by
         local execution — all splits — and per-task fragment execution —
-        the assigned subset, SqlTaskExecution.addSplitAssignments:256)."""
+        the assigned subset, SqlTaskExecution.addSplitAssignments:256).
+        Per-split string dictionaries are merged with codes remapped, so
+        connectors may emit divergent dictionaries across splits (e.g.
+        parquet row-group dictionaries)."""
         conn = self.catalogs.get(node.catalog)
         cols = [c for _, c in node.assignments]
         provider = conn.page_source_provider()
-        values: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-        valids: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-        total = 0
+        tmap = dict(node.types)
+        sym_of = {c: self._sym_for(node, c) for c in cols}
+        pages: List[Page] = []
         for sp in splits:
             src = provider.create_page_source(sp, cols)
             for page in src.pages():
+                src_dicts = src.dictionaries()
+                new_cols = []
                 for c, col in zip(page.names, page.columns):
-                    values[c].append(np.asarray(col.values)[: page.count])
-                    valids[c].append(
-                        np.ones(page.count, dtype=bool)
-                        if col.validity is None
-                        else np.asarray(col.validity)[: page.count]
+                    d = (
+                        col.dictionary
+                        if col.dictionary is not None
+                        else src_dicts.get(c)
                     )
-                total += page.count
-            for c, d in src.dictionaries().items():
-                dicts_key = self._sym_for(node, c)
-                prev = dicts.get(dicts_key)
-                if prev is not None and prev is not d and not np.array_equal(prev, d):
-                    raise ExecutionError(
-                        f"split dictionaries diverge for {c}"
+                    new_cols.append(
+                        Column(col.type, col.values, col.validity, d)
                     )
-                dicts[dicts_key] = d
-        if not splits:
-            # a task may legitimately receive zero splits; dictionaries must
-            # still exist for downstream dict-typed operations
-            src = provider.create_page_source(Split(node.table, 0, 1), cols)
-            for c, d in src.dictionaries().items():
-                dicts.setdefault(self._sym_for(node, c), d)
-        tmap = dict(node.types)
-        merged = {}
-        for c in cols:
-            sym = self._sym_for(node, c)
-            parts = values[c]
-            if parts:
-                vals = np.concatenate(parts) if len(parts) != 1 else parts[0]
-                ok = (
-                    np.concatenate(valids[c])
-                    if len(parts) != 1
-                    else valids[c][0]
+                pages.append(
+                    Page(new_cols, page.count,
+                         [sym_of[c] for c in page.names])
                 )
-            else:
-                vals = np.zeros(0, dtype=tmap[sym].np_dtype)
-                ok = np.zeros(0, dtype=bool)
-            merged[sym] = (vals, None if ok.all() else ok)
+        symbols = [sym_of[c] for c in cols]
+        types = [(s, tmap[s]) for s in symbols]
+        merged, total = merge_pages_to_arrays(pages, symbols, types, dicts)
+        for s, t in types:
+            # dict-typed symbols need a (possibly empty) dictionary even
+            # when this task got zero splits/rows, for literal lowering
+            if t.is_dictionary and s not in dicts:
+                dicts[s] = np.array([], dtype=object)
         scans[id(node)] = merged
         counts[id(node)] = total
 
@@ -660,6 +732,8 @@ class _TraceCtx:
         out = []
         for k in keys:
             d = self.ex.dicts.get(k.column)
+            if d is not None and len(d) == 0:
+                d = None  # zero-row split: codes are all sentinels
             if d is not None:
                 order = np.argsort(np.asarray(d, dtype=str), kind="stable")
                 ranks = np.empty(len(d), dtype=np.int64)
@@ -786,10 +860,11 @@ class _TraceCtx:
                         table[i] = index[s]
                     remaps.append(jnp.asarray(table))
                 self.ex.dicts[out_sym] = np.array(merged, dtype=object)
+                from ..expr.functions import dict_gather
+
                 for b, s, tbl in zip(batches, src_syms, remaps):
                     v, ok = b.lanes[s]
-                    safe = jnp.clip(v, 0, tbl.shape[0] - 1)
-                    vs.append(jnp.where(v >= 0, tbl[safe], -1))
+                    vs.append(dict_gather(tbl, v, -1).astype(jnp.int32))
                     oks.append(ok)
             else:
                 for b, s in zip(batches, src_syms):

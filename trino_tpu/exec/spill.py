@@ -194,7 +194,9 @@ def plan_spill(
     if est_table <= memory_limit:
         return None
     nbatches = math.ceil(est_table / batch_budget)
-    splits = conn.split_manager().get_splits(scan.table, nbatches)
+    splits = conn.split_manager().get_splits(
+        scan.table, nbatches, scan.constraint
+    )
     if len(splits) <= 1:
         return None
     return agg, scan, splits, max(1, len(splits) // nbatches)

@@ -46,8 +46,11 @@ class PlanFragment:
     partition_keys: Tuple[str, ...]  # for hash fragments
     output_partitioning: str  # how output pages route to the consumer stage
     output_keys: Tuple[str, ...]  # hash keys for output_partitioning == hash
-    # (preorder scan index -> (catalog, table)) for split assignment
-    scan_tables: Dict[int, Tuple[str, str]] = dataclasses.field(default_factory=dict)
+    # (preorder scan index -> (catalog, table, constraint)) for split
+    # assignment + connector-side pruning
+    scan_tables: Dict[int, Tuple[str, str, tuple]] = dataclasses.field(
+        default_factory=dict
+    )
     source_fragments: List[int] = dataclasses.field(default_factory=list)
 
 
@@ -64,7 +67,7 @@ def _index_scans(frag: PlanFragment):
     def walk(n: P.PlanNode):
         nonlocal idx
         if isinstance(n, P.TableScan):
-            frag.scan_tables[idx] = (n.catalog, n.table)
+            frag.scan_tables[idx] = (n.catalog, n.table, n.constraint)
             idx += 1
         if isinstance(n, P.RemoteSource):
             frag.source_fragments.append(n.fragment_id)

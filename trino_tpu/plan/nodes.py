@@ -37,6 +37,12 @@ class TableScan(PlanNode):
     # symbol -> source column name
     assignments: Tuple[Tuple[str, str], ...]
     types: Tuple[Tuple[str, T.Type], ...]
+    # advisory per-source-column value ranges derived from the query filter
+    # (TupleDomain pushed into the connector — spi/predicate/TupleDomain via
+    # ConnectorMetadata/SplitManager constraint): (column, lo, hi) inclusive,
+    # None = unbounded.  Connectors may prune splits/row-groups; the engine
+    # keeps the Filter, so pruning is safe-if-conservative.
+    constraint: Tuple[Tuple[str, Optional[float], Optional[float]], ...] = ()
 
     def output_symbols(self):
         return [s for s, _ in self.assignments]

@@ -39,7 +39,103 @@ def optimize(plan: P.PlanNode, metadata: Optional[Metadata] = None) -> P.PlanNod
     if metadata is not None:
         cur = _choose_build_sides(cur, metadata)
     cur = _prune_columns(cur)
+    cur = _derive_scan_constraints(cur)
     return cur
+
+
+# --- constraint extraction (TupleDomain pushdown into the connector) ----
+
+
+def _range_of(conj: "ir.Expr", scan: P.TableScan):
+    """(source_column, lo, hi) for a simple range conjunct over a scan
+    symbol of integral/date type, else None.  Conservative: bounds from
+    non-integral literals (double / fractional decimal) are widened with
+    floor/ceil so connector pruning can never drop matching rows."""
+    import math
+
+    sym_to_col = dict(scan.assignments)
+    types = dict(scan.types)
+
+    def raw(symref, const):
+        """(source_column, true_literal_value) or None.  The literal's
+        *semantic* value depends on its type: decimal Constants hold the
+        unscaled integer (ir.Constant docstring), dates hold epoch days."""
+        if not (isinstance(symref, ir.ColumnRef) and isinstance(const, ir.Constant)):
+            return None
+        t = types.get(symref.name)
+        if t is None or const.value is None:
+            return None
+        if not (t.name in ("tinyint", "smallint", "integer", "bigint", "date")):
+            return None
+        if symref.name not in sym_to_col:
+            return None
+        ct = const.type
+        if ct.is_decimal:
+            v = float(const.value) / (10 ** ct.scale)
+        elif ct.name in ("double", "real") or T.is_integral(ct) or ct.name == "date":
+            v = float(const.value)
+        else:
+            return None
+        return sym_to_col[symref.name], v
+
+    if isinstance(conj, ir.Comparison) and conj.op in ("=", "<", "<=", ">", ">="):
+        r = raw(conj.left, conj.right)
+        flip = False
+        if r is None:
+            r = raw(conj.right, conj.left)
+            flip = True
+        if r is None:
+            return None
+        col, v = r
+        op = conj.op
+        if flip:
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        whole = float(v).is_integer()
+        if op == "=":
+            # fractional literal can't equal an integral column; the Filter
+            # above still evaluates exactly, so an empty range is safe
+            return (col, v, v) if whole else (col, 1.0, 0.0)
+        if op == "<":
+            return col, None, (v - 1 if whole else math.floor(v))
+        if op == "<=":
+            return col, None, math.floor(v)
+        if op == ">":
+            return col, (v + 1 if whole else math.ceil(v)), None
+        if op == ">=":
+            return col, math.ceil(v), None
+        return None
+    if isinstance(conj, ir.Between) and not conj.negate:
+        lo = raw(conj.value, conj.low)
+        hi = raw(conj.value, conj.high)
+        if lo is not None and hi is not None and lo[0] == hi[0]:
+            return lo[0], math.ceil(lo[1]), math.floor(hi[1])
+    return None
+
+
+def _derive_scan_constraints(node: P.PlanNode) -> P.PlanNode:
+    node = _rewrite_sources(
+        node, tuple(_derive_scan_constraints(s) for s in node.sources)
+    )
+    if not (isinstance(node, P.Filter) and isinstance(node.source, P.TableScan)):
+        return node
+    scan = node.source
+    ranges = {}
+    for c in _conjuncts(node.predicate):
+        r = _range_of(c, scan)
+        if r is None:
+            continue
+        col, lo, hi = r
+        plo, phi = ranges.get(col, (None, None))
+        lo = plo if lo is None else (lo if plo is None else max(lo, plo))
+        hi = phi if hi is None else (hi if phi is None else min(hi, phi))
+        ranges[col] = (lo, hi)
+    if not ranges:
+        return node
+    new_scan = P.TableScan(
+        scan.catalog, scan.table, scan.assignments, scan.types,
+        tuple((c, lo, hi) for c, (lo, hi) in sorted(ranges.items())),
+    )
+    return P.Filter(new_scan, node.predicate)
 
 
 # --- predicate pushdown ------------------------------------------------

@@ -88,8 +88,10 @@ class FaultTolerantScheduler:
                 committed[f.id] = self._run_stage(
                     query_id, f, width, committed, by_id, consumer
                 )
+            from ..exchange.filesystem import SpoolHandle
+
             root_pages = read_spool_pages(
-                committed[0][0] + "/buffer_0.bin"
+                SpoolHandle(committed[0][0]).buffer_file(0)
             )
             if not root_pages:
                 raise SchedulerError("root stage produced no pages")
@@ -117,14 +119,14 @@ class FaultTolerantScheduler:
     ) -> Dict[str, list]:
         """Spool-file locations of the committed upstream attempts (same
         buffer routing as the pipelined scheduler, different location shape)."""
+        from ..exchange.filesystem import SpoolHandle
+
         sources: Dict[str, list] = {}
         for sf in f.source_fragments:
             src = by_id[sf]
+            buf = source_buffer_index(src, task_index)
             sources[str(sf)] = [
-                {
-                    "path": f"{path}/buffer_"
-                    f"{source_buffer_index(src, task_index)}.bin"
-                }
+                {"path": SpoolHandle(path).buffer_file(buf)}
                 for path in committed[sf]
             ]
         return sources

@@ -69,15 +69,17 @@ def assign_splits(
     UniformNodeSelector role); single-task fragments scan everything.
     Shared by the pipelined and fault-tolerant schedulers."""
     per_task: List[Dict[int, list]] = [dict() for _ in range(ntasks)]
-    for scan_idx, (catalog, table) in f.scan_tables.items():
+    for scan_idx, (catalog, table, constraint) in f.scan_tables.items():
         conn = catalogs.get(catalog)
         if f.partitioning == SOURCE:
             desired = max(ntasks * SPLITS_PER_NODE, 1)
-            splits = conn.split_manager().get_splits(table, desired)
+            splits = conn.split_manager().get_splits(
+                table, desired, constraint
+            )
             for i, sp in enumerate(splits):
                 per_task[i % ntasks].setdefault(scan_idx, []).append(sp)
         else:
-            splits = conn.split_manager().get_splits(table, 1)
+            splits = conn.split_manager().get_splits(table, 1, constraint)
             per_task[0].setdefault(scan_idx, []).extend(splits)
     return per_task
 

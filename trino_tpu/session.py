@@ -50,6 +50,12 @@ class Session:
             self.catalogs.register_factory(BlackholeConnectorFactory())
         except ImportError:
             pass
+        try:
+            from .connectors.hive import HiveConnectorFactory
+
+            self.catalogs.register_factory(HiveConnectorFactory())
+        except ImportError:  # pyarrow not installed
+            pass
         self.default_catalog = catalog
         self.properties = SessionProperties(config)
         self.metadata = Metadata(self.catalogs)

@@ -83,7 +83,11 @@ class ConnectorMetadata:
 
 
 class SplitManager:
-    def get_splits(self, table: str, desired: int) -> List[Split]:
+    def get_splits(
+        self, table: str, desired: int, constraint=None
+    ) -> List[Split]:
+        """constraint: optional ((column, lo, hi), ...) inclusive ranges
+        (TupleDomain pushdown) — connectors MAY prune splits with it."""
         raise NotImplementedError
 
 

@@ -31,6 +31,12 @@ def _build_catalogs(catalogs: Sequence[Tuple[str, str, dict]]) -> CatalogManager
     cm.register_factory(TpcdsConnectorFactory())
     cm.register_factory(MemoryConnectorFactory())
     cm.register_factory(BlackholeConnectorFactory())
+    try:
+        from ..connectors.hive import HiveConnectorFactory
+
+        cm.register_factory(HiveConnectorFactory())
+    except ImportError:
+        pass
     for name, connector, config in catalogs:
         cm.create_catalog(name, connector, config)
     return cm
