@@ -835,8 +835,30 @@ class _TraceCtx:
 
     # -- set ops ---------------------------------------------------------
     def _visit_setoperation(self, node: P.SetOperation) -> Batch:
-        if node.kind != "union":
-            raise ExecutionError(f"{node.kind} not supported yet")
+        """UNION [ALL] / INTERSECT / EXCEPT (UnionNode, IntersectNode,
+        ExceptNode).  Intersect/except use distinct semantics via one sort
+        over the concatenated inputs with per-side presence counts (the
+        reference lowers them to union + mark + filter; here the sort-based
+        group machinery does both in one kernel)."""
+        if node.kind in ("intersect", "except"):
+            return self._intersect_except(node)
+        lanes, sel, _ = self._union_lanes(node)
+        batch = Batch(lanes, sel)
+        if not node.all:
+            # UNION DISTINCT via the Distinct path
+            key_lanes = [lanes[s] for s in node.symbols]
+            cap = sel.shape[0]
+            perm, gid, _ = agg_ops.sort_group_ids(key_lanes, sel, cap)
+            boundary = jnp.concatenate(
+                [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
+            )
+            lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes.items()}
+            batch = Batch(lanes, sel[perm] & boundary)
+        return batch
+
+    def _union_lanes(self, node: P.SetOperation):
+        """Visit and concatenate all inputs positionally; returns
+        (lanes, sel, per-input capacities)."""
         batches = [self.visit(i) for i in node.inputs]
         caps = [b.sel.shape[0] for b in batches]
         lanes = {}
@@ -873,18 +895,47 @@ class _TraceCtx:
                     oks.append(ok)
             lanes[out_sym] = (jnp.concatenate(vs), jnp.concatenate(oks))
         sel = jnp.concatenate([b.sel for b in batches])
-        batch = Batch(lanes, sel)
-        if not node.all:
-            # UNION DISTINCT via the Distinct path
-            key_lanes = [lanes[s] for s in node.symbols]
-            cap = sel.shape[0]
-            perm, gid, _ = agg_ops.sort_group_ids(key_lanes, sel, cap)
-            boundary = jnp.concatenate(
-                [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
+        return lanes, sel, caps
+
+    def _intersect_except(self, node: P.SetOperation) -> Batch:
+        if node.all:
+            raise ExecutionError(
+                f"{node.kind.upper()} ALL not supported (DISTINCT only)"
             )
-            lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes.items()}
-            batch = Batch(lanes, sel[perm] & boundary)
-        return batch
+        assert len(node.inputs) == 2
+        lanes0, sel, caps = self._union_lanes(node)
+        tag = jnp.concatenate([
+            jnp.zeros(caps[0], dtype=jnp.int32),
+            jnp.ones(caps[1], dtype=jnp.int32),
+        ])
+        cap = sel.shape[0]
+        key_lanes = [lanes0[s] for s in node.symbols]
+        perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, sel, cap)
+        self._note_capacity(ngroups, cap)
+        sel_sorted = sel[perm]
+        tag_sorted = tag[perm]
+        side0 = (
+            jax.ops.segment_sum(
+                (sel_sorted & (tag_sorted == 0)).astype(jnp.int32), gid,
+                num_segments=cap,
+            )
+            > 0
+        )
+        side1 = (
+            jax.ops.segment_sum(
+                (sel_sorted & (tag_sorted == 1)).astype(jnp.int32), gid,
+                num_segments=cap,
+            )
+            > 0
+        )
+        keep_group = (
+            side0 & side1 if node.kind == "intersect" else side0 & ~side1
+        )
+        boundary = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
+        )
+        lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes0.items()}
+        return Batch(lanes, sel_sorted & boundary & keep_group[gid])
 
 
 LocalExecutor.trace_ctx_cls = _TraceCtx
