@@ -1,0 +1,284 @@
+"""Scalar function library tests: engine output vs python-computed golden.
+
+Reference parity: operator/scalar/ function suites (MathFunctions,
+StringFunctions, DateTimeFunctions) — semantics checked end-to-end through
+SQL over the deterministic TPCH connector, with the sqlite oracle supplying
+the base data and python computing the expected transform.
+"""
+import datetime
+import math
+import re
+import sqlite3
+
+import pytest
+
+from oracle import load_tpch
+from trino_tpu.session import tpch_session
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["nation", "customer", "orders"])
+    return conn
+
+
+def run(session, sql):
+    return session.execute(sql).to_pylist()
+
+
+def base(oracle_conn, sql):
+    return [r[0] for r in oracle_conn.execute(sql).fetchall()]
+
+
+# --- strings -----------------------------------------------------------
+
+
+def test_string_transforms(session, oracle_conn):
+    rows = run(
+        session,
+        "select n_name, upper(n_name), lower(n_name), reverse(n_name), "
+        "replace(n_name, 'A', 'x'), substring(n_name, 2, 3), "
+        "lpad(n_name, 10, '*'), rpad(n_name, 10, '*'), length(n_name) "
+        "from nation order by n_nationkey",
+    )
+    names = base(oracle_conn, "select n_name from nation order by n_nationkey")
+    for row, s in zip(rows, names):
+        exp = (
+            s, s.upper(), s.lower(), s[::-1], s.replace("A", "x"), s[1:4],
+            ("*" * 10 + s)[-10:] if len(s) < 10 else s[:10],
+            (s + "*" * 10)[:10] if len(s) < 10 else s[:10],
+            len(s),
+        )
+        assert row == exp, (row, exp)
+
+
+def test_strpos_starts_with_codepoint(session, oracle_conn):
+    rows = run(
+        session,
+        "select n_name, strpos(n_name, 'AN'), starts_with(n_name, 'A'), "
+        "codepoint(n_name) from nation order by n_nationkey",
+    )
+    for name, pos, sw, cp in rows:
+        assert pos == name.find("AN") + 1
+        assert sw == name.startswith("A")
+        assert cp == ord(name[0])
+
+
+def test_concat(session, oracle_conn):
+    rows = run(
+        session,
+        "select concat(n_name, '_x'), concat('p_', n_name, '_s'), "
+        "concat(n_name, '/', n_name) from nation order by n_nationkey",
+    )
+    names = base(oracle_conn, "select n_name from nation order by n_nationkey")
+    for (a, b, c), s in zip(rows, names):
+        assert a == s + "_x"
+        assert b == "p_" + s + "_s"
+        assert c == s + "/" + s
+
+
+def test_split_part_and_trim(session, oracle_conn):
+    rows = run(
+        session,
+        "select c_name, split_part(c_name, '#', 2), split_part(c_name, '#', 5), "
+        "trim(lpad(c_name, 25, ' ')), translate(c_name, '0#', 'O-') "
+        "from customer order by c_custkey limit 20",
+    )
+    for name, p2, p5, trimmed, tr in rows:
+        parts = name.split("#")
+        assert p2 == (parts[1] if len(parts) >= 2 else None)
+        assert p5 is None
+        assert trimmed == name.strip()
+        assert tr == name.replace("0", "O").replace("#", "-")
+
+
+def test_regexp_functions(session, oracle_conn):
+    rows = run(
+        session,
+        "select c_name, regexp_like(c_name, '00[0-4]$'), "
+        "regexp_extract(c_name, '#(0*)(\\d+)', 2), "
+        "regexp_replace(c_name, '0+', '0') "
+        "from customer order by c_custkey limit 20",
+    )
+    for name, rl, rext, rrep in rows:
+        assert rl == (re.search("00[0-4]$", name) is not None)
+        m = re.search(r"#(0*)(\d+)", name)
+        assert rext == (m.group(2) if m else None)
+        assert rrep == re.sub("0+", "0", name)
+
+
+# --- math --------------------------------------------------------------
+
+
+def test_math_functions(session, oracle_conn):
+    rows = run(
+        session,
+        "select o_totalprice, ln(o_totalprice), log10(o_totalprice), "
+        "power(o_totalprice, 2), sqrt(o_totalprice), sign(-o_totalprice), "
+        "truncate(o_totalprice), mod(o_orderkey, 7), "
+        "width_bucket(o_totalprice, 0, 500000, 10), "
+        "greatest(o_totalprice, 100000), least(o_totalprice, 100000) "
+        "from orders order by o_orderkey limit 50",
+    )
+    for tp, ln_, l10, pw, sq, sg, tr, md, wb, gr, le in rows:
+        assert math.isclose(ln_, math.log(tp), rel_tol=1e-9)
+        assert math.isclose(l10, math.log10(tp), rel_tol=1e-9)
+        assert math.isclose(pw, tp**2, rel_tol=1e-9)
+        assert math.isclose(sq, math.sqrt(tp), rel_tol=1e-9)
+        assert sg == -1
+        assert tr == math.trunc(tp)
+        assert wb == min(10 + 1, max(0, int(10 * tp / 500000) + 1))
+        assert gr == max(tp, 100000)
+        assert le == min(tp, 100000)
+    keys = base(
+        oracle_conn, "select o_orderkey from orders order by o_orderkey limit 50"
+    )
+    for (row, k) in zip(rows, keys):
+        sign = -1 if k < 0 else 1
+        assert row[7] == sign * (abs(k) % 7)
+
+
+def test_trig_and_constants(session):
+    rows = run(
+        session,
+        "select sin(o_totalprice / 100000), atan2(o_totalprice, 100000), "
+        "exp(o_totalprice / 1000000), pi(), cbrt(o_totalprice) "
+        "from orders order by o_orderkey limit 20",
+    )
+    tps = [
+        r[0]
+        for r in run(
+            session,
+            "select o_totalprice from orders order by o_orderkey limit 20",
+        )
+    ]
+    for (sn, at2, ex, pi_, cb), tp in zip(rows, tps):
+        # decimal / int division quantizes at scale 6 (Trino decimal rules)
+        assert math.isclose(sn, math.sin(tp / 100000), abs_tol=2e-6)
+        assert math.isclose(at2, math.atan2(tp, 100000), rel_tol=1e-9)
+        assert math.isclose(ex, math.exp(tp / 1000000), rel_tol=1e-5)
+        assert math.isclose(pi_, math.pi)
+        assert math.isclose(cb, tp ** (1 / 3), rel_tol=1e-9)
+
+
+def test_conditional_functions(session, oracle_conn):
+    rows = run(
+        session,
+        "select o_orderkey, nullif(o_orderpriority, '1-URGENT'), "
+        "if(o_totalprice > 100000, 'big', 'small') "
+        "from orders order by o_orderkey limit 50",
+    )
+    prios = oracle_conn.execute(
+        "select o_orderpriority, o_totalprice from orders "
+        "order by o_orderkey limit 50"
+    ).fetchall()
+    for (k, nf, iff), (prio, tp) in zip(rows, prios):
+        assert nf == (None if prio == "1-URGENT" else prio)
+        assert iff == ("big" if tp > 100000 else "small")
+
+
+# --- date/time ---------------------------------------------------------
+
+
+def _dates(oracle_conn):
+    return [
+        datetime.date.fromisoformat(d)
+        for d in base(
+            oracle_conn,
+            "select o_orderdate from orders order by o_orderkey limit 100",
+        )
+    ]
+
+
+def test_date_parts(session, oracle_conn):
+    rows = run(
+        session,
+        "select o_orderdate, day_of_week(o_orderdate), day_of_year(o_orderdate), "
+        "week(o_orderdate), year_of_week(o_orderdate), "
+        "extract(dow from o_orderdate), last_day_of_month(o_orderdate) "
+        "from orders order by o_orderkey limit 100",
+    )
+    for row, d in zip(rows, _dates(oracle_conn)):
+        iso = d.isocalendar()
+        assert row[0] == d.isoformat()
+        assert row[1] == d.isoweekday()
+        assert row[2] == d.timetuple().tm_yday
+        assert row[3] == iso[1]
+        assert row[4] == iso[0]
+        assert row[5] == d.isoweekday()
+        nm = (d.replace(day=28) + datetime.timedelta(days=4)).replace(day=1)
+        assert row[6] == (nm - datetime.timedelta(days=1)).isoformat()
+
+
+def test_date_trunc(session, oracle_conn):
+    rows = run(
+        session,
+        "select date_trunc('week', o_orderdate), date_trunc('month', o_orderdate), "
+        "date_trunc('quarter', o_orderdate), date_trunc('year', o_orderdate) "
+        "from orders order by o_orderkey limit 100",
+    )
+    for row, d in zip(rows, _dates(oracle_conn)):
+        assert row[0] == (d - datetime.timedelta(days=d.isoweekday() - 1)).isoformat()
+        assert row[1] == d.replace(day=1).isoformat()
+        qm = 3 * ((d.month - 1) // 3) + 1
+        assert row[2] == d.replace(month=qm, day=1).isoformat()
+        assert row[3] == d.replace(month=1, day=1).isoformat()
+
+
+def _add_months(d: datetime.date, n: int) -> datetime.date:
+    total = d.year * 12 + (d.month - 1) + n
+    y, m = divmod(total, 12)
+    m += 1
+    last = (
+        (datetime.date(y, m, 28) + datetime.timedelta(days=4)).replace(day=1)
+        - datetime.timedelta(days=1)
+    ).day
+    return datetime.date(y, m, min(d.day, last))
+
+
+def test_date_add(session, oracle_conn):
+    rows = run(
+        session,
+        "select date_add('day', 45, o_orderdate), "
+        "date_add('week', -3, o_orderdate), "
+        "date_add('month', 7, o_orderdate), "
+        "date_add('year', -2, o_orderdate) "
+        "from orders order by o_orderkey limit 100",
+    )
+    for row, d in zip(rows, _dates(oracle_conn)):
+        assert row[0] == (d + datetime.timedelta(days=45)).isoformat()
+        assert row[1] == (d - datetime.timedelta(weeks=3)).isoformat()
+        assert row[2] == _add_months(d, 7).isoformat()
+        assert row[3] == _add_months(d, -24).isoformat()
+
+
+def test_date_diff(session, oracle_conn):
+    rows = run(
+        session,
+        "select date_diff('day', date '1995-06-15', o_orderdate), "
+        "date_diff('month', date '1995-06-15', o_orderdate), "
+        "date_diff('year', date '1995-06-15', o_orderdate), "
+        "date_diff('week', date '1995-06-15', o_orderdate) "
+        "from orders order by o_orderkey limit 100",
+    )
+    ref = datetime.date(1995, 6, 15)
+    for row, d in zip(rows, _dates(oracle_conn)):
+        days = (d - ref).days
+        assert row[0] == days
+        months = (d.year * 12 + d.month) - (ref.year * 12 + ref.month)
+        if months > 0 and d.day < ref.day:
+            months -= 1
+        elif months < 0 and d.day > ref.day:
+            months += 1
+        assert row[1] == months
+        sign = -1 if months < 0 else 1
+        assert row[2] == sign * (abs(months) // 12)
+        assert row[3] == int(math.trunc(days / 7))

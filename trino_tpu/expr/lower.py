@@ -109,6 +109,19 @@ def compile_expr(
             return cols[node.name]
         if isinstance(node, ir.Constant):
             ref = next(iter(cols.values()))
+            if node.type.is_dictionary:
+                shape = ref[0].shape
+                if node.value is None:
+                    ctx.expr_dicts[node] = np.array([], dtype=object)
+                    return (
+                        jnp.full(shape, -1, dtype=jnp.int32),
+                        jnp.zeros(shape, dtype=bool),
+                    )
+                ctx.expr_dicts[node] = np.array([node.value], dtype=object)
+                return (
+                    jnp.zeros(shape, dtype=jnp.int32),
+                    jnp.ones(shape, dtype=bool),
+                )
             return _const_lane(node, ref)
         if isinstance(node, ir.Call):
             return _lower_call(node, cols, ev, ctx)
@@ -137,7 +150,7 @@ def compile_expr(
         if isinstance(node, ir.In):
             return _lower_in(node, cols, ev, ctx)
         if isinstance(node, ir.Case):
-            return _lower_case(node, cols, ev)
+            return _lower_case(node, cols, ev, ctx)
         if isinstance(node, ir.Cast):
             return _lower_cast(node, cols, ev, ctx)
         raise NotImplementedError(type(node).__name__)
@@ -269,7 +282,9 @@ def _lower_in(node: ir.In, cols, ev, ctx: LoweringContext) -> Lane:
     return res, ok
 
 
-def _lower_case(node: ir.Case, cols, ev) -> Lane:
+def _lower_case(node: ir.Case, cols, ev, ctx: LoweringContext) -> Lane:
+    if node.type.is_dictionary:
+        return _lower_case_dict(node, cols, ev, ctx)
     # evaluate all branches, select backwards (XLA fuses the selects)
     if node.default is not None:
         v, ok = ev(node.default, cols)
@@ -292,11 +307,61 @@ def _lower_case(node: ir.Case, cols, ev) -> Lane:
     return v, ok
 
 
+def _lower_case_dict(node: ir.Case, cols, ev, ctx: LoweringContext) -> Lane:
+    """CASE producing varchar: union the branch dictionaries, remap each
+    branch's codes into the union space, then select — the multi-branch
+    generalisation of the DictionaryAwarePageProjection trick."""
+    union_index: Dict[str, int] = {}
+    union_vals: list = []
+
+    def remap_codes(e: ir.Expr, lane: Lane):
+        d = ctx.dict_for_expr(e)
+        if d is None:
+            raise NotImplementedError(
+                "varchar CASE requires dictionary-encoded branches"
+            )
+        remap = np.empty(len(d), dtype=np.int32)
+        for i, s in enumerate(d):
+            s = str(s)
+            if s not in union_index:
+                union_index[s] = len(union_vals)
+                union_vals.append(s)
+            remap[i] = union_index[s]
+        v, ok = lane
+        codes = dict_gather(remap, v, -1).astype(jnp.int32)
+        return codes, ok & (codes >= 0)
+
+    if node.default is not None:
+        v, ok = remap_codes(node.default, ev(node.default, cols))
+    else:
+        ref = next(iter(cols.values()))
+        v = jnp.full(ref[0].shape, -1, dtype=jnp.int32)
+        ok = jnp.zeros(ref[0].shape, dtype=bool)
+    for w in reversed(node.whens):
+        cv, cok = ev(w.condition, cols)
+        rv, rok = remap_codes(w.result, ev(w.result, cols))
+        take = cok & cv
+        v = jnp.where(take, rv, v)
+        ok = jnp.where(take, rok, ok)
+    ctx.expr_dicts[node] = np.array(union_vals, dtype=object)
+    return v, ok
+
+
 def _lower_cast(node: ir.Cast, cols, ev, ctx: LoweringContext) -> Lane:
     v, ok = ev(node.term, cols)
     ft, tt = node.term.type, node.type
     if ft == tt:
         return v, ok
+    if ft.is_dictionary and tt.is_dictionary:
+        # varchar(n) truncation: lengths are advisory; keep codes but
+        # re-register the dictionary under the cast node for downstream
+        # dictionary consumers (comparisons, derived string functions)
+        d = ctx.dict_for_expr(node.term)
+        if d is not None:
+            ctx.expr_dicts[node] = d
+        return v, ok
+    if ft.is_dictionary:
+        return _cast_varchar_parse(node, v, ok, ctx)
     if ft.is_decimal and tt.is_decimal:
         return decimal_rescale(v, ft.scale, tt.scale), ok
     if ft.is_decimal and tt.name == "double":
@@ -308,6 +373,48 @@ def _lower_cast(node: ir.Cast, cols, ev, ctx: LoweringContext) -> Lane:
     if T.is_integral(ft) and tt.is_decimal:
         return v.astype(jnp.int64) * (10**tt.scale), ok
     return v.astype(tt.np_dtype), ok
+
+
+def _cast_varchar_parse(node: ir.Cast, v, ok, ctx: LoweringContext) -> Lane:
+    """CAST(varchar AS numeric/date): parse each dictionary entry host-side,
+    gather values + a validity table (bad parses -> NULL, TRY semantics)."""
+    d = ctx.dict_for_expr(node.term)
+    if d is None:
+        raise NotImplementedError("varchar cast requires a dictionary input")
+    tt = node.type
+    vals = np.zeros(len(d), dtype=tt.np_dtype)
+    valid = np.ones(len(d), dtype=bool)
+    for i, s in enumerate(d):
+        s = str(s).strip()
+        try:
+            if tt.name == "date":
+                import datetime
+
+                from .functions import days_from_civil
+
+                dt = datetime.date.fromisoformat(s)
+                vals[i] = days_from_civil(dt.year, dt.month, dt.day)
+            elif tt.is_decimal:
+                from decimal import Decimal
+
+                vals[i] = int(Decimal(s).scaleb(tt.scale).to_integral_value())
+            elif tt.name in ("double", "real"):
+                vals[i] = float(s)
+            elif tt.name == "boolean":
+                low = s.lower()
+                if low in ("true", "t", "1"):
+                    vals[i] = True
+                elif low in ("false", "f", "0"):
+                    vals[i] = False
+                else:
+                    valid[i] = False
+            else:
+                vals[i] = int(s)
+        except (ValueError, ArithmeticError):
+            valid[i] = False
+    res = dict_gather(vals, v, 0)
+    okt = dict_gather(valid, v, False)
+    return res, ok & okt
 
 
 def _lower_call(node: ir.Call, cols, ev, ctx: LoweringContext) -> Lane:

@@ -1150,9 +1150,17 @@ class ExprAnalyzer:
             return _fold(ir.Cast(to, self._an(e.operand)))
         if isinstance(e, ast.ExtractOp):
             v = self._an(e.operand)
-            if e.field not in ("year", "month", "day", "quarter"):
+            field = {
+                "dow": "day_of_week",
+                "doy": "day_of_year",
+                "yow": "year_of_week",
+            }.get(e.field, e.field)
+            if field not in (
+                "year", "month", "day", "quarter", "week",
+                "day_of_week", "day_of_year", "day_of_month", "year_of_week",
+            ):
                 raise SemanticError(f"extract({e.field}) unsupported")
-            return ir.Call(T.BIGINT, e.field, (v,))
+            return ir.Call(T.BIGINT, field, (v,))
         if isinstance(e, ast.CaseExpr):
             return self._case(e)
         if isinstance(e, ast.ScalarSubquery):
@@ -1229,6 +1237,34 @@ class ExprAnalyzer:
                 ir.WhenClause(ir.IsNull(a, negate=True), a) for a in args[:-1]
             )
             return ir.Case(rt, whens, args[-1])
+        if e.name == "nullif":
+            a, b = self._an(e.args[0]), self._an(e.args[1])
+            # CASE WHEN a = b THEN null ELSE a
+            whens = (
+                ir.WhenClause(
+                    ir.Comparison("=", a, b), ir.Constant(a.type, None)
+                ),
+            )
+            return ir.Case(a.type, whens, a)
+        if e.name == "if":
+            c = self._an(e.args[0])
+            t = self._an(e.args[1])
+            f = self._an(e.args[2]) if len(e.args) > 2 else None
+            rt = t.type if f is None else T.common_super_type(t.type, f.type)
+            return ir.Case(rt, (ir.WhenClause(c, t),), f)
+        if e.name in ("try", "try_cast"):
+            # our kernels already mask error rows to NULL (divide-by-zero,
+            # bad casts), matching TRY semantics without a control transfer
+            return self._an(e.args[0])
+        from ..expr.functions import SIGNATURES
+
+        if e.name in SIGNATURES:
+            args = tuple(self._an(a) for a in e.args)
+            try:
+                rt = SIGNATURES[e.name](args)
+            except (ValueError, TypeError) as err:
+                raise SemanticError(str(err)) from err
+            return _fold(ir.Call(rt, e.name, args))
         raise SemanticError(f"unknown function: {e.name}")
 
     def _scalar_subquery(self, q: ast.Query) -> ir.Expr:
@@ -1563,9 +1599,14 @@ def _fold(e: ir.Expr) -> ir.Expr:
                 v = (v, a.type.scale)
             vals.append(v)
         try:
-            return ir.Constant(e.type, _eval_const(e.name, e.type, e.args))
-        except NotImplementedError:
+            v = _eval_const(e.name, e.type, e.args)
+        except (NotImplementedError, ValueError, OverflowError, ArithmeticError):
+            # domain/overflow errors fall through to the runtime kernels,
+            # which mask bad rows to NULL (TRY semantics)
             return e
+        if isinstance(v, complex):
+            return e
+        return ir.Constant(e.type, v)
     if isinstance(e, ir.Cast) and isinstance(e.term, ir.Constant):
         c = e.term
         if c.value is None:
@@ -1584,6 +1625,11 @@ def _fold(e: ir.Expr) -> ir.Expr:
 
 
 def _eval_const(name: str, out_t: T.Type, args) -> object:
+    from ..expr.functions import CONST_EVAL
+
+    if name in CONST_EVAL:
+        return CONST_EVAL[name](out_t, args)
+
     def scaled(a):
         return a.value, (a.type.scale if a.type.is_decimal else 0)
 
