@@ -1,0 +1,328 @@
+"""Aggregate function library tests.
+
+Reference parity: operator/aggregation/ (112 aggregate classes) tested via
+AbstractTestAggregations; here each family is validated against the sqlite
+oracle where sqlite supports it, or a numpy/python reference otherwise.
+"""
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from trino_tpu.session import tpch_session
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["nation", "orders", "lineitem"])
+    return conn
+
+
+def rows(session, sql):
+    return session.execute(sql).to_pylist()
+
+
+def oracle_col(oracle_conn, sql):
+    return [r[0] for r in oracle_conn.execute(sql).fetchall()]
+
+
+# -- moments ------------------------------------------------------------
+
+
+def test_stddev_variance_global(session, oracle_conn):
+    data = np.array(
+        oracle_col(oracle_conn, "select l_quantity from lineitem"), dtype=float
+    )
+    (r,) = rows(
+        session,
+        "select var_samp(l_quantity), var_pop(l_quantity), "
+        "stddev_samp(l_quantity), stddev_pop(l_quantity), "
+        "stddev(l_quantity), variance(l_quantity) from lineitem",
+    )
+    assert r[0] == pytest.approx(data.var(ddof=1), rel=1e-9)
+    assert r[1] == pytest.approx(data.var(ddof=0), rel=1e-9)
+    assert r[2] == pytest.approx(data.std(ddof=1), rel=1e-9)
+    assert r[3] == pytest.approx(data.std(ddof=0), rel=1e-9)
+    assert r[4] == pytest.approx(data.std(ddof=1), rel=1e-9)
+    assert r[5] == pytest.approx(data.var(ddof=1), rel=1e-9)
+
+
+def test_stddev_grouped(session, oracle_conn):
+    actual = rows(
+        session,
+        "select l_returnflag, stddev_samp(l_quantity), count(*) from lineitem "
+        "group by l_returnflag order by l_returnflag",
+    )
+    expected = {}
+    for flag, qty in oracle_conn.execute(
+        "select l_returnflag, l_quantity from lineitem"
+    ):
+        expected.setdefault(flag, []).append(qty)
+    assert [a[0] for a in actual] == sorted(expected)
+    for flag, std, cnt in actual:
+        arr = np.array(expected[flag], dtype=float)
+        assert cnt == len(arr)
+        assert std == pytest.approx(arr.std(ddof=1), rel=1e-9)
+
+
+def test_geometric_mean(session, oracle_conn):
+    data = np.array(
+        oracle_col(oracle_conn, "select l_quantity from lineitem"), dtype=float
+    )
+    (r,) = rows(session, "select geometric_mean(l_quantity) from lineitem")
+    assert r[0] == pytest.approx(math.exp(np.log(data).mean()), rel=1e-9)
+
+
+def test_corr_covar_regr(session, oracle_conn):
+    pairs = oracle_conn.execute(
+        "select l_extendedprice, l_quantity from lineitem"
+    ).fetchall()
+    y = np.array([p[0] for p in pairs], dtype=float)
+    x = np.array([p[1] for p in pairs], dtype=float)
+    (r,) = rows(
+        session,
+        "select corr(l_extendedprice, l_quantity), "
+        "covar_pop(l_extendedprice, l_quantity), "
+        "covar_samp(l_extendedprice, l_quantity), "
+        "regr_slope(l_extendedprice, l_quantity), "
+        "regr_intercept(l_extendedprice, l_quantity) from lineitem",
+    )
+    assert r[0] == pytest.approx(np.corrcoef(y, x)[0, 1], rel=1e-9)
+    assert r[1] == pytest.approx(np.cov(y, x, ddof=0)[0, 1], rel=1e-9)
+    assert r[2] == pytest.approx(np.cov(y, x, ddof=1)[0, 1], rel=1e-9)
+    slope = np.cov(y, x, ddof=0)[0, 1] / x.var(ddof=0)
+    assert r[3] == pytest.approx(slope, rel=1e-9)
+    assert r[4] == pytest.approx(y.mean() - slope * x.mean(), rel=1e-9)
+
+
+# -- boolean / conditional ---------------------------------------------
+
+
+def test_bool_and_or_count_if(session, oracle_conn):
+    actual = rows(
+        session,
+        "select bool_and(l_quantity > 1), bool_or(l_quantity > 49), "
+        "every(l_quantity > 0), count_if(l_quantity > 25) from lineitem",
+    )
+    qty = np.array(
+        oracle_col(oracle_conn, "select l_quantity from lineitem"), dtype=float
+    )
+    assert actual == [
+        (bool((qty > 1).all()), bool((qty > 49).any()), bool((qty > 0).all()),
+         int((qty > 25).sum()))
+    ]
+
+
+def test_bool_grouped_vs_oracle(session, oracle_conn):
+    assert_rows_match(
+        rows(
+            session,
+            "select l_returnflag, count_if(l_discount > 0.05) from lineitem "
+            "group by l_returnflag order by l_returnflag",
+        ),
+        oracle_conn.execute(
+            "select l_returnflag, sum(case when l_discount > 0.05 then 1 "
+            "else 0 end) from lineitem group by l_returnflag "
+            "order by l_returnflag"
+        ).fetchall(),
+    )
+
+
+# -- bitwise / checksum -------------------------------------------------
+
+
+def test_bitwise_aggs(session, oracle_conn):
+    keys = oracle_col(oracle_conn, "select o_orderkey from orders")
+    (r,) = rows(
+        session,
+        "select bitwise_and_agg(o_orderkey), bitwise_or_agg(o_orderkey), "
+        "bitwise_xor_agg(o_orderkey) from orders",
+    )
+    band = bor = 0
+    bxor = 0
+    band = ~0
+    for k in keys:
+        band &= k
+        bor |= k
+        bxor ^= k
+    assert r == (band, bor, bxor)
+
+
+def test_checksum_properties(session):
+    a = rows(session, "select checksum(o_orderkey) from orders")
+    b = rows(session, "select checksum(o_orderkey) from orders")
+    c = rows(session, "select checksum(o_custkey) from orders")
+    assert a == b  # deterministic
+    assert a != c  # sensitive to the data
+    assert a[0][0] is not None
+
+
+# -- positional / selection --------------------------------------------
+
+
+def test_arbitrary(session, oracle_conn):
+    vals = set(oracle_col(oracle_conn, "select n_name from nation"))
+    (r,) = rows(session, "select arbitrary(n_name), any_value(n_name) from nation")
+    assert r[0] in vals and r[1] in vals
+
+
+def test_min_by_max_by(session, oracle_conn):
+    pairs = oracle_conn.execute(
+        "select o_orderkey, o_totalprice from orders"
+    ).fetchall()
+    lo = min(pairs, key=lambda p: p[1])
+    hi = max(pairs, key=lambda p: p[1])
+    assert rows(
+        session,
+        "select min_by(o_orderkey, o_totalprice), "
+        "max_by(o_orderkey, o_totalprice) from orders",
+    ) == [(lo[0], hi[0])]
+
+
+def test_min_by_grouped(session, oracle_conn):
+    actual = rows(
+        session,
+        "select o_orderpriority, max_by(o_orderkey, o_totalprice) "
+        "from orders group by o_orderpriority order by o_orderpriority",
+    )
+    best = {}
+    for prio, key, price in oracle_conn.execute(
+        "select o_orderpriority, o_orderkey, o_totalprice from orders"
+    ):
+        if prio not in best or price > best[prio][1]:
+            best[prio] = (key, price)
+    assert actual == [(p, best[p][0]) for p in sorted(best)]
+
+
+def test_min_by_varchar_value(session, oracle_conn):
+    pairs = oracle_conn.execute(
+        "select o_orderpriority, o_totalprice from orders"
+    ).fetchall()
+    lo = min(pairs, key=lambda p: p[1])[0]
+    assert rows(
+        session, "select min_by(o_orderpriority, o_totalprice) from orders"
+    ) == [(lo,)]
+
+
+# -- approximate (exact here) ------------------------------------------
+
+
+def test_approx_distinct(session, oracle_conn):
+    expected = oracle_conn.execute(
+        "select count(distinct o_custkey) from orders"
+    ).fetchone()[0]
+    assert rows(session, "select approx_distinct(o_custkey) from orders") == [
+        (expected,)
+    ]
+    # optional max-standard-error argument is accepted
+    assert rows(
+        session, "select approx_distinct(o_custkey, 0.023) from orders"
+    ) == [(expected,)]
+
+
+def test_approx_percentile(session, oracle_conn):
+    qty = sorted(
+        oracle_col(oracle_conn, "select l_quantity from lineitem")
+    )
+
+    def nearest_rank(p):
+        return qty[int(math.floor(p * (len(qty) - 1) + 0.5))]
+
+    for p in (0.0, 0.25, 0.5, 0.9, 1.0):
+        (r,) = rows(
+            session,
+            f"select approx_percentile(l_quantity, {p}) from lineitem",
+        )
+        assert r[0] == pytest.approx(nearest_rank(p), rel=1e-9), p
+
+
+def test_approx_percentile_grouped(session, oracle_conn):
+    actual = rows(
+        session,
+        "select l_returnflag, approx_percentile(l_extendedprice, 0.5) "
+        "from lineitem group by l_returnflag order by l_returnflag",
+    )
+    groups = {}
+    for flag, v in oracle_conn.execute(
+        "select l_returnflag, l_extendedprice from lineitem"
+    ):
+        groups.setdefault(flag, []).append(v)
+    for flag, med in actual:
+        vals = sorted(groups[flag])
+        expected = vals[int(math.floor(0.5 * (len(vals) - 1) + 0.5))]
+        assert med == pytest.approx(expected, rel=1e-6), flag
+
+
+# -- null handling ------------------------------------------------------
+
+
+def test_new_aggs_all_null_group(session):
+    # aggregates over an empty selection produce NULL (count-ish -> 0)
+    r = rows(
+        session,
+        "select stddev(o_totalprice), corr(o_totalprice, o_custkey), "
+        "bool_and(o_totalprice > 0), min_by(o_orderkey, o_totalprice), "
+        "arbitrary(o_orderkey), count_if(o_totalprice > 0), "
+        "approx_distinct(o_custkey), bitwise_or_agg(o_orderkey), "
+        "checksum(o_orderkey) "
+        "from orders where o_orderkey < 0",
+    )
+    assert r == [(None, None, None, None, None, 0, 0, None, None)]
+
+
+def test_var_samp_single_row_null(session):
+    # sample variance of a single value is NULL (n-1 == 0)
+    r = rows(
+        session,
+        "select var_samp(o_totalprice) from orders "
+        "where o_orderkey = (select min(o_orderkey) from orders)",
+    )
+    assert r == [(None,)]
+
+
+# -- varchar ordering (dictionary rank remap) ---------------------------
+
+
+def test_min_max_varchar(session, oracle_conn):
+    assert_rows_match(
+        rows(session, "select min(n_name), max(n_name) from nation"),
+        oracle_conn.execute("select min(n_name), max(n_name) from nation").fetchall(),
+    )
+
+
+def test_min_max_varchar_grouped(session, oracle_conn):
+    assert_rows_match(
+        rows(
+            session,
+            "select n_regionkey, min(n_name), max(n_name) from nation "
+            "group by n_regionkey order by n_regionkey",
+        ),
+        oracle_conn.execute(
+            "select n_regionkey, min(n_name), max(n_name) from nation "
+            "group by n_regionkey order by n_regionkey"
+        ).fetchall(),
+    )
+
+
+def test_min_by_varchar_key(session, oracle_conn):
+    # ordering key is a varchar: ordered by string value, not dict code
+    pairs = oracle_conn.execute(
+        "select n_nationkey, n_name from nation"
+    ).fetchall()
+    lo = min(pairs, key=lambda p: p[1])[0]
+    hi = max(pairs, key=lambda p: p[1])[0]
+    assert rows(
+        session,
+        "select min_by(n_nationkey, n_name), max_by(n_nationkey, n_name) "
+        "from nation",
+    ) == [(lo, hi)]

@@ -282,3 +282,18 @@ def test_date_diff(session, oracle_conn):
         sign = -1 if months < 0 else 1
         assert row[2] == sign * (abs(months) // 12)
         assert row[3] == int(math.trunc(days / 7))
+
+
+def test_width_bucket_descending(session):
+    # descending bounds count buckets downward (WidthBucketFunction)
+    assert session.execute(
+        "select width_bucket(5.0, 10.0, 0.0, 4), "
+        "width_bucket(11.0, 10.0, 0.0, 4), width_bucket(0.0, 10.0, 0.0, 4)"
+    ).to_pylist() == [(3, 0, 5)]
+
+
+def test_concat_null_constant(session):
+    out = session.execute(
+        "select concat(n_name, cast(null as varchar)) from nation limit 3"
+    ).to_pylist()
+    assert out == [(None,), (None,), (None,)]

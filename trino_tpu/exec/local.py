@@ -420,13 +420,8 @@ class _TraceCtx:
         if b is None:
             b = self.visit(node.source)
         types = node.source.output_types()
-        specs = [
-            agg_ops.AggSpec(
-                a.kind, a.arg, a.output, a.input_type, a.output_type,
-                a.distinct,
-            )
-            for a in node.aggs
-        ]
+        b, aggs = self._agg_dict_setup(node, b)
+        specs = [a.to_spec() for a in aggs]
         final = node.step in ("final", "intermediate")  # merges accumulators
         partial = node.step in ("partial", "intermediate")  # emits them
 
@@ -496,6 +491,73 @@ class _TraceCtx:
             }
             present = jnp.pad(present, (0, pad_cap - cap))
         return Batch(lanes, present)
+
+    def _agg_dict_setup(self, node: P.Aggregate, b: "Batch"):
+        """Dictionary handling for ordering/value-carrying aggregates.
+
+        Dictionary codes are first-seen order, not string order, so min/max
+        over a varchar (and the min_by/max_by ordering key) must compare
+        lexicographic *ranks*: remap the code lane through the sorted
+        dictionary and register the sorted dictionary for the output — the
+        code-space analog of the reference ordering real strings through
+        TypeOperators.  Value-carrying aggregates (arbitrary, min_by value)
+        propagate the input dictionary unchanged.  Dictionaries are also
+        registered for the $val/$key accumulator columns so PARTIAL-step
+        output pages (shipped over exchanges) stay decodable."""
+        raw_step = node.step in ("single", "partial")
+        lanes = None
+        aggs = []
+
+        def rank_lane(sym: str):
+            nonlocal lanes
+            d = self.ex.dicts.get(sym)
+            if d is None or len(d) == 0:
+                return sym, d if d is not None else np.array([], dtype=object)
+            order = np.argsort(np.array([str(x) for x in d]))
+            rank = np.empty(len(d), dtype=np.int32)
+            rank[order] = np.arange(len(d), dtype=np.int32)
+            v, ok = b.lanes[sym]
+            rk = jnp.asarray(rank)[jnp.clip(v, 0, len(d) - 1)]
+            rsym = sym + "$rank"
+            if lanes is None:
+                lanes = dict(b.lanes)
+            lanes[rsym] = (jnp.where(v >= 0, rk, -1).astype(v.dtype), ok)
+            return rsym, d[order]
+
+        for a in node.aggs:
+            it, i2t = a.input_type, a.input2_type
+            if (a.kind in ("min", "max") and it is not None
+                    and it.is_dictionary):
+                if raw_step:
+                    rsym, sorted_d = rank_lane(a.arg)
+                    a = dataclasses.replace(a, arg=rsym)
+                    self.ex.dicts[a.output] = sorted_d
+                    self.ex.dicts[f"{a.output}$val"] = sorted_d
+                elif f"{a.output}$val" in self.ex.dicts:
+                    self.ex.dicts[a.output] = self.ex.dicts[f"{a.output}$val"]
+            elif a.kind in ("min_by", "max_by"):
+                if i2t is not None and i2t.is_dictionary and raw_step:
+                    rsym, sorted_d = rank_lane(a.arg2)
+                    a = dataclasses.replace(a, arg2=rsym)
+                    self.ex.dicts[f"{a.output}$key"] = sorted_d
+                if it is not None and it.is_dictionary:
+                    if raw_step and a.arg in self.ex.dicts:
+                        self.ex.dicts[a.output] = self.ex.dicts[a.arg]
+                        self.ex.dicts[f"{a.output}$val"] = self.ex.dicts[a.arg]
+                    elif f"{a.output}$val" in self.ex.dicts:
+                        self.ex.dicts[a.output] = (
+                            self.ex.dicts[f"{a.output}$val"]
+                        )
+            elif a.output_type.is_dictionary:  # arbitrary etc.
+                if raw_step and a.arg in self.ex.dicts:
+                    self.ex.dicts[a.output] = self.ex.dicts[a.arg]
+                    self.ex.dicts[f"{a.output}$val"] = self.ex.dicts[a.arg]
+                elif f"{a.output}$val" in self.ex.dicts:
+                    self.ex.dicts[a.output] = self.ex.dicts[f"{a.output}$val"]
+            aggs.append(a)
+        if lanes is not None:
+            b = dataclasses.replace(b, lanes=lanes)
+        return b, aggs
 
     def _direct_domains(self, keys, types) -> Optional[List[int]]:
         domains = []

@@ -2,7 +2,8 @@
 
 Reference parity: operator/HashAggregationOperator.java:53,
 operator/GroupByHash.java:29 (FlatGroupByHash/FlatHash open addressing),
-operator/aggregation/ (AccumulatorCompiler bytecode accumulators),
+operator/aggregation/ (112 aggregate function classes built on
+AccumulatorCompiler bytecode accumulators),
 aggregation/builder/InMemoryHashAggregationBuilder.java:50.
 
 TPU-first redesign — hash tables with random scatter are hostile to the MXU/
@@ -28,6 +29,19 @@ PARTIAL produces accumulator columns keyed by group; FINAL re-groups partial
 rows and merges accumulators — the same kernel pair handles both, which is
 also the distributed merge path (all-gather partials -> final, SURVEY §2.2).
 
+Aggregate function families (reference operator/aggregation/*):
+  count/count_star/count_if, sum, min, max, avg          — basic
+  var_pop/var_samp/stddev_pop/stddev_samp (+aliases)     — 2nd moments
+  covar_pop/covar_samp/corr/regr_slope/regr_intercept    — binary moments
+  geometric_mean                                          — log-sum
+  bool_and/bool_or (every)                                — boolean
+  bitwise_and_agg/bitwise_or_agg/bitwise_xor_agg          — bit-plane kernels
+  checksum                                                — order-independent
+  arbitrary (any_value)                                   — first non-null
+  min_by/max_by                                           — argmin/argmax
+  approx_distinct                                         — exact distinct here
+  approx_percentile                                       — sort-based exact
+
 NULL semantics: a NULL key is its own group (tracked via the validity bit as
 an extra radix/sort key); sum/min/max ignore NULL inputs and return NULL for
 empty groups; count counts non-NULL only.
@@ -45,25 +59,76 @@ from ..expr.lower import Lane
 
 I64_MAX = jnp.int64(2**62)
 
+# kinds whose accumulators are 2nd-moment sums over one input
+MOMENT_KINDS = ("var_samp", "var_pop", "stddev_samp", "stddev_pop")
+# kinds whose accumulators are moment sums over two inputs (y, x) —
+# argument order follows the reference (e.g. regr_slope(y, x))
+BINARY_MOMENT_KINDS = (
+    "covar_pop", "covar_samp", "corr", "regr_slope", "regr_intercept",
+)
+BITWISE_KINDS = ("bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg")
+# kinds that cannot be split into PARTIAL/FINAL (computed at SINGLE step
+# from raw rows; the planner must not push them through exchanges)
+NON_DECOMPOSABLE = ("approx_distinct", "approx_percentile")
+
+TWO_ARG_KINDS = ("min_by", "max_by") + BINARY_MOMENT_KINDS
+
 
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
     """One aggregate function instance (AggregatorFactory analog)."""
 
-    kind: str  # sum | count | count_star | min | max | avg
+    kind: str
     input: Optional[str]  # input column name (None for count_star)
     output: str
     input_type: Optional[T.Type] = None
     output_type: Optional[T.Type] = None
     distinct: bool = False
+    input2: Optional[str] = None  # second arg (min_by/max_by/corr/...)
+    input2_type: Optional[T.Type] = None
+    param: Optional[float] = None  # constant parameter (approx_percentile)
 
     @property
     def accumulator_names(self) -> List[str]:
-        if self.kind in ("avg",):
-            return [f"{self.output}$sum", f"{self.output}$count"]
+        o = self.output
+        if self.kind == "avg":
+            return [f"{o}$sum", f"{o}$count"]
         if self.kind in ("sum", "min", "max"):
-            return [f"{self.output}$val", f"{self.output}$valid"]
-        return [f"{self.output}$count"]
+            return [f"{o}$val", f"{o}$valid"]
+        if self.kind in MOMENT_KINDS:
+            return [f"{o}$sum", f"{o}$sumsq", f"{o}$count"]
+        if self.kind == "geometric_mean":
+            return [f"{o}$sumlog", f"{o}$count"]
+        if self.kind in BINARY_MOMENT_KINDS:
+            return [f"{o}$sy", f"{o}$sx", f"{o}$sxy", f"{o}$sxx",
+                    f"{o}$syy", f"{o}$n"]
+        if self.kind in ("bool_and", "bool_or", "checksum", "arbitrary",
+                         "approx_percentile") or self.kind in BITWISE_KINDS:
+            return [f"{o}$val", f"{o}$valid"]
+        if self.kind in ("min_by", "max_by"):
+            return [f"{o}$val", f"{o}$key", f"{o}$valid", f"{o}$has"]
+        # count / count_star / count_if / approx_distinct
+        return [f"{o}$count"]
+
+    def psum_kind(self, name: str) -> Optional[str]:
+        """How to merge this accumulator across mesh devices with a single
+        collective: 'sum' | 'min' | 'max', or None when a collective cannot
+        merge it (the executor must fall back to the gather+merge path)."""
+        if self.kind in ("min", "max") and name.endswith("$val"):
+            return self.kind
+        if self.kind == "bool_and" and name.endswith("$val"):
+            return "min"
+        if self.kind == "bool_or" and name.endswith("$val"):
+            return "max"
+        if self.kind in ("arbitrary", "min_by", "max_by") or (
+            self.kind in BITWISE_KINDS
+        ):
+            if not (name.endswith("$valid") or name.endswith("$has")
+                    or name.endswith("$count")):
+                return None
+        if self.kind in NON_DECOMPOSABLE:
+            return None
+        return "sum"
 
 
 def direct_group_ids(
@@ -139,6 +204,137 @@ def distinct_count(
                                num_segments=capacity)
 
 
+def _seg_sum(v, gid, cap):
+    return jax.ops.segment_sum(v, gid, num_segments=cap)
+
+
+def _splitmix64(v: jnp.ndarray) -> jnp.ndarray:
+    """splitmix64 finalizer — order-independent per-value hash for checksum.
+    (The reference's checksum xors XxHash64 values: aggregation/ChecksumAggregationFunction;
+    we sum splitmix64 hashes, equally order-independent.)"""
+    z = v.astype(jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+    z = z ^ (z >> 31)
+    return z.astype(jnp.int64)
+
+
+_BIT_SHIFTS = jnp.arange(64, dtype=jnp.uint64)
+
+
+def _segment_bitwise(vals, live, gid, cap, op: str, live_cnt=None):
+    """Per-group bitwise and/or/xor via one 2-D segment_sum over bit planes.
+
+    No segment_and/or exists in XLA; instead decompose into a [n, 64] 0/1
+    matrix, segment-sum it to per-group bit counts [cap, 64], then
+    AND = (count == group_size), OR = (count > 0), XOR = (count & 1).
+    """
+    u = vals.astype(jnp.uint64)
+    bits = ((u[:, None] >> _BIT_SHIFTS[None, :]) & jnp.uint64(1)).astype(
+        jnp.int32
+    )
+    bits = jnp.where(live[:, None], bits, 0)
+    sums = jax.ops.segment_sum(bits, gid, num_segments=cap)  # [cap, 64]
+    if live_cnt is None:
+        live_cnt = _seg_sum(live.astype(jnp.int32), gid, cap)
+    if op == "or":
+        outbits = (sums > 0)
+    elif op == "and":
+        outbits = (sums == live_cnt[:, None]) & (live_cnt[:, None] > 0)
+    else:  # xor
+        outbits = (sums & 1) == 1
+    vals64 = (outbits.astype(jnp.uint64) << _BIT_SHIFTS[None, :]).sum(
+        axis=1, dtype=jnp.uint64
+    )
+    return vals64.astype(jnp.int64)
+
+
+def _first_by_key(xlane, key, live, gid, cap, take_min: bool):
+    """Per-group x-value at the min/max key row (min_by/max_by kernel).
+
+    Two-pass argmin: (1) segment extremum of the key, (2) first row index
+    whose key equals the extremum, (3) gather x there."""
+    x, xok = xlane
+    n = gid.shape[0]
+    if key.dtype.kind == "f":
+        sentinel = jnp.inf if take_min else -jnp.inf
+        kv = jnp.where(live, key, sentinel)
+    else:
+        sentinel = I64_MAX if take_min else -I64_MAX
+        kv = jnp.where(live, key.astype(jnp.int64), sentinel)
+    seg = jax.ops.segment_min if take_min else jax.ops.segment_max
+    extremum = seg(kv, gid, num_segments=cap)
+    cand = live & (kv == extremum[gid])
+    ridx = jax.ops.segment_min(
+        jnp.where(cand, jnp.arange(n, dtype=jnp.int64), n), gid,
+        num_segments=cap,
+    )
+    has = ridx < n
+    safe = jnp.clip(ridx, 0, n - 1)
+    xv = x[safe]
+    xvalid = xok[safe] & has
+    zero = jnp.zeros_like(extremum)
+    return (
+        jnp.where(has, xv, jnp.zeros_like(xv)),
+        jnp.where(has, extremum, zero),
+        xvalid,
+        has,
+    )
+
+
+def _percentile(lane: Lane, sel, gid, cap, frac: float):
+    """Exact per-group percentile by sort (the engine's approx_percentile:
+    zero-error flavor of the reference's qdigest-based one)."""
+    v, ok = lane
+    live = sel & ok
+    n = gid.shape[0]
+    dead = jnp.logical_not(live)
+    vv = v.astype(jnp.int64) if v.dtype.kind in ("i", "u", "b") else v
+    d2, g2, v2 = jax.lax.sort((dead, gid, vv), num_keys=3)
+    live2 = jnp.logical_not(d2)
+    cnt = _seg_sum(live2.astype(jnp.int64), jnp.clip(g2, 0, cap - 1), cap)
+    start = jnp.cumsum(cnt) - cnt  # live rows sort before dead ones per gid?
+    # live rows of group g occupy a contiguous run; compute each sorted row's
+    # rank within its group
+    g2c = jnp.clip(g2, 0, cap - 1)
+    rank = jnp.arange(n, dtype=jnp.int64) - start[g2c]
+    target = jnp.clip(
+        jnp.floor(frac * (cnt - 1).astype(jnp.float64) + 0.5).astype(jnp.int64),
+        0,
+        jnp.maximum(cnt - 1, 0),
+    )
+    pick = live2 & (rank == target[g2c])
+    if v2.dtype.kind == "f":
+        out = jax.ops.segment_max(
+            jnp.where(pick, v2, -jnp.inf), g2c, num_segments=cap
+        )
+        out = jnp.where(cnt > 0, out, 0.0)
+    else:
+        out = jax.ops.segment_max(
+            jnp.where(pick, v2, -I64_MAX), g2c, num_segments=cap
+        )
+        out = jnp.where(cnt > 0, out, 0)
+    return out.astype(v.dtype) if v.dtype.kind != "f" else out, cnt > 0
+
+
+def _as_double(v: jnp.ndarray, t: Optional[T.Type]) -> jnp.ndarray:
+    """Numeric lane -> float64, unscaling fixed-point decimals."""
+    if t is None:
+        return v.astype(jnp.float64)
+    from ..expr.functions import to_double
+
+    return to_double(v, t)
+
+
+def _moment_sums(v, live, gid, cap, in_t):
+    x = jnp.where(live, _as_double(v, in_t), 0.0)
+    return (
+        _seg_sum(x, gid, cap),
+        _seg_sum(x * x, gid, cap),
+        _seg_sum(live.astype(jnp.int64), gid, cap),
+    )
+
+
 def accumulate(
     specs: Sequence[AggSpec],
     lanes: Dict[str, Lane],
@@ -148,41 +344,39 @@ def accumulate(
 ) -> Dict[str, jnp.ndarray]:
     """Compute accumulator arrays (shape [capacity]) per spec."""
     out: Dict[str, jnp.ndarray] = {}
+    cap = capacity
     for s in specs:
+        o = s.output
         if getattr(s, "distinct", False):
             if s.kind != "count":
                 raise NotImplementedError(f"{s.kind}(DISTINCT) not supported")
-            out[f"{s.output}$count"] = distinct_count(
-                gid, lanes[s.input], sel, capacity
-            )
+            out[f"{o}$count"] = distinct_count(gid, lanes[s.input], sel, cap)
             continue
         if s.kind == "count_star":
-            w = sel.astype(jnp.int64)
-            out[f"{s.output}$count"] = jax.ops.segment_sum(
-                w, gid, num_segments=capacity
-            )
+            out[f"{o}$count"] = _seg_sum(sel.astype(jnp.int64), gid, cap)
             continue
         v, ok = lanes[s.input]
         live = sel & ok
         if s.kind == "count":
-            out[f"{s.output}$count"] = jax.ops.segment_sum(
-                live.astype(jnp.int64), gid, num_segments=capacity
-            )
+            out[f"{o}$count"] = _seg_sum(live.astype(jnp.int64), gid, cap)
+        elif s.kind == "count_if":
+            hit = live & (v.astype(bool))
+            out[f"{o}$count"] = _seg_sum(hit.astype(jnp.int64), gid, cap)
+        elif s.kind == "approx_distinct":
+            out[f"{o}$count"] = distinct_count(gid, (v, ok), sel, cap)
         elif s.kind in ("sum", "avg"):
             if v.dtype.kind == "f":
                 vv = jnp.where(live, v, 0.0)
             else:
                 vv = jnp.where(live, v.astype(jnp.int64), 0)
-            ssum = jax.ops.segment_sum(vv, gid, num_segments=capacity)
-            cnt = jax.ops.segment_sum(
-                live.astype(jnp.int64), gid, num_segments=capacity
-            )
+            ssum = _seg_sum(vv, gid, cap)
+            cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
             if s.kind == "sum":
-                out[f"{s.output}$val"] = ssum
-                out[f"{s.output}$valid"] = cnt
+                out[f"{o}$val"] = ssum
+                out[f"{o}$valid"] = cnt
             else:
-                out[f"{s.output}$sum"] = ssum
-                out[f"{s.output}$count"] = cnt
+                out[f"{o}$sum"] = ssum
+                out[f"{o}$count"] = cnt
         elif s.kind in ("min", "max"):
             if v.dtype.kind == "f":
                 sentinel = jnp.inf if s.kind == "min" else -jnp.inf
@@ -191,10 +385,74 @@ def accumulate(
                 sentinel = I64_MAX if s.kind == "min" else -I64_MAX
                 vv = jnp.where(live, v.astype(jnp.int64), sentinel)
             seg = jax.ops.segment_min if s.kind == "min" else jax.ops.segment_max
-            out[f"{s.output}$val"] = seg(vv, gid, num_segments=capacity)
-            out[f"{s.output}$valid"] = jax.ops.segment_sum(
-                live.astype(jnp.int64), gid, num_segments=capacity
+            out[f"{o}$val"] = seg(vv, gid, num_segments=cap)
+            out[f"{o}$valid"] = _seg_sum(live.astype(jnp.int64), gid, cap)
+        elif s.kind in MOMENT_KINDS:
+            sm, sq, cnt = _moment_sums(v, live, gid, cap, s.input_type)
+            out[f"{o}$sum"], out[f"{o}$sumsq"], out[f"{o}$count"] = sm, sq, cnt
+        elif s.kind == "geometric_mean":
+            x = _as_double(v, s.input_type)
+            lx = jnp.where(live & (x > 0), jnp.log(jnp.maximum(x, 1e-300)), 0.0)
+            out[f"{o}$sumlog"] = _seg_sum(lx, gid, cap)
+            out[f"{o}$count"] = _seg_sum(live.astype(jnp.int64), gid, cap)
+        elif s.kind in BINARY_MOMENT_KINDS:
+            y, yok = lanes[s.input]
+            x, xok = lanes[s.input2]
+            both = sel & yok & xok
+            xf = jnp.where(both, _as_double(x, s.input2_type), 0.0)
+            yf = jnp.where(both, _as_double(y, s.input_type), 0.0)
+            out[f"{o}$sy"] = _seg_sum(yf, gid, cap)
+            out[f"{o}$sx"] = _seg_sum(xf, gid, cap)
+            out[f"{o}$sxy"] = _seg_sum(xf * yf, gid, cap)
+            out[f"{o}$sxx"] = _seg_sum(xf * xf, gid, cap)
+            out[f"{o}$syy"] = _seg_sum(yf * yf, gid, cap)
+            out[f"{o}$n"] = _seg_sum(both.astype(jnp.int64), gid, cap)
+        elif s.kind in ("bool_and", "bool_or"):
+            cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
+            if s.kind == "bool_and":
+                vv = jnp.where(live, v.astype(jnp.int64), 1)
+                out[f"{o}$val"] = jax.ops.segment_min(vv, gid, num_segments=cap)
+            else:
+                vv = jnp.where(live, v.astype(jnp.int64), 0)
+                out[f"{o}$val"] = jax.ops.segment_max(vv, gid, num_segments=cap)
+            out[f"{o}$valid"] = cnt
+        elif s.kind in BITWISE_KINDS:
+            op = {"bitwise_and_agg": "and", "bitwise_or_agg": "or",
+                  "bitwise_xor_agg": "xor"}[s.kind]
+            cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
+            out[f"{o}$val"] = _segment_bitwise(
+                v, live, gid, cap, op, cnt.astype(jnp.int32)
             )
+            out[f"{o}$valid"] = cnt
+        elif s.kind == "checksum":
+            addend = jnp.where(
+                ok, _splitmix64(v), jnp.int64(0x6E67_6C6C_7561)
+            )
+            out[f"{o}$val"] = _seg_sum(jnp.where(sel, addend, 0), gid, cap)
+            out[f"{o}$valid"] = _seg_sum(sel.astype(jnp.int64), gid, cap)
+        elif s.kind == "arbitrary":
+            n = gid.shape[0]
+            ridx = jax.ops.segment_min(
+                jnp.where(live, jnp.arange(n, dtype=jnp.int64), n), gid,
+                num_segments=cap,
+            )
+            has = ridx < n
+            safe = jnp.clip(ridx, 0, n - 1)
+            out[f"{o}$val"] = jnp.where(has, v[safe], jnp.zeros_like(v[safe]))
+            out[f"{o}$valid"] = has.astype(jnp.int64)
+        elif s.kind in ("min_by", "max_by"):
+            key, kok = lanes[s.input2]
+            xv, kv, xvalid, has = _first_by_key(
+                (v, ok), key, sel & kok, gid, cap, s.kind == "min_by"
+            )
+            out[f"{o}$val"] = xv
+            out[f"{o}$key"] = kv
+            out[f"{o}$valid"] = xvalid.astype(jnp.int64)
+            out[f"{o}$has"] = has.astype(jnp.int64)
+        elif s.kind == "approx_percentile":
+            val, valid = _percentile((v, ok), sel, gid, cap, float(s.param))
+            out[f"{o}$val"] = val
+            out[f"{o}$valid"] = valid.astype(jnp.int64)
         else:
             raise NotImplementedError(s.kind)
     return out
@@ -209,36 +467,37 @@ def merge_accumulators(
 ) -> Dict[str, jnp.ndarray]:
     """FINAL step: merge partial accumulator rows grouped by gid."""
     out: Dict[str, jnp.ndarray] = {}
+    cap = capacity
     w = sel
+
+    def msum(name, zero=0):
+        v, _ = acc_lanes[name]
+        z = 0.0 if v.dtype.kind == "f" else zero
+        out[name] = _seg_sum(jnp.where(w, v, z), gid, cap)
+
     for s in specs:
-        if s.kind in ("count", "count_star"):
-            v, _ = acc_lanes[f"{s.output}$count"]
-            out[f"{s.output}$count"] = jax.ops.segment_sum(
-                jnp.where(w, v, 0), gid, num_segments=capacity
-            )
+        o = s.output
+        if s.kind in ("count", "count_star", "count_if", "approx_distinct"):
+            msum(f"{o}$count")
         elif s.kind == "avg":
-            sv, _ = acc_lanes[f"{s.output}$sum"]
-            cv, _ = acc_lanes[f"{s.output}$count"]
-            zero = 0.0 if sv.dtype.kind == "f" else 0
-            out[f"{s.output}$sum"] = jax.ops.segment_sum(
-                jnp.where(w, sv, zero), gid, num_segments=capacity
-            )
-            out[f"{s.output}$count"] = jax.ops.segment_sum(
-                jnp.where(w, cv, 0), gid, num_segments=capacity
-            )
+            msum(f"{o}$sum")
+            msum(f"{o}$count")
         elif s.kind == "sum":
-            sv, _ = acc_lanes[f"{s.output}$val"]
-            cv, _ = acc_lanes[f"{s.output}$valid"]
-            zero = 0.0 if sv.dtype.kind == "f" else 0
-            out[f"{s.output}$val"] = jax.ops.segment_sum(
-                jnp.where(w, sv, zero), gid, num_segments=capacity
-            )
-            out[f"{s.output}$valid"] = jax.ops.segment_sum(
-                jnp.where(w, cv, 0), gid, num_segments=capacity
-            )
+            msum(f"{o}$val")
+            msum(f"{o}$valid")
+        elif s.kind in MOMENT_KINDS:
+            msum(f"{o}$sum")
+            msum(f"{o}$sumsq")
+            msum(f"{o}$count")
+        elif s.kind == "geometric_mean":
+            msum(f"{o}$sumlog")
+            msum(f"{o}$count")
+        elif s.kind in BINARY_MOMENT_KINDS:
+            for suf in ("$sy", "$sx", "$sxy", "$sxx", "$syy", "$n"):
+                msum(o + suf)
         elif s.kind in ("min", "max"):
-            sv, _ = acc_lanes[f"{s.output}$val"]
-            cv, _ = acc_lanes[f"{s.output}$valid"]
+            sv, _ = acc_lanes[f"{o}$val"]
+            cv, _ = acc_lanes[f"{o}$valid"]
             has = w & (cv > 0)
             if sv.dtype.kind == "f":
                 sentinel = jnp.inf if s.kind == "min" else -jnp.inf
@@ -246,10 +505,56 @@ def merge_accumulators(
                 sentinel = I64_MAX if s.kind == "min" else -I64_MAX
             vv = jnp.where(has, sv, sentinel)
             seg = jax.ops.segment_min if s.kind == "min" else jax.ops.segment_max
-            out[f"{s.output}$val"] = seg(vv, gid, num_segments=capacity)
-            out[f"{s.output}$valid"] = jax.ops.segment_sum(
-                jnp.where(w, cv, 0), gid, num_segments=capacity
+            out[f"{o}$val"] = seg(vv, gid, num_segments=cap)
+            out[f"{o}$valid"] = _seg_sum(jnp.where(w, cv, 0), gid, cap)
+        elif s.kind in ("bool_and", "bool_or"):
+            sv, _ = acc_lanes[f"{o}$val"]
+            cv, _ = acc_lanes[f"{o}$valid"]
+            has = w & (cv > 0)
+            if s.kind == "bool_and":
+                vv = jnp.where(has, sv, 1)
+                out[f"{o}$val"] = jax.ops.segment_min(vv, gid, num_segments=cap)
+            else:
+                vv = jnp.where(has, sv, 0)
+                out[f"{o}$val"] = jax.ops.segment_max(vv, gid, num_segments=cap)
+            out[f"{o}$valid"] = _seg_sum(jnp.where(w, cv, 0), gid, cap)
+        elif s.kind in BITWISE_KINDS:
+            sv, _ = acc_lanes[f"{o}$val"]
+            cv, _ = acc_lanes[f"{o}$valid"]
+            has = w & (cv > 0)
+            op = {"bitwise_and_agg": "and", "bitwise_or_agg": "or",
+                  "bitwise_xor_agg": "xor"}[s.kind]
+            out[f"{o}$val"] = _segment_bitwise(sv, has, gid, cap, op)
+            out[f"{o}$valid"] = _seg_sum(jnp.where(w, cv, 0), gid, cap)
+        elif s.kind == "checksum":
+            msum(f"{o}$val")
+            msum(f"{o}$valid")
+        elif s.kind == "arbitrary":
+            sv, _ = acc_lanes[f"{o}$val"]
+            cv, _ = acc_lanes[f"{o}$valid"]
+            has = w & (cv > 0)
+            n = gid.shape[0]
+            ridx = jax.ops.segment_min(
+                jnp.where(has, jnp.arange(n, dtype=jnp.int64), n), gid,
+                num_segments=cap,
             )
+            ok2 = ridx < n
+            safe = jnp.clip(ridx, 0, n - 1)
+            out[f"{o}$val"] = jnp.where(ok2, sv[safe], jnp.zeros_like(sv[safe]))
+            out[f"{o}$valid"] = ok2.astype(jnp.int64)
+        elif s.kind in ("min_by", "max_by"):
+            sv, _ = acc_lanes[f"{o}$val"]
+            kv, _ = acc_lanes[f"{o}$key"]
+            xval, _ = acc_lanes[f"{o}$valid"]
+            hv, _ = acc_lanes[f"{o}$has"]
+            has = w & (hv > 0)
+            xv, kk, xvalid, has2 = _first_by_key(
+                (sv, xval > 0), kv, has, gid, cap, s.kind == "min_by"
+            )
+            out[f"{o}$val"] = xv
+            out[f"{o}$key"] = kk
+            out[f"{o}$valid"] = xvalid.astype(jnp.int64)
+            out[f"{o}$has"] = has2.astype(jnp.int64)
         else:
             raise NotImplementedError(s.kind)
     return out
@@ -261,21 +566,22 @@ def finalize(
     """Accumulators -> output lanes (SINGLE/FINAL output step)."""
     out: Dict[str, Lane] = {}
     for s in specs:
-        if s.kind in ("count", "count_star"):
-            c = accs[f"{s.output}$count"]
-            out[s.output] = (c, jnp.ones(c.shape, bool))
+        o = s.output
+        if s.kind in ("count", "count_star", "count_if", "approx_distinct"):
+            c = accs[f"{o}$count"]
+            out[o] = (c, jnp.ones(c.shape, bool))
         elif s.kind == "sum":
-            v = accs[f"{s.output}$val"]
-            cnt = accs[f"{s.output}$valid"]
-            out[s.output] = (v, cnt > 0)
+            v = accs[f"{o}$val"]
+            cnt = accs[f"{o}$valid"]
+            out[o] = (v, cnt > 0)
         elif s.kind in ("min", "max"):
-            v = accs[f"{s.output}$val"]
-            cnt = accs[f"{s.output}$valid"]
+            v = accs[f"{o}$val"]
+            cnt = accs[f"{o}$valid"]
             zero = jnp.zeros_like(v)
-            out[s.output] = (jnp.where(cnt > 0, v, zero), cnt > 0)
+            out[o] = (jnp.where(cnt > 0, v, zero), cnt > 0)
         elif s.kind == "avg":
-            ssum = accs[f"{s.output}$sum"]
-            cnt = accs[f"{s.output}$count"]
+            ssum = accs[f"{o}$sum"]
+            cnt = accs[f"{o}$count"]
             den = jnp.maximum(cnt, 1)
             ot = s.output_type
             if ssum.dtype.kind == "f":
@@ -294,7 +600,70 @@ def finalize(
                 v = sign * (q + (2 * rem >= den))
             else:
                 v = ssum // den
-            out[s.output] = (v, cnt > 0)
+            out[o] = (v, cnt > 0)
+        elif s.kind in MOMENT_KINDS:
+            sm = accs[f"{o}$sum"]
+            sq = accs[f"{o}$sumsq"]
+            cnt = accs[f"{o}$count"]
+            n = jnp.maximum(cnt, 1).astype(jnp.float64)
+            m2 = jnp.maximum(sq - sm * sm / n, 0.0)
+            pop = s.kind in ("var_pop", "stddev_pop")
+            if pop:
+                var = m2 / n
+                valid = cnt > 0
+            else:
+                var = m2 / jnp.maximum(n - 1, 1.0)
+                valid = cnt > 1
+            v = jnp.sqrt(var) if s.kind.startswith("stddev") else var
+            out[o] = (v, valid)
+        elif s.kind == "geometric_mean":
+            sl = accs[f"{o}$sumlog"]
+            cnt = accs[f"{o}$count"]
+            n = jnp.maximum(cnt, 1).astype(jnp.float64)
+            out[o] = (jnp.exp(sl / n), cnt > 0)
+        elif s.kind in BINARY_MOMENT_KINDS:
+            sy = accs[f"{o}$sy"]
+            sx = accs[f"{o}$sx"]
+            sxy = accs[f"{o}$sxy"]
+            sxx = accs[f"{o}$sxx"]
+            syy = accs[f"{o}$syy"]
+            cnt = accs[f"{o}$n"]
+            n = jnp.maximum(cnt, 1).astype(jnp.float64)
+            cxy = sxy - sx * sy / n
+            cxx = jnp.maximum(sxx - sx * sx / n, 0.0)
+            cyy = jnp.maximum(syy - sy * sy / n, 0.0)
+            if s.kind == "covar_pop":
+                v, valid = cxy / n, cnt > 0
+            elif s.kind == "covar_samp":
+                v, valid = cxy / jnp.maximum(n - 1, 1.0), cnt > 1
+            elif s.kind == "corr":
+                den = jnp.sqrt(cxx * cyy)
+                v = jnp.where(den > 0, cxy / jnp.maximum(den, 1e-300), 0.0)
+                valid = (cnt > 0) & (den > 0)
+            elif s.kind == "regr_slope":
+                v = jnp.where(cxx > 0, cxy / jnp.maximum(cxx, 1e-300), 0.0)
+                valid = (cnt > 0) & (cxx > 0)
+            else:  # regr_intercept
+                slope = jnp.where(cxx > 0, cxy / jnp.maximum(cxx, 1e-300), 0.0)
+                v = (sy - slope * sx) / n
+                valid = (cnt > 0) & (cxx > 0)
+            out[o] = (v, valid)
+        elif s.kind in ("bool_and", "bool_or"):
+            v = accs[f"{o}$val"]
+            cnt = accs[f"{o}$valid"]
+            out[o] = (v.astype(bool), cnt > 0)
+        elif s.kind in BITWISE_KINDS or s.kind == "checksum":
+            v = accs[f"{o}$val"]
+            cnt = accs[f"{o}$valid"]
+            out[o] = (v, cnt > 0)
+        elif s.kind in ("arbitrary", "approx_percentile"):
+            v = accs[f"{o}$val"]
+            cnt = accs[f"{o}$valid"]
+            out[o] = (v, cnt > 0)
+        elif s.kind in ("min_by", "max_by"):
+            v = accs[f"{o}$val"]
+            xvalid = accs[f"{o}$valid"]
+            out[o] = (v, xvalid > 0)
         else:
             raise NotImplementedError(s.kind)
     return out

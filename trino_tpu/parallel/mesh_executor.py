@@ -259,19 +259,27 @@ class _MeshTraceCtx(_TraceCtx):
     # -- aggregation -----------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate) -> Batch:
         b = self.visit(node.source)
-        if any(a.distinct for a in node.aggs) and not b.replicated:
-            # DISTINCT aggregation needs global dedup: gather input rows
-            # (single-distribution fragment; hash-repartitioned distinct
-            # is the next increment)
+        psum_able = all(
+            s.psum_kind(n) is not None
+            for a in node.aggs
+            for s in (a.to_spec(),)
+            for n in s.accumulator_names
+        )
+        if not b.replicated and (
+            any(a.distinct or not a.partializable for a in node.aggs)
+            or (not psum_able and not node.keys)
+        ):
+            # DISTINCT and non-decomposable aggregates (approx_percentile,
+            # approx_distinct) need the raw rows in one place — and global
+            # aggregates whose accumulators no collective can merge
+            # (min_by/bitwise/arbitrary) need a gather instead of psum.
             b = _gather_batch(b)
         if b.replicated:
             out = _TraceCtx._visit_aggregate(self, node, b)
             return Batch(out.lanes, out.sel, out.ordered, replicated=True)
         types = node.source.output_types()
-        specs = [
-            agg_ops.AggSpec(a.kind, a.arg, a.output, a.input_type, a.output_type)
-            for a in node.aggs
-        ]
+        b, aggs = self._agg_dict_setup(node, b)
+        specs = [a.to_spec() for a in aggs]
 
         if not node.keys:
             gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)
@@ -287,7 +295,7 @@ class _MeshTraceCtx(_TraceCtx):
 
         key_lanes = [b.lanes[k] for k in node.keys]
         domains = self._direct_domains(node.keys, types)
-        if domains is not None:
+        if domains is not None and psum_able:
             gid, cap = agg_ops.direct_group_ids(key_lanes, domains)
             accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, cap)
             present_local = (
@@ -365,15 +373,14 @@ class _MeshTraceCtx(_TraceCtx):
         return self.ex.mesh.devices.size
 
     def _psum_accs(self, specs, accs):
+        """Cross-device accumulator merge by collective; callers must have
+        checked psum_kind != None for every accumulator first."""
         out = {}
+        ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
         for s in specs:
             for name in s.accumulator_names:
-                arr = accs[name]
-                if s.kind in ("min", "max") and name.endswith("$val"):
-                    op = jax.lax.pmin if s.kind == "min" else jax.lax.pmax
-                    out[name] = op(arr, AXIS)
-                else:
-                    out[name] = jax.lax.psum(arr, AXIS)
+                kind = s.psum_kind(name)
+                out[name] = ops[kind](accs[name], AXIS)
         return out
 
     # -- joins ----------------------------------------------------------

@@ -101,11 +101,23 @@ class Project(PlanNode):
 @dataclasses.dataclass(frozen=True)
 class AggInfo:
     output: str
-    kind: str  # sum|count|count_star|min|max|avg
+    kind: str  # sum|count|min_by|corr|... (see ops/aggregation.py families)
     arg: Optional[str]  # input symbol
     distinct: bool
     input_type: Optional[T.Type]
     output_type: T.Type
+    arg2: Optional[str] = None  # second input (min_by/max_by/corr/regr_*)
+    input2_type: Optional[T.Type] = None
+    param: Optional[float] = None  # constant parameter (approx_percentile)
+
+    def to_spec(self):
+        from ..ops.aggregation import AggSpec
+
+        return AggSpec(
+            self.kind, self.arg, self.output, self.input_type,
+            self.output_type, self.distinct, self.arg2, self.input2_type,
+            self.param,
+        )
 
     def accumulator_schema(self) -> List[Tuple[str, T.Type]]:
         """Intermediate (PARTIAL-step output) columns for this aggregate —
@@ -113,12 +125,9 @@ class AggInfo:
         between PARTIAL and FINAL HashAggregationOperators.  Names come from
         the kernel's AggSpec.accumulator_names (the single source of truth
         for the accumulator layout); only the wire types are decided here."""
-        from ..ops.aggregation import AggSpec
+        from ..ops import aggregation as A
 
-        names = AggSpec(
-            self.kind, self.arg, self.output, self.input_type,
-            self.output_type, self.distinct,
-        ).accumulator_names
+        names = self.to_spec().accumulator_names
         it = self.input_type
         if it is not None and it.name in ("double", "real"):
             sum_t = T.DOUBLE
@@ -126,21 +135,36 @@ class AggInfo:
             sum_t = it
         else:
             sum_t = T.BIGINT
+        moment = (
+            self.kind in A.MOMENT_KINDS
+            or self.kind in A.BINARY_MOMENT_KINDS
+            or self.kind == "geometric_mean"
+        )
 
         def type_for(name: str) -> T.Type:
-            if name.endswith("$count") or name.endswith("$valid"):
+            if (name.endswith("$count") or name.endswith("$valid")
+                    or name.endswith("$has") or name.endswith("$n")):
                 return T.BIGINT
-            if self.kind in ("min", "max"):  # $val keeps the input type
-                return it if it is not None else T.BIGINT
+            if moment:  # $sum/$sumsq/$sumlog/$sx... are float moments
+                return T.DOUBLE
+            if name.endswith("$key"):  # min_by/max_by ordering key
+                return self.input2_type if self.input2_type else T.BIGINT
+            if self.kind in ("min", "max", "arbitrary", "min_by", "max_by",
+                             "approx_percentile"):
+                return it if it is not None else T.BIGINT  # $val keeps input
+            if self.kind in ("bool_and", "bool_or", "checksum") or (
+                self.kind in A.BITWISE_KINDS
+            ):
+                return T.BIGINT
             return sum_t  # sum's $val / avg's $sum promote
 
         return [(n, type_for(n)) for n in names]
 
     @property
     def partializable(self) -> bool:
-        return not self.distinct and self.kind in (
-            "sum", "count", "count_star", "min", "max", "avg",
-        )
+        from ..ops import aggregation as A
+
+        return not self.distinct and self.kind not in A.NON_DECOMPOSABLE
 
 
 @dataclasses.dataclass(frozen=True)

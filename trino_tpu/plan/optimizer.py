@@ -471,7 +471,11 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
             return P.Filter(prune(node.source, need), node.predicate)
         if isinstance(node, P.Aggregate):
             kept_aggs = tuple(a for a in node.aggs if a.output in required)
-            need = set(node.keys) | {a.arg for a in kept_aggs if a.arg}
+            need = (
+                set(node.keys)
+                | {a.arg for a in kept_aggs if a.arg}
+                | {a.arg2 for a in kept_aggs if a.arg2}
+            )
             return P.Aggregate(
                 prune(node.source, need), node.keys, kept_aggs, node.step
             )

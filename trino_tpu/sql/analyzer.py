@@ -36,7 +36,29 @@ from ..ops.sort import SortKey
 from ..plan import nodes as P
 from . import ast
 
-AGGREGATES = {"sum", "count", "min", "max", "avg"}
+# canonical aggregate kinds (ops/aggregation.py families) + SQL aliases
+AGG_ALIASES = {
+    "stddev": "stddev_samp",
+    "variance": "var_samp",
+    "every": "bool_and",
+    "any_value": "arbitrary",
+}
+ONE_ARG_AGGREGATES = {
+    "sum", "count", "min", "max", "avg",
+    "var_samp", "var_pop", "stddev_samp", "stddev_pop", "geometric_mean",
+    "bool_and", "bool_or",
+    "bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg",
+    "checksum", "arbitrary", "count_if", "approx_distinct",
+}
+TWO_ARG_AGGREGATES = {
+    "min_by", "max_by",
+    "covar_pop", "covar_samp", "corr",
+    "regr_slope", "regr_intercept",
+    "approx_percentile",
+}
+AGGREGATES = (
+    ONE_ARG_AGGREGATES | TWO_ARG_AGGREGATES | set(AGG_ALIASES)
+)
 
 WINDOW_ONLY_FUNCTIONS = {
     "row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
@@ -1392,29 +1414,63 @@ class AggCollector(ExprAnalyzer):
         return self._an(e)  # will raise a descriptive error
 
     def _aggregate_call(self, e: ast.FunctionCall) -> ir.ColumnRef:
-        kind = e.name
+        kind = AGG_ALIASES.get(e.name, e.name)
+        arg_sym = arg2_sym = None
+        in_t = in2_t = None
+        param = None
+
+        def to_symbol(arg: ir.Expr, label: str) -> str:
+            if isinstance(arg, ir.ColumnRef):
+                return arg.name
+            sym = self.a.symbols.new(label)
+            self.pre_assigns.append((sym, arg))
+            return sym
+
         if e.is_star:
             kind = "count_star"
-            arg_sym = None
-            in_t = None
             out_t = T.BIGINT
+        elif kind in TWO_ARG_AGGREGATES:
+            if len(e.args) != 2:
+                raise SemanticError(f"{e.name} takes two arguments")
+            arg = self._an(e.args[0])
+            in_t = arg.type
+            arg_sym = to_symbol(arg, f"{kind}arg")
+            if kind == "approx_percentile":
+                # second argument is the constant percentile fraction
+                p = self._an(e.args[1])
+                if not isinstance(p, ir.Constant) or p.value is None:
+                    raise SemanticError(
+                        "approx_percentile requires a constant percentile"
+                    )
+                param = float(p.value) / (
+                    10 ** p.type.scale if p.type.is_decimal else 1
+                )
+                if not (0.0 <= param <= 1.0):
+                    raise SemanticError("percentile must be in [0, 1]")
+            else:
+                arg2 = self._an(e.args[1])
+                in2_t = arg2.type
+                arg2_sym = to_symbol(arg2, f"{kind}arg2")
+            out_t = _agg_output_type(kind, in_t, in2_t)
         else:
-            if len(e.args) != 1:
+            # approx_distinct accepts an optional max-standard-error second
+            # argument (ignored: this engine's implementation is exact)
+            nargs = len(e.args)
+            if kind == "approx_distinct" and nargs == 2:
+                nargs = 1  # drop the max-standard-error argument
+            if nargs != 1:
                 raise SemanticError(f"{e.name} takes one argument")
             arg = self._an(e.args[0])  # pre-agg scope
             in_t = arg.type
             out_t = _agg_output_type(kind, in_t)
-            if isinstance(arg, ir.ColumnRef):
-                arg_sym = arg.name
-            else:
-                arg_sym = self.a.symbols.new(f"{kind}arg")
-                self.pre_assigns.append((arg_sym, arg))
-        cache_key = (kind, arg_sym, e.distinct)
+            arg_sym = to_symbol(arg, f"{kind}arg")
+        cache_key = (kind, arg_sym, arg2_sym, param, e.distinct)
         if cache_key in self._agg_cache:
             return self._agg_cache[cache_key]
         out_sym = self.a.symbols.new(kind)
         self.aggs.append(
-            P.AggInfo(out_sym, kind, arg_sym, e.distinct, in_t, out_t)
+            P.AggInfo(out_sym, kind, arg_sym, e.distinct, in_t, out_t,
+                      arg2_sym, in2_t, param)
         )
         ref = ir.ColumnRef(out_t, out_sym)
         self._agg_cache[cache_key] = ref
@@ -1561,10 +1617,22 @@ def _check_comparable(a: T.Type, b: T.Type):
         raise SemanticError(f"cannot compare {a} and {b}")
 
 
-def _agg_output_type(kind: str, in_t: T.Type) -> T.Type:
-    if kind == "count":
+def _agg_output_type(
+    kind: str, in_t: T.Type, in2_t: Optional[T.Type] = None
+) -> T.Type:
+    if kind in ("count", "count_if", "approx_distinct"):
+        if kind == "count_if" and in_t.name not in ("boolean", "unknown"):
+            raise SemanticError("count_if requires a boolean argument")
         return T.BIGINT
-    if kind in ("min", "max"):
+    if kind == "approx_percentile":
+        if not T.is_numeric(in_t) and in_t.name != "unknown":
+            raise SemanticError("approx_percentile requires a numeric argument")
+        return in_t
+    if kind in ("min", "max", "arbitrary"):
+        return in_t
+    if kind in ("min_by", "max_by"):
+        if in2_t is not None and not in2_t.orderable:
+            raise SemanticError(f"{kind} ordering key must be orderable")
         return in_t
     if kind == "sum":
         if in_t.is_decimal:
@@ -1578,6 +1646,23 @@ def _agg_output_type(kind: str, in_t: T.Type) -> T.Type:
             # within rounding noise of exact decimal(38) math
             return T.decimal(18, max(in_t.scale, 6))
         return T.DOUBLE
+    if kind in ("var_samp", "var_pop", "stddev_samp", "stddev_pop",
+                "geometric_mean", "covar_pop", "covar_samp", "corr",
+                "regr_slope", "regr_intercept"):
+        for t in (in_t, in2_t):
+            if t is not None and not T.is_numeric(t) and t.name != "unknown":
+                raise SemanticError(f"{kind} requires numeric arguments")
+        return T.DOUBLE
+    if kind in ("bool_and", "bool_or"):
+        if in_t.name not in ("boolean", "unknown"):
+            raise SemanticError(f"{kind} requires a boolean argument")
+        return T.BOOLEAN
+    if kind in ("bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg"):
+        if not T.is_integral(in_t) and in_t.name != "unknown":
+            raise SemanticError(f"{kind} requires an integral argument")
+        return T.BIGINT
+    if kind == "checksum":
+        return T.BIGINT
     raise SemanticError(kind)
 
 
@@ -1592,12 +1677,6 @@ def _fold(e: ir.Expr) -> ir.Expr:
             return e
         if any(a.value is None for a in e.args):
             return ir.Constant(e.type, None)
-        vals = []
-        for a in e.args:
-            v = a.value
-            if a.type.is_decimal:
-                v = (v, a.type.scale)
-            vals.append(v)
         try:
             v = _eval_const(e.name, e.type, e.args)
         except (NotImplementedError, ValueError, OverflowError, ArithmeticError):
