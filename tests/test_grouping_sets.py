@@ -1,0 +1,151 @@
+"""GROUPING SETS / ROLLUP / CUBE / grouping() tests.
+
+Reference parity: GroupIdNode + GroupIdOperator + GroupingOperationRewriter
+(sql/planner/); sqlite has no GROUPING SETS, so the oracle side uses the
+UNION ALL expansion each construct is defined as.
+"""
+import sqlite3
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from trino_tpu.session import tpch_session
+
+SF = 0.001
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["nation", "orders", "lineitem"])
+    return conn
+
+
+def check(session, oracle_conn, sql, oracle_sql):
+    actual = session.execute(sql).to_pylist()
+    expected = oracle_conn.execute(oracle_sql).fetchall()
+    assert_rows_match(actual, expected, ordered=False)
+
+
+def test_rollup_one_key(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select n_regionkey, count(*) from nation group by rollup(n_regionkey)",
+        "select n_regionkey, count(*) from nation group by n_regionkey "
+        "union all select null, count(*) from nation",
+    )
+
+
+def test_rollup_two_keys(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+        "from lineitem group by rollup(l_returnflag, l_linestatus)",
+        "select l_returnflag, l_linestatus, sum(l_quantity), count(*) "
+        "from lineitem group by l_returnflag, l_linestatus "
+        "union all select l_returnflag, null, sum(l_quantity), count(*) "
+        "from lineitem group by l_returnflag "
+        "union all select null, null, sum(l_quantity), count(*) from lineitem",
+    )
+
+
+def test_cube(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select l_returnflag, l_linestatus, sum(l_quantity) "
+        "from lineitem group by cube(l_returnflag, l_linestatus)",
+        "select l_returnflag, l_linestatus, sum(l_quantity) "
+        "from lineitem group by l_returnflag, l_linestatus "
+        "union all select l_returnflag, null, sum(l_quantity) "
+        "from lineitem group by l_returnflag "
+        "union all select null, l_linestatus, sum(l_quantity) "
+        "from lineitem group by l_linestatus "
+        "union all select null, null, sum(l_quantity) from lineitem",
+    )
+
+
+def test_grouping_sets_explicit(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select n_regionkey, n_nationkey, count(*) from nation "
+        "group by grouping sets ((n_regionkey), (n_nationkey), ())",
+        "select n_regionkey, null, count(*) from nation group by n_regionkey "
+        "union all select null, n_nationkey, count(*) from nation "
+        "group by n_nationkey "
+        "union all select null, null, count(*) from nation",
+    )
+
+
+def test_grouping_sets_mixed_with_plain_key(session, oracle_conn):
+    # plain key cross-products with the grouping-sets element
+    check(
+        session, oracle_conn,
+        "select l_returnflag, l_linestatus, sum(l_quantity) from lineitem "
+        "group by l_returnflag, grouping sets ((l_linestatus), ())",
+        "select l_returnflag, l_linestatus, sum(l_quantity) "
+        "from lineitem group by l_returnflag, l_linestatus "
+        "union all select l_returnflag, null, sum(l_quantity) "
+        "from lineitem group by l_returnflag",
+    )
+
+
+def test_grouping_function(session):
+    out = session.execute(
+        "select l_returnflag, l_linestatus, "
+        "grouping(l_returnflag, l_linestatus), grouping(l_linestatus) "
+        "from lineitem group by cube(l_returnflag, l_linestatus)"
+    ).to_pylist()
+    for flag, status, g2, g1 in out:
+        expected = ((flag is None) << 1) | (status is None)
+        assert g2 == expected, (flag, status, g2)
+        assert g1 == (1 if status is None else 0)
+
+
+def test_grouping_with_plain_group_by(session):
+    out = session.execute(
+        "select l_returnflag, grouping(l_returnflag) from lineitem "
+        "group by l_returnflag"
+    ).to_pylist()
+    assert all(g == 0 for _, g in out) and len(out) == 3
+
+
+def test_rollup_with_having_on_grouping(session, oracle_conn):
+    # HAVING grouping(...) filters set rows (only the per-flag subtotals)
+    check(
+        session, oracle_conn,
+        "select l_returnflag, l_linestatus, sum(l_quantity) from lineitem "
+        "group by rollup(l_returnflag, l_linestatus) "
+        "having grouping(l_returnflag, l_linestatus) = 1",
+        "select l_returnflag, null, sum(l_quantity) from lineitem "
+        "group by l_returnflag",
+    )
+
+
+def test_rollup_aggregates_merge_totals(session, oracle_conn):
+    # min/max/avg across the whole rollup hierarchy
+    check(
+        session, oracle_conn,
+        "select l_returnflag, min(l_quantity), max(l_quantity), "
+        "avg(l_extendedprice) from lineitem group by rollup(l_returnflag)",
+        "select l_returnflag, min(l_quantity), max(l_quantity), "
+        "avg(l_extendedprice) from lineitem group by l_returnflag "
+        "union all select null, min(l_quantity), max(l_quantity), "
+        "avg(l_extendedprice) from lineitem",
+    )
+
+
+def test_grouping_sets_varchar_keys(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderpriority, o_orderstatus, count(*) from orders "
+        "group by grouping sets ((o_orderpriority), (o_orderstatus))",
+        "select o_orderpriority, null, count(*) from orders "
+        "group by o_orderpriority "
+        "union all select null, o_orderstatus, count(*) from orders "
+        "group by o_orderstatus",
+    )

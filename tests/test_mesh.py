@@ -186,3 +186,21 @@ def test_approx_percentile_distributed(session, mesh_exec):
         "select approx_percentile(o_totalprice, 0.5), "
         "approx_distinct(o_custkey) from orders",
     )
+
+
+def test_rollup_distributed(session, mesh_exec):
+    run_both(
+        session, mesh_exec,
+        "select o_orderpriority, count(*), sum(o_totalprice) from orders "
+        "group by rollup(o_orderpriority) order by o_orderpriority",
+    )
+
+
+def test_grouping_sets_distributed(session, mesh_exec):
+    run_both(
+        session, mesh_exec,
+        "select o_orderpriority, o_orderstatus, "
+        "grouping(o_orderpriority, o_orderstatus), count(*) from orders "
+        "group by grouping sets ((o_orderpriority), (o_orderstatus), ()) "
+        "order by 3, 1, 2",
+    )

@@ -412,6 +412,26 @@ class _TraceCtx:
         }
         return Batch(lanes, sel_sorted & boundary)
 
+    def _visit_groupid(self, node: P.GroupId) -> Batch:
+        """GROUPING SETS row expansion: tile every lane once per grouping
+        set and mask grouping keys absent from each set to NULL; a
+        replicated [0..G) group-id lane distinguishes the copies."""
+        b = self.visit(node.source)
+        G = len(node.sets)
+        n = b.sel.shape[0]
+        key_union = {s for st in node.sets for s in st}
+        lanes = {}
+        for sym, (v, ok) in b.lanes.items():
+            v2 = jnp.tile(v, G)
+            ok2 = jnp.tile(ok, G)
+            if sym in key_union and any(sym not in st for st in node.sets):
+                keep = np.array([sym in st for st in node.sets], dtype=bool)
+                ok2 = ok2 & jnp.repeat(jnp.asarray(keep), n)
+            lanes[sym] = (v2, ok2)
+        gid = jnp.repeat(jnp.arange(G, dtype=jnp.int64), n)
+        lanes[node.gid_symbol] = (gid, jnp.ones(G * n, dtype=bool))
+        return Batch(lanes, jnp.tile(b.sel, G), replicated=b.replicated)
+
     # -- aggregation -----------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate, b: Optional[Batch] = None) -> Batch:
         """Handles all three steps (AggregationNode.java:346): SINGLE and

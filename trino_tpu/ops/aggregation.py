@@ -162,7 +162,9 @@ def sort_group_ids(
     operands = [jnp.logical_not(sel)]
     for v, ok in key_lanes:
         operands.append(jnp.logical_not(ok))
-        operands.append(v)
+        # NULL keys form ONE group whatever the masked value holds
+        # (GROUPING SETS masks keys without zeroing the value lane)
+        operands.append(jnp.where(ok, v, jnp.zeros((), v.dtype)))
     operands.append(jnp.arange(n, dtype=jnp.int64))
     num_keys = len(operands) - 1
     sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)

@@ -204,6 +204,12 @@ class Fragmenter:
         rs = self._cut(partial, part, keys, HASH, syms)
         return P.Distinct(rs), HASH, syms
 
+    def _do_groupid(self, node: P.GroupId):
+        # row expansion is local to each task; gid joins the hash keys of
+        # the aggregation above, so partitioning is unchanged here
+        src, part, keys = self._rewrite(node.source)
+        return dataclasses.replace(node, source=src), part, keys
+
     # -- aggregation ------------------------------------------------------
     def _do_aggregate(self, node: P.Aggregate):
         src, part, keys = self._rewrite(node.source)

@@ -168,6 +168,32 @@ class AggInfo:
 
 
 @dataclasses.dataclass(frozen=True)
+class GroupId(PlanNode):
+    """GROUPING SETS expansion (GroupIdNode / GroupIdOperator analog):
+    replicates every input row once per grouping set, masking grouping-key
+    columns absent from that set to NULL, and emits a group-id column that
+    the Aggregate above includes in its keys.  The reference remaps symbols
+    per set; here validity masks do the same with static shapes (rows ×
+    sets)."""
+
+    source: PlanNode
+    sets: Tuple[Tuple[str, ...], ...]  # grouping-key symbols per set
+    gid_symbol: str
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return list(self.source.output_symbols()) + [self.gid_symbol]
+
+    def output_types(self):
+        out = dict(self.source.output_types())
+        out[self.gid_symbol] = T.BIGINT
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
 class Aggregate(PlanNode):
     """AggregationNode. step follows the reference's PARTIAL/FINAL/SINGLE
     (plan/AggregationNode.java:346); the planner emits SINGLE and the

@@ -528,16 +528,56 @@ class Analyzer:
     # ------------------------------------------------------------------
     def _plan_aggregation(self, rel, spec, items, ea: "ExprAnalyzer",
                           win_calls=()):
-        # group keys: ordinals or expressions
+        # group keys: ordinals or expressions, possibly inside grouping
+        # elements (ROLLUP/CUBE/GROUPING SETS -> cross-product of per-item
+        # sets, StatementAnalyzer.analyzeGroupBy semantics)
+        import itertools
+
         key_exprs: List[ir.Expr] = []
-        for g in spec.group_by:
+
+        def key_index(g: ast.Node) -> int:
             if isinstance(g, ast.Literal) and g.kind == "integer":
                 idx = int(g.value) - 1
                 if not (0 <= idx < len(items)):
-                    raise SemanticError(f"GROUP BY ordinal {g.value} out of range")
-                key_exprs.append(ea.analyze(items[idx].expr))
+                    raise SemanticError(
+                        f"GROUP BY ordinal {g.value} out of range"
+                    )
+                e = ea.analyze(items[idx].expr)
             else:
-                key_exprs.append(ea.analyze(g))
+                e = ea.analyze(g)
+            for i, k in enumerate(key_exprs):
+                if k == e:
+                    return i
+            key_exprs.append(e)
+            return len(key_exprs) - 1
+
+        set_lists: List[List[Tuple[int, ...]]] = []
+        for g in spec.group_by:
+            if isinstance(g, ast.Rollup):
+                idxs = [key_index(x) for x in g.items]
+                set_lists.append(
+                    [tuple(idxs[:k]) for k in range(len(idxs), -1, -1)]
+                )
+            elif isinstance(g, ast.Cube):
+                idxs = [key_index(x) for x in g.items]
+                subs: List[Tuple[int, ...]] = []
+                for r in range(len(idxs), -1, -1):
+                    subs.extend(itertools.combinations(idxs, r))
+                set_lists.append(subs)
+            elif isinstance(g, ast.GroupingSets):
+                set_lists.append(
+                    [tuple(key_index(x) for x in s) for s in g.sets]
+                )
+            else:
+                set_lists.append([(key_index(g),)])
+        sets_idx: List[Tuple[int, ...]] = []
+        for combo in itertools.product(*set_lists):
+            merged: List[int] = []
+            for part in combo:
+                for i in part:
+                    if i not in merged:
+                        merged.append(i)
+            sets_idx.append(tuple(merged))
         rel = ea.relation
 
         # pre-projection: pass-through + key symbols
@@ -557,7 +597,18 @@ class Analyzer:
                 key_syms.append(sym)
                 key_map.append((ke, ref))
 
-        agg_collector = AggCollector(self, rel, key_map, pre_assigns)
+        multi_sets = len(sets_idx) > 1
+        gid_sym = gid_ref = sets_syms = None
+        if multi_sets:
+            sets_syms = tuple(
+                tuple(key_syms[i] for i in st) for st in sets_idx
+            )
+            gid_sym = self.symbols.new("groupid")
+            gid_ref = ir.ColumnRef(T.BIGINT, gid_sym)
+        agg_collector = AggCollector(
+            self, rel, key_map, pre_assigns,
+            grouping_sets=sets_syms, gid_ref=gid_ref,
+        )
         # window args/partition/order are evaluated over the aggregation
         # output: extract their aggregates first (before the Aggregate node
         # is frozen) and register placeholder types for the item analysis
@@ -578,7 +629,12 @@ class Analyzer:
         rel = agg_collector.relation
 
         pre = P.Project(rel.root, tuple(agg_collector.pre_assigns))
-        agg_node = P.Aggregate(pre, tuple(key_syms), tuple(agg_collector.aggs))
+        agg_src: P.PlanNode = pre
+        agg_keys = tuple(key_syms)
+        if multi_sets:
+            agg_src = P.GroupId(pre, sets_syms, gid_sym)
+            agg_keys = agg_keys + (gid_sym,)
+        agg_node = P.Aggregate(agg_src, agg_keys, tuple(agg_collector.aggs))
         new_fields = [
             Field(None, s, s, t)
             for s, t in agg_node.output_types().items()
@@ -1325,7 +1381,8 @@ class AggCollector(ExprAnalyzer):
     AggInfo entries (pre-projected args) and rewrites group-key expressions
     to key symbols (AggregationAnalyzer + QueryPlanner combined)."""
 
-    def __init__(self, analyzer, relation, key_map, pre_assigns):
+    def __init__(self, analyzer, relation, key_map, pre_assigns,
+                 grouping_sets=None, gid_ref=None):
         super().__init__(analyzer, relation)
         self.key_map = key_map  # [(key ir expr, key symbol ref)]
         self.pre_relation = relation  # pre-aggregation scope for resolution
@@ -1335,6 +1392,12 @@ class AggCollector(ExprAnalyzer):
         # scalar subqueries in HAVING/post-agg expressions join ABOVE the
         # aggregation (the reference plans Apply above AggregationNode)
         self.pending_scalar: List[P.PlanNode] = []
+        # GROUPING SETS context: per-set key-symbol tuples + the group-id
+        # column, for grouping() rewriting (GroupingOperationRewriter analog)
+        self.grouping_sets = grouping_sets
+        self.gid_ref = gid_ref
+        if gid_ref is not None:
+            self.scalar_syms.add(gid_ref.name)
 
     def _scalar_subquery(self, q: ast.Query) -> ir.Expr:
         sub, _, corr = self.a._plan_subquery_correlated(q, self.relation.scope)
@@ -1362,6 +1425,12 @@ class AggCollector(ExprAnalyzer):
             and e.window is None
         ):
             return self._aggregate_call(e)
+        if (
+            isinstance(e, ast.FunctionCall)
+            and e.name == "grouping"
+            and e.window is None
+        ):
+            return self._grouping_call(e)
         # try: whole expression equals a group key
         try:
             full = self._an(e)
@@ -1412,6 +1481,43 @@ class AggCollector(ExprAnalyzer):
         if full is not None:
             return full
         return self._an(e)  # will raise a descriptive error
+
+    def _grouping_call(self, e: ast.FunctionCall) -> ir.Expr:
+        """grouping(a, b, ...) -> bitmask, bit i (MSB-first) set when the
+        i-th argument is absent from the row's grouping set.  Lowered to a
+        CASE over the group-id column, whose value is known per set at plan
+        time (sql/planner/GroupingOperationRewriter analog)."""
+        if e.is_star or not e.args:
+            raise SemanticError("grouping() requires arguments")
+        refs: List[ir.ColumnRef] = []
+        for a in e.args:
+            ae = self._an(a)
+            for ke, ref in self.key_map:
+                if ae == ke:
+                    refs.append(ref)
+                    break
+            else:
+                raise SemanticError(
+                    "grouping() arguments must appear in GROUP BY"
+                )
+        if self.gid_ref is None or self.grouping_sets is None:
+            return ir.Constant(T.BIGINT, 0)  # plain GROUP BY: all bits 0
+        nbits = len(refs)
+        masks = []
+        for st in self.grouping_sets:
+            m = 0
+            for j, ref in enumerate(refs):
+                if ref.name not in st:
+                    m |= 1 << (nbits - 1 - j)
+            masks.append(m)
+        whens = tuple(
+            ir.WhenClause(
+                ir.Comparison("=", self.gid_ref, ir.Constant(T.BIGINT, g)),
+                ir.Constant(T.BIGINT, m),
+            )
+            for g, m in enumerate(masks[:-1])
+        )
+        return ir.Case(T.BIGINT, whens, ir.Constant(T.BIGINT, masks[-1]))
 
     def _aggregate_call(self, e: ast.FunctionCall) -> ir.ColumnRef:
         kind = AGG_ALIASES.get(e.name, e.name)

@@ -226,6 +226,27 @@ class SortItem(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Rollup(Node):
+    """GROUP BY ROLLUP (a, b) — prefix grouping sets (SqlBase.g4 groupingElement)."""
+
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Cube(Node):
+    """GROUP BY CUBE (a, b) — all-subset grouping sets."""
+
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupingSets(Node):
+    """GROUP BY GROUPING SETS ((a, b), (a), ())."""
+
+    sets: Tuple[Tuple[Node, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class QuerySpec(Node):
     """SELECT ... FROM ... WHERE ... GROUP BY ... HAVING ..."""
 

@@ -287,14 +287,53 @@ class Parser:
             where = self.expr()
         if self.accept_kw("group"):
             self.expect_kw("by")
-            group.append(self.expr())
+            group.append(self.group_element())
             while self.accept_op(","):
-                group.append(self.expr())
+                group.append(self.group_element())
         if self.accept_kw("having"):
             having = self.expr()
         return ast.QuerySpec(
             tuple(items), relation, where, tuple(group), having, distinct
         )
+
+    def group_element(self) -> ast.Node:
+        """groupingElement (SqlBase.g4): ROLLUP (...) | CUBE (...) |
+        GROUPING SETS ((...), ...) | expression.  The construct words are
+        soft keywords — only recognized in this position."""
+        t = self.peek()
+        if (t.kind == "ident" and t.text.lower() in ("rollup", "cube")
+                and self.peek(1).kind == "op" and self.peek(1).text == "("):
+            word = self.next().text.lower()
+            self.expect_op("(")
+            items = [self.expr()]
+            while self.accept_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            node = ast.Rollup if word == "rollup" else ast.Cube
+            return node(tuple(items))
+        if (t.kind == "ident" and t.text.lower() == "grouping"
+                and self.peek(1).kind == "ident"
+                and self.peek(1).text.lower() == "sets"):
+            self.next()
+            self.next()
+            self.expect_op("(")
+            sets = [self._grouping_set()]
+            while self.accept_op(","):
+                sets.append(self._grouping_set())
+            self.expect_op(")")
+            return ast.GroupingSets(tuple(sets))
+        return self.expr()
+
+    def _grouping_set(self) -> tuple:
+        if self.accept_op("("):
+            if self.accept_op(")"):
+                return ()  # the grand-total set
+            items = [self.expr()]
+            while self.accept_op(","):
+                items.append(self.expr())
+            self.expect_op(")")
+            return tuple(items)
+        return (self.expr(),)
 
     def select_item(self) -> ast.Node:
         if self.accept_op("*"):
