@@ -56,5 +56,19 @@ class Metadata:
             raise KeyError(f"table not found: {catalog}.{parts[-1]}")
         return catalog, md.get_table_schema(parts[-1])
 
+    def resolve_new_table(
+        self, parts, default_catalog: Optional[str]
+    ) -> "tuple[str, str]":
+        """(catalog, table) for a table that need not exist (DDL targets)."""
+        if len(parts) == 3:
+            catalog, _schema, table = parts
+        elif len(parts) == 2:
+            catalog, table = default_catalog, parts[1]
+        else:
+            catalog, table = default_catalog, parts[0]
+        if catalog is None:
+            raise ValueError(f"no catalog specified for table {'.'.join(parts)}")
+        return catalog, table
+
     def table_statistics(self, catalog: str, table: str) -> TableStatistics:
         return self.catalogs.get(catalog).metadata().get_table_statistics(table)

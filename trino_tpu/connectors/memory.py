@@ -3,8 +3,8 @@
 Reference parity: plugin/trino-memory (MemoryConnector, MemoryMetadata,
 MemoryPagesStore) — tables held as host numpy columns, used by engine
 tests as a scriptable data source (the MockConnector/memory role).
-Rows are inserted through the python API (create_table) since the engine's
-DML surface is read-oriented for now.
+Writable: CREATE TABLE [AS] / INSERT / DELETE flow through the PageSink
+SPI; rows can also be loaded via the python API (create_table).
 """
 from __future__ import annotations
 
@@ -20,6 +20,8 @@ from ..spi import (
     Connector,
     ConnectorFactory,
     ConnectorMetadata,
+    PageSink,
+    PageSinkProvider,
     PageSource,
     PageSourceProvider,
     Split,
@@ -48,6 +50,22 @@ class MemoryMetadata(ConnectorMetadata):
     def get_table_statistics(self, table: str) -> TableStatistics:
         page = self.store.tables[table]
         return TableStatistics(float(page.count), {})
+
+    # -- writes (MemoryMetadata.beginCreateTable/beginInsert analog) ----
+    def create_table(self, schema: TableSchema) -> None:
+        if schema.name in self.store.tables:
+            raise ValueError(f"table {schema.name} already exists")
+        cols = [column_from_pylist(c.type, []) for c in schema.columns]
+        self.store.tables[schema.name] = Page(
+            cols, 0, [c.name for c in schema.columns]
+        )
+        self.store.schemas[schema.name] = schema
+
+    def drop_table(self, table: str) -> None:
+        if table not in self.store.tables:
+            raise KeyError(f"table {table} does not exist")
+        del self.store.tables[table]
+        del self.store.schemas[table]
 
 
 class MemorySplitManager(SplitManager):
@@ -87,6 +105,60 @@ class MemoryPageSourceProvider(PageSourceProvider):
         return MemoryPageSource(self.store, split, columns)
 
 
+class MemoryPageSink(PageSink):
+    """MemoryPagesStore.add analog.  Buffers appended pages as python
+    values and rebuilds the stored columns at finish() — re-encoding
+    unifies per-page varchar dictionaries (correctness over speed: this
+    is the test connector, like the reference's trino-memory)."""
+
+    def __init__(self, store: _Store, table: str, columns, overwrite: bool):
+        self.store = store
+        self.table = table
+        self.columns = list(columns)
+        self.overwrite = overwrite
+        self.buffered: List[list] = [[] for _ in self.columns]
+        self.rows = 0
+
+    def append(self, page: Page) -> None:
+        for i, name in enumerate(self.columns):
+            self.buffered[i].extend(page.by_name(name).to_python(page.count))
+        self.rows += page.count
+
+    def finish(self) -> int:
+        schema = self.store.schemas[self.table]
+        old = self.store.tables[self.table]
+        data: Dict[str, list] = {}
+        for c in schema.columns:
+            prior = (
+                [] if self.overwrite
+                else old.by_name(c.name).to_python(old.count)
+            )
+            try:
+                idx = self.columns.index(c.name)
+                incoming = self.buffered[idx]
+            except ValueError:
+                incoming = [None] * self.rows  # unmentioned column -> NULL
+            data[c.name] = prior + incoming
+        cols = [
+            column_from_pylist(c.type, data[c.name]) for c in schema.columns
+        ]
+        self.store.tables[self.table] = Page(
+            cols, len(data[schema.columns[0].name]),
+            [c.name for c in schema.columns],
+        )
+        return self.rows
+
+
+class MemoryPageSinkProvider(PageSinkProvider):
+    def __init__(self, store: _Store):
+        self.store = store
+
+    def create_sink(self, table: str, columns, overwrite: bool = False):
+        if table not in self.store.tables:
+            raise KeyError(f"table {table} does not exist")
+        return MemoryPageSink(self.store, table, columns, overwrite)
+
+
 class MemoryConnector(Connector):
     def __init__(self, name: str):
         self.name = name
@@ -110,6 +182,9 @@ class MemoryConnector(Connector):
 
     def page_source_provider(self):
         return MemoryPageSourceProvider(self.store)
+
+    def page_sink_provider(self):
+        return MemoryPageSinkProvider(self.store)
 
 
 class MemoryConnectorFactory(ConnectorFactory):

@@ -157,6 +157,8 @@ class LocalExecutor:
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
         assert isinstance(plan, P.Output)
+        if isinstance(plan.source, P.TableWriter):
+            return self._execute_write(plan.source)
         # out-of-core path: when the estimated scan working set exceeds the
         # memory limit and the plan allows it, aggregate in split batches
         # (MemoryRevokingScheduler -> spill, host RAM as the spill tier)
@@ -205,6 +207,45 @@ class LocalExecutor:
         finally:
             if pool is not None:
                 pool.free(self.query_id, self.scan_bytes)
+
+    # ------------------------------------------------------------------
+    def _execute_write(self, w: P.TableWriter) -> Page:
+        """INSERT/CTAS/DELETE execution (TableWriterOperator +
+        TableFinishOperator collapsed: run the source query, stream the
+        result into the connector PageSink, commit at finish())."""
+        conn = self.catalogs.get(w.catalog)
+        md = conn.metadata()
+        if w.create_schema is not None:
+            from ..spi import ColumnSchema, TableSchema
+
+            if w.if_not_exists and w.table in md.list_tables():
+                return Page(
+                    [Column(T.BIGINT, np.zeros(1, dtype=np.int64))], 1,
+                    ["rows"],
+                )
+            md.create_table(
+                TableSchema(
+                    w.table,
+                    tuple(ColumnSchema(c, t) for c, t in w.create_schema),
+                )
+            )
+        before = 0
+        if w.report_deleted:
+            before = int(md.get_table_statistics(w.table).row_count)
+        inner = P.Output(
+            w.source, tuple(w.columns), tuple(w.source.output_symbols())
+        )
+        page = self.execute(inner)
+        sink = conn.page_sink_provider().create_sink(
+            w.table, list(w.columns), overwrite=w.overwrite
+        )
+        sink.append(page)
+        written = sink.finish()
+        result = before - written if w.report_deleted else written
+        return Page(
+            [Column(T.BIGINT, np.array([result], dtype=np.int64))], 1,
+            ["rows"],
+        )
 
     # ------------------------------------------------------------------
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
@@ -359,6 +400,8 @@ class _TraceCtx:
         cap = _pad_capacity(max(n, 1))
         lanes = {}
         tmap = dict(node.types_)
+        for sym, d in getattr(node, "dicts", ()):
+            self.ex.dicts[sym] = np.array(list(d), dtype=object)
         for i, sym in enumerate(node.symbols):
             colvals = [r[i] for r in node.rows]
             arr = np.zeros(cap, dtype=tmap[sym].np_dtype)

@@ -53,17 +53,50 @@ class TableScan(PlanNode):
 
 @dataclasses.dataclass(frozen=True)
 class Values(PlanNode):
-    """Literal rows (ValuesNode): symbols + per-row constant tuples."""
+    """Literal rows (ValuesNode): symbols + per-row constant tuples.
+    Varchar values are stored as dictionary codes with the dictionary in
+    `dicts` (symbol -> tuple of strings)."""
 
     symbols: Tuple[str, ...]
     types_: Tuple[Tuple[str, T.Type], ...]
     rows: Tuple[Tuple[object, ...], ...]
+    dicts: Tuple[Tuple[str, Tuple[str, ...]], ...] = ()
 
     def output_symbols(self):
         return list(self.symbols)
 
     def output_types(self):
         return dict(self.types_)
+
+
+@dataclasses.dataclass(frozen=True)
+class TableWriter(PlanNode):
+    """INSERT/CTAS/DELETE write sink (TableWriterNode + TableFinishNode
+    combined: the reference splits writing and commit/stats collection into
+    two operators; this engine's sinks commit in finish() so one node
+    reports the row count).  `overwrite` rewrites the table with the source
+    rows (the DELETE-as-rewrite path); `report_deleted` makes the output row
+    count = previous_count - written (DELETE's deleted-rows result)."""
+
+    source: PlanNode
+    catalog: str
+    table: str
+    columns: Tuple[str, ...]  # connector column name per source symbol
+    overwrite: bool = False
+    report_deleted: bool = False
+    # CTAS: (column, Type) schema to create before writing
+    create_schema: Optional[Tuple[Tuple[str, T.Type], ...]] = None
+    if_not_exists: bool = False
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return ["rows"]
+
+    def output_types(self):
+        return {"rows": T.BIGINT}
 
 
 @dataclasses.dataclass(frozen=True)

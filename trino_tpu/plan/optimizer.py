@@ -163,7 +163,7 @@ def _rewrite_sources(node: P.PlanNode, new_sources: Tuple[P.PlanNode, ...]):
 
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
                          P.Limit, P.Distinct, P.Output, P.Exchange,
-                         P.Window, P.GroupId)):
+                         P.Window, P.GroupId, P.TableWriter)):
         return dataclasses.replace(node, source=new_sources[0])
     if isinstance(node, P.Join):
         return dataclasses.replace(node, left=new_sources[0], right=new_sources[1])
@@ -450,6 +450,12 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
         if isinstance(node, P.Output):
             return dataclasses.replace(
                 node, source=prune(node.source, set(node.symbols))
+            )
+        if isinstance(node, P.TableWriter):
+            # every source column is written — nothing above can prune them
+            return dataclasses.replace(
+                node,
+                source=prune(node.source, set(node.source.output_symbols())),
             )
         if isinstance(node, P.TableScan):
             kept = tuple(

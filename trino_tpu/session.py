@@ -158,6 +158,34 @@ class Session:
             text = P.plan_to_string(self._plan_stmt(stmt.query))
             col = column_from_pylist(T.VARCHAR, text.split("\n"))
             return Page([col], len(text.split("\n")), ["Query Plan"])
+        if isinstance(stmt, ast.CreateTable):
+            from .spi import ColumnSchema, TableSchema
+
+            catalog, table = self.metadata.resolve_new_table(
+                stmt.table, self.default_catalog
+            )
+            md = self.catalogs.get(catalog).metadata()
+            if stmt.if_not_exists and table in md.list_tables():
+                return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
+            md.create_table(
+                TableSchema(
+                    table,
+                    tuple(
+                        ColumnSchema(c.lower(), T.parse_type(t))
+                        for c, t in stmt.columns
+                    ),
+                )
+            )
+            return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
+        if isinstance(stmt, ast.DropTable):
+            catalog, table = self.metadata.resolve_new_table(
+                stmt.table, self.default_catalog
+            )
+            md = self.catalogs.get(catalog).metadata()
+            if stmt.if_exists and table not in md.list_tables():
+                return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
+            md.drop_table(table)
+            return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
 
         plan = self._plan_stmt(stmt)
         executor = self._executor()

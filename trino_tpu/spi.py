@@ -81,6 +81,14 @@ class ConnectorMetadata:
     def get_table_statistics(self, table: str) -> TableStatistics:
         raise NotImplementedError
 
+    # -- writes (ConnectorMetadata.beginCreateTable/beginInsert/...; a
+    # connector that leaves these unimplemented is read-only) ----------
+    def create_table(self, schema: TableSchema) -> None:
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
+    def drop_table(self, table: str) -> None:
+        raise NotImplementedError(f"{type(self).__name__} is read-only")
+
 
 class SplitManager:
     def get_splits(
@@ -109,6 +117,30 @@ class PageSourceProvider:
         raise NotImplementedError
 
 
+class PageSink:
+    """Write-side mirror of PageSource (spi/connector/ConnectorPageSink:
+    appendPage/finish).  One sink per write operation; finish() commits
+    and returns the row count."""
+
+    def append(self, page: Page) -> None:
+        raise NotImplementedError
+
+    def finish(self) -> int:
+        raise NotImplementedError
+
+
+class PageSinkProvider:
+    """spi/connector/ConnectorPageSinkProvider."""
+
+    def create_sink(self, table: str, columns: Sequence[str],
+                    overwrite: bool = False) -> PageSink:
+        """overwrite=True replaces the table contents atomically at
+        finish() — the rewrite slot used by DELETE (the reference routes
+        row-level deletes through MergeWriterNode; here the engine computes
+        the kept rows and rewrites)."""
+        raise NotImplementedError
+
+
 class Connector:
     """One mounted catalog (spi/connector/Connector)."""
 
@@ -122,6 +154,9 @@ class Connector:
 
     def page_source_provider(self) -> PageSourceProvider:
         raise NotImplementedError
+
+    def page_sink_provider(self) -> PageSinkProvider:
+        raise NotImplementedError(f"connector {self.name} is read-only")
 
 
 class Plugin:

@@ -162,7 +162,113 @@ class Analyzer:
             return P.Output(
                 rp.root, tuple(names), tuple(f.symbol for f in rp.scope.fields)
             )
+        if isinstance(stmt, ast.Insert):
+            return self._plan_insert(stmt)
+        if isinstance(stmt, ast.CreateTableAs):
+            return self._plan_ctas(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._plan_delete(stmt)
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
+
+    # -- DML planning (QueryPlanner.planInsert / planDelete analogs) -----
+    def _coerced_source(self, rp: RelationPlan, target_types) -> P.PlanNode:
+        """Project the query output onto the target column types, inserting
+        casts where the analyzer's types differ (implicit INSERT coercion)."""
+        assigns = []
+        changed = False
+        for f, tt in zip(rp.scope.fields, target_types):
+            ref: ir.Expr = ir.ColumnRef(f.type, f.symbol)
+            if f.type != tt:
+                try:
+                    ok = f.type.name == "unknown" or T.common_super_type(
+                        f.type, tt
+                    ) is not None
+                except TypeError:
+                    ok = False
+                if not ok:
+                    raise SemanticError(
+                        f"cannot insert {f.type} into column of type {tt}"
+                    )
+                ref = _fold(ir.Cast(tt, ref))
+                changed = True
+            assigns.append((self.symbols.new("ins"), ref))
+        if not changed:
+            return rp.root
+        return P.Project(rp.root, tuple(assigns))
+
+    def _plan_insert(self, stmt: ast.Insert) -> P.PlanNode:
+        catalog, schema = self.metadata.resolve_table(
+            stmt.table, self.default_catalog
+        )
+        if stmt.columns:
+            known = {c.name for c in schema.columns}
+            for c in stmt.columns:
+                if c.lower() not in known:
+                    raise SemanticError(
+                        f"column {c} not in table {schema.name}"
+                    )
+            targets = [c.lower() for c in stmt.columns]
+        else:
+            targets = [c.name for c in schema.columns]
+        rp, _ = self.plan_query(stmt.query)
+        if len(rp.scope.fields) != len(targets):
+            raise SemanticError(
+                f"INSERT has {len(rp.scope.fields)} expressions but "
+                f"{len(targets)} target columns"
+            )
+        ttypes = [schema.column_type(c) for c in targets]
+        src = self._coerced_source(rp, ttypes)
+        writer = P.TableWriter(src, catalog, schema.name, tuple(targets))
+        return P.Output(writer, ("rows",), ("rows",))
+
+    def _plan_ctas(self, stmt: ast.CreateTableAs) -> P.PlanNode:
+        catalog, table = self.metadata.resolve_new_table(
+            stmt.table, self.default_catalog
+        )
+        rp, names = self.plan_query(stmt.query)
+        seen = set()
+        for n in names:
+            if n.lower() in seen:
+                raise SemanticError(f"duplicate output column name {n}")
+            seen.add(n.lower())
+        create_schema = tuple(
+            (n.lower(), f.type if f.type.name != "unknown" else T.BIGINT)
+            for n, f in zip(names, rp.scope.fields)
+        )
+        writer = P.TableWriter(
+            rp.root, catalog, table, tuple(n for n, _ in create_schema),
+            create_schema=create_schema,
+            if_not_exists=stmt.if_not_exists,
+        )
+        return P.Output(writer, ("rows",), ("rows",))
+
+    def _plan_delete(self, stmt: ast.Delete) -> P.PlanNode:
+        catalog, schema = self.metadata.resolve_table(
+            stmt.table, self.default_catalog
+        )
+        # DELETE rows WHERE pred == rewrite with rows where pred IS NOT TRUE
+        # (the reference routes row-level deletes through MergeWriterNode;
+        # the memory-style connectors here rewrite the table)
+        if stmt.where is None:
+            keep: Optional[ast.Node] = ast.Literal("boolean", False)
+        else:
+            keep = ast.LogicalOp(
+                "or", (ast.NotOp(stmt.where), ast.IsNullOp(stmt.where, False))
+            )
+        spec = ast.QuerySpec(
+            items=(ast.Star(),),
+            relation=ast.Table(stmt.table),
+            where=keep,
+            group_by=(),
+            having=None,
+        )
+        rp, _ = self.plan_query(ast.Query(spec))
+        writer = P.TableWriter(
+            rp.root, catalog, schema.name,
+            tuple(c.name for c in schema.columns),
+            overwrite=True, report_deleted=True,
+        )
+        return P.Output(writer, ("rows",), ("rows",))
 
     def plan_root_query(self, q: ast.Query) -> Tuple[RelationPlan, List[str]]:
         rp, names = self.plan_query(q)
@@ -187,9 +293,78 @@ class Analyzer:
         finally:
             self.ctes = saved
 
+    def plan_values_relation(
+        self, v: ast.ValuesRelation
+    ) -> Tuple[RelationPlan, List[str]]:
+        """VALUES rows -> P.Values (constant folding required; the reference
+        additionally allows non-constant rows, out of scope here)."""
+        arity = len(v.rows[0])
+        for r in v.rows:
+            if len(r) != arity:
+                raise SemanticError("VALUES rows must all have the same arity")
+        dummy = RelationPlan(P.Values((), (), ()), Scope([]))
+        ea = ExprAnalyzer(self, dummy)
+        cells: List[List[ir.Constant]] = []
+        for r in v.rows:
+            row = []
+            for x in r:
+                e = _fold(ea.analyze(x))
+                if not isinstance(e, ir.Constant):
+                    raise SemanticError("VALUES rows must be constant")
+                row.append(e)
+            cells.append(row)
+        col_types: List[T.Type] = []
+        for i in range(arity):
+            t = cells[0][i].type
+            for row in cells[1:]:
+                t = T.common_super_type(t, row[i].type)
+            col_types.append(t)
+        symbols = tuple(self.symbols.new(f"_col{i}") for i in range(arity))
+        dicts: List[Tuple[str, Tuple[str, ...]]] = []
+        codes: List[Dict[str, int]] = [dict() for _ in range(arity)]
+        out_rows = []
+        for row in cells:
+            vals = []
+            for i, (c, t) in enumerate(zip(row, col_types)):
+                if c.value is None:
+                    vals.append(None)
+                elif t.is_dictionary:
+                    code = codes[i].setdefault(str(c.value), len(codes[i]))
+                    vals.append(code)
+                elif t.is_decimal:
+                    cs = c.type.scale if c.type.is_decimal else 0
+                    vals.append(int(c.value) * 10 ** (t.scale - cs)
+                                if t.scale >= cs
+                                else int(c.value) // 10 ** (cs - t.scale))
+                elif t.name in ("double", "real"):
+                    cv = c.value
+                    if c.type.is_decimal:
+                        cv = cv / 10 ** c.type.scale
+                    vals.append(float(cv))
+                else:
+                    vals.append(c.value)
+            out_rows.append(tuple(vals))
+        for i, t in enumerate(col_types):
+            if t.is_dictionary:
+                dicts.append((symbols[i], tuple(codes[i])))
+        node = P.Values(
+            symbols,
+            tuple(zip(symbols, col_types)),
+            tuple(out_rows),
+            tuple(dicts),
+        )
+        names = [f"_col{i}" for i in range(arity)]
+        fields = [
+            Field(None, n, s, t)
+            for n, s, t in zip(names, symbols, col_types)
+        ]
+        return RelationPlan(node, Scope(fields)), names
+
     def plan_set_op(self, s: ast.Node) -> Tuple[RelationPlan, List[str]]:
         if isinstance(s, ast.QuerySpec):
             return self.plan_query_spec(s, (), None)
+        if isinstance(s, ast.ValuesRelation):
+            return self.plan_values_relation(s)
         if isinstance(s, ast.Query):
             # parenthesized branch with its own ORDER BY / LIMIT
             return self.plan_query(s)

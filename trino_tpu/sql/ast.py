@@ -284,6 +284,54 @@ class Query(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ValuesRelation(Node):
+    """VALUES (a, b), (c, d) as a query body / inline relation."""
+
+    rows: Tuple[Tuple[Node, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Node):
+    """CREATE TABLE [IF NOT EXISTS] t (col type, ...)"""
+
+    table: Tuple[str, ...]
+    columns: Tuple[Tuple[str, str], ...]  # (name, type text)
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTableAs(Node):
+    """CREATE TABLE [IF NOT EXISTS] t AS query"""
+
+    table: Tuple[str, ...]
+    query: Node
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Insert(Node):
+    """INSERT INTO t [(cols)] query"""
+
+    table: Tuple[str, ...]
+    columns: Tuple[str, ...]  # () = positional, all table columns
+    query: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class Delete(Node):
+    """DELETE FROM t [WHERE pred]"""
+
+    table: Tuple[str, ...]
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Node):
+    table: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Explain(Node):
     query: Query
     analyze: bool = False

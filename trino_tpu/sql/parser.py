@@ -30,6 +30,7 @@ _TOKEN_RE = re.compile(
 
 KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
+    "create", "table", "insert", "into", "delete", "drop",
     "as", "and", "or", "not", "in", "exists", "between", "like", "escape",
     "is", "null", "true", "false", "case", "when", "then", "else", "end",
     "cast", "try_cast", "extract", "join", "inner", "left", "right", "full",
@@ -110,6 +111,14 @@ class Parser:
         if not self.accept_kw(kw):
             raise ParseError(f"expected {kw.upper()} at {self.peek()!r}")
 
+    def accept_soft(self, word: str) -> bool:
+        """Accept a soft keyword (lexes as ident; e.g. IF in DDL)."""
+        t = self.peek()
+        if t.kind == "ident" and t.text.lower() == word:
+            self.next()
+            return True
+        return False
+
     def accept_op(self, op: str) -> bool:
         t = self.peek()
         if t.kind == "op" and t.text == op:
@@ -166,9 +175,73 @@ class Parser:
                 raise ParseError(f"bad SET SESSION value {t!r}")
             self._finish()
             return ast.SetSession(name, value)
+        if self.accept_kw("create"):
+            self.expect_kw("table")
+            ine = False
+            if self.accept_soft("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                ine = True
+            name = self.qualified_name()
+            if self.accept_kw("as"):
+                q = self.parse_query()
+                self._finish()
+                return ast.CreateTableAs(name, q, ine)
+            self.expect_op("(")
+            cols = [self.column_def()]
+            while self.accept_op(","):
+                cols.append(self.column_def())
+            self.expect_op(")")
+            self._finish()
+            return ast.CreateTable(name, tuple(cols), ine)
+        if self.accept_kw("insert"):
+            self.expect_kw("into")
+            name = self.qualified_name()
+            cols: List[str] = []
+            # '(' starts either a column list or a parenthesized query
+            if (self.peek().kind == "op" and self.peek().text == "("
+                    and self.peek(1).kind == "ident"):
+                self.expect_op("(")
+                cols.append(self.ident())
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            q = self.parse_query()
+            self._finish()
+            return ast.Insert(name, tuple(cols), q)
+        if self.accept_kw("delete"):
+            self.expect_kw("from")
+            name = self.qualified_name()
+            where = self.expr() if self.accept_kw("where") else None
+            self._finish()
+            return ast.Delete(name, where)
+        if self.accept_kw("drop"):
+            self.expect_kw("table")
+            ie = False
+            if self.accept_soft("if"):
+                self.expect_kw("exists")
+                ie = True
+            name = self.qualified_name()
+            self._finish()
+            return ast.DropTable(name, ie)
         q = self.parse_query()
         self._finish()
         return q
+
+    def column_def(self) -> Tuple[str, str]:
+        """column definition: name + SQL type text (types.parse_type forms)."""
+        name = self.ident()
+        t = self.next()
+        if t.kind not in ("ident", "kw"):
+            raise ParseError(f"expected a type name at {t!r}")
+        type_text = t.text
+        if self.accept_op("("):
+            args = [self.next().text]
+            while self.accept_op(","):
+                args.append(self.next().text)
+            self.expect_op(")")
+            type_text += "(" + ",".join(args) + ")"
+        return name, type_text
 
     def _finish(self):
         self.accept_op(";")
@@ -260,7 +333,21 @@ class Parser:
             if not q.withs and not q.order_by and q.limit is None:
                 return q.body
             return q
+        if self.at_kw("values"):
+            self.next()
+            rows = [self._values_row()]
+            while self.accept_op(","):
+                rows.append(self._values_row())
+            return ast.ValuesRelation(tuple(rows))
         return self.parse_query_spec()
+
+    def _values_row(self) -> tuple:
+        self.expect_op("(")
+        row = [self.expr()]
+        while self.accept_op(","):
+            row.append(self.expr())
+        self.expect_op(")")
+        return tuple(row)
 
     def _int_token(self, t: Token, clause: str) -> int:
         if t.kind != "number" or not t.text.isdigit():
@@ -406,7 +493,7 @@ class Parser:
     def relation_primary(self) -> ast.Node:
         if self.accept_op("("):
             # subquery or parenthesized join
-            if self.at_kw("select", "with"):
+            if self.at_kw("select", "with", "values"):
                 q = self.parse_query()
                 self.expect_op(")")
                 alias = None
