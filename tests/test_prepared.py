@@ -1,0 +1,103 @@
+"""PREPARE / EXECUTE / DEALLOCATE / DESCRIBE + EXPLAIN ANALYZE tests.
+
+Reference parity: QueryPreparer + prepared-statement protocol
+(EXECUTE ... USING parameter binding) and ExplainAnalyzeOperator output.
+"""
+import pytest
+
+from trino_tpu.session import Session, tpch_session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(0.001)
+
+
+def rows(s, sql):
+    return s.execute(sql).to_pylist()
+
+
+def test_prepare_execute_using(session):
+    rows(session, "prepare pq from select count(*) from orders where o_totalprice > ?")
+    full = rows(session, "select count(*) from orders where o_totalprice > 100000")
+    assert rows(session, "execute pq using 100000") == full
+    assert rows(session, "execute pq using 1000000000") == [(0,)]
+
+
+def test_prepare_no_params(session):
+    rows(session, "prepare pq2 from select 41 + 1")
+    assert rows(session, "execute pq2") == [(42,)]
+
+
+def test_execute_missing_binding_rejected(session):
+    rows(session, "prepare pq3 from select count(*) from orders where o_custkey = ?")
+    with pytest.raises(ValueError):
+        session.execute("execute pq3")
+
+
+def test_multiple_params_ordered(session):
+    rows(
+        session,
+        "prepare pq4 from select count(*) from orders "
+        "where o_totalprice between ? and ?",
+    )
+    expect = rows(
+        session,
+        "select count(*) from orders where o_totalprice between 50000 and 150000",
+    )
+    assert rows(session, "execute pq4 using 50000, 150000") == expect
+
+
+def test_describe_input_output(session):
+    rows(
+        session,
+        "prepare pq5 from select o_orderkey, o_orderpriority from orders "
+        "where o_custkey = ?",
+    )
+    assert rows(session, "describe input pq5") == [(1, "unknown")]
+    assert rows(session, "describe output pq5") == [
+        ("o_orderkey", "bigint"), ("o_orderpriority", "varchar"),
+    ]
+
+
+def test_deallocate(session):
+    rows(session, "prepare pq6 from select 1")
+    rows(session, "deallocate prepare pq6")
+    with pytest.raises(KeyError):
+        session.execute("execute pq6")
+
+
+def test_describe_table_is_show_columns(session):
+    out = rows(session, "describe nation")
+    assert ("n_name", "varchar(25)") in out or ("n_name", "varchar") in out
+
+
+def test_prepared_dml():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (a bigint)")
+    s.execute("prepare ins from insert into t values (?)")
+    assert s.execute("execute ins using 7").to_pylist() == [(1,)]
+    assert s.execute("execute ins using 8").to_pylist() == [(1,)]
+    assert s.execute("select * from t order by a").to_pylist() == [(7,), (8,)]
+
+
+def test_explain_analyze_annotates(session):
+    lines = [
+        r[0]
+        for r in rows(
+            session,
+            "explain analyze select count(*) from orders where o_custkey > 10",
+        )
+    ]
+    text = "\n".join(lines)
+    assert "TableScan" in text and "rows=" in text and "wall=" in text
+    assert "output rows" in text
+
+
+def test_explain_analyze_matches_plain_execution(session):
+    # running under instrumentation must not change results
+    plain = rows(session, "select count(*) from lineitem")
+    lines = rows(session, "explain analyze select count(*) from lineitem")
+    assert any("Aggregate" in r[0] for r in lines)
+    assert plain == rows(session, "select count(*) from lineitem")

@@ -153,6 +153,9 @@ class LocalExecutor:
         self.config = config or {}
         self.query_id = str(self.config.get("query_id", "query"))
         self.scan_bytes = 0
+        # EXPLAIN ANALYZE: id(plan node) -> {rows, wall_s, calls}
+        # (OperatorStats analog, filled when collect_node_stats is set)
+        self.node_stats: Dict[int, dict] = {}
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
@@ -372,7 +375,23 @@ class _TraceCtx:
         m = getattr(self, f"_visit_{type(node).__name__.lower()}", None)
         if m is None:
             raise ExecutionError(f"no executor for {type(node).__name__}")
-        return m(node)
+        if not self.ex.config.get("collect_node_stats"):
+            return m(node)
+        # EXPLAIN ANALYZE instrumentation (OperatorContext timing analog);
+        # wall time is inclusive of children — the printer subtracts
+        import time as _time
+
+        t0 = _time.perf_counter()
+        b = m(node)
+        jax.block_until_ready((b.sel,))
+        wall = _time.perf_counter() - t0
+        st = self.ex.node_stats.setdefault(
+            id(node), {"rows": 0, "wall_s": 0.0, "calls": 0}
+        )
+        st["rows"] = int(jnp.sum(b.sel))
+        st["wall_s"] += wall
+        st["calls"] += 1
+        return b
 
     # -- leaves ---------------------------------------------------------
     def _visit_tablescan(self, node: P.TableScan) -> Batch:

@@ -544,8 +544,10 @@ def visit_plan(node: PlanNode, fn, depth=0):
         visit_plan(s, fn, depth + 1)
 
 
-def plan_to_string(node: PlanNode) -> str:
-    """EXPLAIN-style textual plan (PlanPrinter analog)."""
+def plan_to_string(node: PlanNode, stats: Optional[dict] = None) -> str:
+    """EXPLAIN-style textual plan (PlanPrinter analog).  With `stats`
+    (id(node) -> {rows, wall_s} from EXPLAIN ANALYZE instrumentation) each
+    line is annotated with output rows and exclusive wall time."""
     lines: List[str] = []
 
     def fmt(n: PlanNode, d: int):
@@ -578,6 +580,13 @@ def plan_to_string(node: PlanNode) -> str:
             extra = f" fragment={n.fragment_id}"
         elif isinstance(n, Output):
             extra = f" {list(n.names)}"
+        if stats is not None and id(n) in stats:
+            st = stats[id(n)]
+            child_wall = sum(
+                stats[id(s)]["wall_s"] for s in n.sources if id(s) in stats
+            )
+            own = max(st["wall_s"] - child_wall, 0.0)
+            extra += f"  [rows={st['rows']}, wall={own * 1000:.2f}ms]"
         lines.append(f"{pad}{name}{extra}")
 
     visit_plan(node, fmt)

@@ -64,6 +64,9 @@ class Session:
             self.properties.get("query_max_memory_bytes")
         )
         self.tracer = TRACER
+        # PREPARE name FROM ... statements (QueryPreparer / prepared
+        # statement store; the reference keeps these per client session)
+        self.prepared: dict = {}
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
@@ -154,7 +157,55 @@ class Session:
                     "type": [str(c.type) for c in schema.columns],
                 },
             )
+        if isinstance(stmt, ast.Prepare):
+            self.prepared[stmt.name.lower()] = stmt.statement
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.Deallocate):
+            if stmt.name.lower() not in self.prepared:
+                raise KeyError(f"prepared statement not found: {stmt.name}")
+            del self.prepared[stmt.name.lower()]
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.ExecutePrepared):
+            if stmt.name.lower() not in self.prepared:
+                raise KeyError(f"prepared statement not found: {stmt.name}")
+            bound = ast.substitute_parameters(
+                self.prepared[stmt.name.lower()], stmt.args
+            )
+            nparams = ast.count_parameters(bound)
+            if nparams:
+                raise ValueError(
+                    f"{nparams} parameter(s) left unbound; "
+                    f"EXECUTE ... USING must supply all values"
+                )
+            return self._execute_statement(bound, sql, query_id)
+        if isinstance(stmt, ast.Describe):
+            if stmt.name.lower() not in self.prepared:
+                raise KeyError(f"prepared statement not found: {stmt.name}")
+            target = self.prepared[stmt.name.lower()]
+            if stmt.kind == "input":
+                n = ast.count_parameters(target)
+                return page_from_pydict(
+                    [("position", T.BIGINT), ("type", T.VARCHAR)],
+                    {"position": list(range(1, n + 1)),
+                     "type": ["unknown"] * n},
+                )
+            # DESCRIBE OUTPUT: plan with NULL-bound parameters for typing
+            n = ast.count_parameters(target)
+            bound = ast.substitute_parameters(
+                target, tuple(ast.Literal("null", None) for _ in range(n))
+            )
+            plan = self._plan_stmt(bound)
+            types = plan.source.output_types()
+            return page_from_pydict(
+                [("column", T.VARCHAR), ("type", T.VARCHAR)],
+                {
+                    "column": list(plan.names),
+                    "type": [str(types[s]) for s in plan.symbols],
+                },
+            )
         if isinstance(stmt, ast.Explain):
+            if stmt.analyze:
+                return self._explain_analyze(stmt.query, query_id)
             text = P.plan_to_string(self._plan_stmt(stmt.query))
             col = column_from_pylist(T.VARCHAR, text.split("\n"))
             return Page([col], len(text.split("\n")), ["Query Plan"])
@@ -192,6 +243,33 @@ class Session:
         with self.tracer.span("execute", query_id=query_id):
             page = executor.execute(plan)
         return page
+
+    def _explain_analyze(self, query, query_id: str) -> Page:
+        """EXPLAIN ANALYZE: execute with per-node instrumentation and print
+        the plan annotated with rows + wall time (ExplainAnalyzeOperator +
+        PlanPrinter.textDistributedPlan analog; single-node executor)."""
+        import time
+
+        plan = self._plan_stmt(query)
+        executor = LocalExecutor(
+            self.catalogs,
+            {
+                "group_capacity": self.properties.get("group_capacity"),
+                "collect_node_stats": True,
+                "spill_enabled": False,
+                "query_id": query_id,
+            },
+        )
+        t0 = time.perf_counter()
+        page = executor.execute(plan)
+        wall = time.perf_counter() - t0
+        text = P.plan_to_string(plan, executor.node_stats)
+        text += (
+            f"\n\nQuery: {page.count} output rows in {wall * 1000:.2f}ms "
+            f"(single node)"
+        )
+        col = column_from_pylist(T.VARCHAR, text.split("\n"))
+        return Page([col], len(text.split("\n")), ["Query Plan"])
 
     def _plan_stmt(self, stmt) -> P.PlanNode:
         with self.tracer.span("analyze+plan"):

@@ -332,6 +332,44 @@ class DropTable(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Parameter(Node):
+    """Positional ? parameter in a prepared statement."""
+
+    index: int  # 0-based
+
+
+@dataclasses.dataclass(frozen=True)
+class Prepare(Node):
+    """PREPARE name FROM statement"""
+
+    name: str
+    statement: Node
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutePrepared(Node):
+    """EXECUTE name [USING expr, ...]"""
+
+    name: str
+    args: Tuple[Node, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class Deallocate(Node):
+    """DEALLOCATE PREPARE name"""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Describe(Node):
+    """DESCRIBE INPUT name | DESCRIBE OUTPUT name"""
+
+    kind: str  # input | output
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class Explain(Node):
     query: Query
     analyze: bool = False
@@ -356,3 +394,55 @@ class SetSession(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowSession(Node):
     pass
+
+
+def transform(node, fn):
+    """Bottom-up structural rewrite over the AST (nodes + tuples); `fn`
+    maps each rebuilt node to its replacement.  Used for prepared-statement
+    parameter binding (the reference's ParameterRewriter)."""
+    if isinstance(node, Node):
+        kwargs = {}
+        changed = False
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            nv = transform(v, fn)
+            if nv is not v:
+                changed = True
+            kwargs[f.name] = nv
+        node2 = dataclasses.replace(node, **kwargs) if changed else node
+        return fn(node2)
+    if isinstance(node, tuple):
+        out = tuple(transform(x, fn) for x in node)
+        if len(out) == len(node) and all(a is b for a, b in zip(out, node)):
+            return node
+        return out
+    return node
+
+
+def substitute_parameters(node: Node, args) -> Node:
+    """Bind ? parameters positionally with the given expression nodes."""
+
+    def fn(n):
+        if isinstance(n, Parameter):
+            if n.index >= len(args):
+                raise ValueError(
+                    f"statement has parameter ?{n.index + 1} but only "
+                    f"{len(args)} values were supplied"
+                )
+            return args[n.index]
+        return n
+
+    return transform(node, fn)
+
+
+def count_parameters(node: Node) -> int:
+    count = 0
+
+    def fn(n):
+        nonlocal count
+        if isinstance(n, Parameter):
+            count = max(count, n.index + 1)
+        return n
+
+    transform(node, fn)
+    return count

@@ -87,6 +87,7 @@ class Parser:
         self.sql = sql
         self.toks = tokenize(sql)
         self.i = 0
+        self._param_count = 0  # positional ? parameters seen so far
 
     # --- token helpers -------------------------------------------------
     def peek(self, k: int = 0) -> Token:
@@ -175,6 +176,37 @@ class Parser:
                 raise ParseError(f"bad SET SESSION value {t!r}")
             self._finish()
             return ast.SetSession(name, value)
+        if self.accept_soft("prepare"):
+            name = self.ident()
+            self.expect_kw("from")
+            stmt = self.parse_statement()
+            return ast.Prepare(name, stmt)
+        if self.accept_soft("execute"):
+            name = self.ident()
+            args: List[ast.Node] = []
+            if self.accept_kw("using"):
+                args.append(self.expr())
+                while self.accept_op(","):
+                    args.append(self.expr())
+            self._finish()
+            return ast.ExecutePrepared(name, tuple(args))
+        if self.accept_soft("deallocate"):
+            self.accept_soft("prepare")
+            name = self.ident()
+            self._finish()
+            return ast.Deallocate(name)
+        if self.accept_soft("describe"):
+            if self.accept_soft("input"):
+                name = self.ident()
+                self._finish()
+                return ast.Describe("input", name)
+            if self.accept_soft("output"):
+                name = self.ident()
+                self._finish()
+                return ast.Describe("output", name)
+            name = self.qualified_name()
+            self._finish()
+            return ast.ShowColumns(name)
         if self.accept_kw("create"):
             self.expect_kw("table")
             ine = False
@@ -643,6 +675,11 @@ class Parser:
 
     def primary(self) -> ast.Node:
         t = self.peek()
+        if t.kind == "op" and t.text == "?":
+            self.next()
+            p = ast.Parameter(self._param_count)
+            self._param_count += 1
+            return p
         if t.kind == "number":
             self.next()
             if "." in t.text or "e" in t.text.lower():
