@@ -1,0 +1,256 @@
+"""Resource groups, access control, authentication, system tables.
+
+Reference parity: execution/resourcegroups/InternalResourceGroup (+ file
+config manager), security/AccessControlManager + file-based rules +
+password authenticator, and the system.runtime/system.metadata tables.
+"""
+import threading
+import time
+
+import pytest
+
+from trino_tpu.security import (
+    AccessDeniedError,
+    FileBasedAccessControl,
+    Identity,
+    PasswordAuthenticator,
+)
+from trino_tpu.server.resource_groups import (
+    InternalResourceGroup,
+    QueryQueueFullError,
+    ResourceGroupManager,
+)
+from trino_tpu.session import Session, tpch_session
+
+
+# -- resource groups ----------------------------------------------------
+
+
+def test_group_concurrency_and_queueing():
+    g = InternalResourceGroup("g", hard_concurrency_limit=2, max_queued=10)
+    started = []
+    for i in range(5):
+        g.submit(lambda i=i: started.append(i))
+    assert started == [0, 1]  # two run, three queued
+    g.finish()
+    assert started == [0, 1, 2]
+    g.finish()
+    g.finish()
+    assert started == [0, 1, 2, 3, 4]
+
+
+def test_group_queue_full_rejects():
+    g = InternalResourceGroup("g", hard_concurrency_limit=1, max_queued=1)
+    g.submit(lambda: None)
+    g.submit(lambda: None)  # queued
+    with pytest.raises(QueryQueueFullError):
+        g.submit(lambda: None)
+
+
+def test_parent_limit_bounds_children():
+    mgr = ResourceGroupManager({
+        "groups": [{
+            "name": "global", "hardConcurrencyLimit": 2,
+            "subGroups": [
+                {"name": "a", "hardConcurrencyLimit": 2},
+                {"name": "b", "hardConcurrencyLimit": 2},
+            ],
+        }],
+    })
+    a = mgr.groups["global.a"]
+    b = mgr.groups["global.b"]
+    ran = []
+    a.submit(lambda: ran.append("a1"))
+    b.submit(lambda: ran.append("b1"))
+    b.submit(lambda: ran.append("b2"))  # parent at limit -> queued
+    assert ran == ["a1", "b1"]
+    a.finish()
+    assert ran == ["a1", "b1", "b2"]
+
+
+def test_selectors():
+    mgr = ResourceGroupManager({
+        "groups": [
+            {"name": "global"},
+            {"name": "etl", "hardConcurrencyLimit": 1},
+        ],
+        "selectors": [
+            {"user": "etl_.*", "group": "etl"},
+            {"source": "dashboard", "group": "etl"},
+        ],
+    })
+    assert mgr.select("etl_nightly").full_name == "etl"
+    assert mgr.select("alice", "dashboard").full_name == "etl"
+    assert mgr.select("alice").full_name == "global"
+
+
+def test_coordinator_enforces_admission():
+    session = tpch_session(0.001)
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.client.client import StatementClient
+
+    server = CoordinatorServer(
+        session,
+        resource_groups={
+            "groups": [{"name": "global", "hardConcurrencyLimit": 1,
+                        "maxQueued": 5}],
+        },
+    ).start()
+    try:
+        client = StatementClient(server.uri)
+        cols, rows = client.execute("select count(*) from nation")
+        assert rows == [[25]]
+        # serial queries all succeed through the single-slot group
+        for _ in range(3):
+            _, rows = client.execute("select 1")
+            assert rows == [[1]]
+        info = {g["name"]: g for g in server.coordinator.resource_groups.info()}
+        assert info["global"]["running"] == 0
+    finally:
+        server.stop()
+
+
+# -- access control -----------------------------------------------------
+
+
+def test_file_based_rules_read_only():
+    ac = FileBasedAccessControl({
+        "catalogs": [
+            {"user": "*", "catalog": "tpch", "allow": "read-only"},
+            {"user": "admin", "catalog": "*", "allow": "all"},
+        ],
+    })
+    alice = Identity("alice")
+    admin = Identity("admin")
+    ac.check_can_select(alice, "tpch", "nation", ["n_name"])
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_insert(alice, "tpch", "nation")
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_select(alice, "memory", "t", [])
+    ac.check_can_insert(admin, "memory", "t")
+
+
+def test_table_level_rules():
+    ac = FileBasedAccessControl({
+        "catalogs": [{"user": "*", "catalog": "*", "allow": "all"}],
+        "tables": [
+            {"user": "*", "catalog": "tpch", "table": "nation",
+             "privileges": ["SELECT"]},
+        ],
+    })
+    i = Identity("bob")
+    ac.check_can_select(i, "tpch", "nation", [])
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_select(i, "tpch", "orders", [])
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_delete(i, "tpch", "nation")
+
+
+def test_session_enforces_select(tmp_path):
+    s = tpch_session(0.001)
+    s.access_control.add(FileBasedAccessControl({
+        "catalogs": [
+            {"user": "admin", "catalog": "*", "allow": "all"},
+            {"user": "*", "catalog": "tpch", "allow": "read-only"},
+        ],
+    }))
+    assert s.execute("select count(*) from nation").to_pylist() == [(25,)]
+    with pytest.raises(AccessDeniedError):
+        s.execute("select * from system.runtime.nodes")
+    assert s.execute(
+        "select state from system.runtime.nodes", user="admin"
+    ).to_pylist() == [("active",)]
+
+
+def test_session_enforces_writes():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.access_control.add(FileBasedAccessControl({
+        "catalogs": [
+            {"user": "writer", "catalog": "*", "allow": "all"},
+            {"user": "*", "catalog": "*", "allow": "read-only"},
+        ],
+    }))
+    with pytest.raises(AccessDeniedError):
+        s.execute("create table t (a bigint)")
+    s.execute("create table t (a bigint)", user="writer")
+    s.execute("insert into t values (1)", user="writer")
+    with pytest.raises(AccessDeniedError):
+        s.execute("insert into t values (2)")
+    with pytest.raises(AccessDeniedError):
+        s.execute("delete from t")
+    assert s.execute("select * from t").to_pylist() == [(1,)]
+
+
+def test_password_authenticator():
+    auth = PasswordAuthenticator({"alice": "secret"})
+    assert auth.authenticate("alice", "secret").user == "alice"
+    with pytest.raises(AccessDeniedError):
+        auth.authenticate("alice", "wrong")
+    with pytest.raises(AccessDeniedError):
+        auth.authenticate("mallory", "secret")
+
+
+def test_http_auth_required():
+    session = tpch_session(0.001)
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.client.client import StatementClient
+    import urllib.error
+
+    server = CoordinatorServer(
+        session, authenticator=PasswordAuthenticator({"alice": "pw"})
+    ).start()
+    try:
+        good = StatementClient(server.uri, user="alice", password="pw")
+        _, rows = good.execute("select 7")
+        assert rows == [[7]]
+        bad = StatementClient(server.uri, user="alice", password="nope")
+        with pytest.raises(urllib.error.HTTPError):
+            bad.execute("select 7")
+        anon = StatementClient(server.uri)
+        with pytest.raises(urllib.error.HTTPError):
+            anon.execute("select 7")
+    finally:
+        server.stop()
+
+
+# -- system tables ------------------------------------------------------
+
+
+def test_system_catalogs_tables_columns():
+    s = tpch_session(0.001)
+    cats = s.execute(
+        "select catalog_name from system.metadata.catalogs order by 1"
+    ).to_pylist()
+    assert ("tpch",) in cats and ("system",) in cats
+    tabs = s.execute(
+        "select table_name from system.jdbc.tables "
+        "where table_catalog = 'tpch' order by 1"
+    ).to_pylist()
+    assert ("lineitem",) in tabs
+    cols = s.execute(
+        "select column_name, data_type from system.jdbc.columns "
+        "where table_name = 'nation' order by 1"
+    ).to_pylist()
+    assert ("n_nationkey", "bigint") in cols
+
+
+def test_system_runtime_queries_records_history():
+    s = tpch_session(0.001)
+    s.execute("select 1")
+    try:
+        s.execute("select bogus_column from nation")
+    except Exception:
+        pass
+    rows = s.execute(
+        "select state, query from system.runtime.queries order by created"
+    ).to_pylist()
+    states = [r[0] for r in rows]
+    assert "FINISHED" in states and "FAILED" in states
+
+
+def test_system_runtime_nodes_local():
+    s = tpch_session(0.001)
+    assert s.execute(
+        "select node_id, state from system.runtime.nodes"
+    ).to_pylist() == [("local", "active")]

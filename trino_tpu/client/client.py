@@ -16,16 +16,30 @@ class ClientError(RuntimeError):
 
 
 class StatementClient:
-    def __init__(self, server: str):
+    def __init__(self, server: str, user: str = "trino-tpu",
+                 password: Optional[str] = None, source: str = ""):
         self.server = server.rstrip("/")
+        self.user = user
+        self.password = password
+        self.source = source
 
     def execute(self, sql: str) -> Tuple[List[dict], List[list]]:
         """Returns (columns, rows)."""
+        headers = {"X-Trino-User": self.user}
+        if self.source:
+            headers["X-Trino-Source"] = self.source
+        if self.password is not None:
+            import base64
+
+            cred = base64.b64encode(
+                f"{self.user}:{self.password}".encode()
+            ).decode()
+            headers["Authorization"] = f"Basic {cred}"
         req = urllib.request.Request(
             f"{self.server}/v1/statement",
             data=sql.encode(),
             method="POST",
-            headers={"X-Trino-User": "trino-tpu"},
+            headers=headers,
         )
         with urllib.request.urlopen(req) as resp:
             doc = json.load(resp)

@@ -32,6 +32,7 @@ from ..sql import ast
 from ..sql.parser import parse
 from . import protocol
 from .discovery import HeartbeatFailureDetector, NodeManager
+from .resource_groups import QueryQueueFullError, ResourceGroupManager
 
 PAGE_ROWS = 4096
 
@@ -39,10 +40,12 @@ PAGE_ROWS = 4096
 class QueryExecution:
     """One tracked query (QueryStateMachine + QueryTracker entry)."""
 
-    def __init__(self, query_id: str, sql: str):
+    def __init__(self, query_id: str, sql: str, user: str = "user"):
         self.query_id = query_id
         self.slug = secrets.token_hex(8)
         self.sql = sql
+        self.user = user
+        self.group = None  # resource group holding our slot
         self.state = "QUEUED"
         self.error: Optional[str] = None
         self.page: Optional[Page] = None
@@ -61,8 +64,14 @@ class Coordinator:
         session: Session,
         workers: int = 4,
         distributed: bool = False,
+        resource_groups: Optional[dict] = None,
+        authenticator=None,
     ):
         self.session = session
+        # admission control (InternalResourceGroupManager)
+        self.resource_groups = ResourceGroupManager(resource_groups)
+        # optional PasswordAuthenticator (security.py); None = open access
+        self.authenticator = authenticator
         self.queries: Dict[str, QueryExecution] = {}
         self.pool = ThreadPoolExecutor(max_workers=workers)
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
@@ -76,10 +85,20 @@ class Coordinator:
         )
 
     # -- lifecycle ------------------------------------------------------
-    def submit(self, sql: str) -> QueryExecution:
-        q = QueryExecution(f"q_{uuid.uuid4().hex[:16]}", sql)
+    def submit(self, sql: str, user: str = "user",
+               source: str = "") -> QueryExecution:
+        q = QueryExecution(f"q_{uuid.uuid4().hex[:16]}", sql, user)
         self.queries[q.query_id] = q
-        self.pool.submit(self._run, q)
+        group = self.resource_groups.select(user, source)
+        q.group = group
+        try:
+            group.submit(lambda: self.pool.submit(self._run, q))
+        except QueryQueueFullError as e:
+            with q.lock:
+                q.state = "FAILED"
+                q.error = f"QUERY_QUEUE_FULL: {e}"
+                q.finished = time.time()
+                q.group = None
         return q
 
     def _run(self, q: QueryExecution):
@@ -99,6 +118,9 @@ class Coordinator:
                 q.error = f"{type(e).__name__}: {e}"
                 q.state = "FAILED"
                 q.finished = time.time()
+        finally:
+            if q.group is not None:
+                q.group.finish()
 
     def _execute(self, q: QueryExecution) -> Page:
         """Distributed mode routes plain queries through the fragment
@@ -138,7 +160,7 @@ class Coordinator:
                     self.session.catalogs, workers, task_props
                 )
                 return sched.run(plan, q.query_id)
-        return self.session.execute(q.sql)
+        return self.session.execute(q.sql, user=q.user)
 
     def cancel(self, query_id: str):
         q = self.queries.get(query_id)
@@ -198,11 +220,39 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _authenticate(self) -> Optional[str]:
+        """Returns the authenticated/declared user, or None after sending a
+        401 (PasswordAuthenticator + X-Trino-User header handling)."""
+        user = self.headers.get("X-Trino-User") or "user"
+        auth = self.coordinator.authenticator
+        if auth is None:
+            return user
+        import base64
+
+        header = self.headers.get("Authorization", "")
+        if header.startswith("Basic "):
+            try:
+                decoded = base64.b64decode(header[6:]).decode()
+                u, _, pw = decoded.partition(":")
+                auth.authenticate(u, pw)
+                return u
+            except Exception:
+                pass
+        self.send_response(401)
+        self.send_header("WWW-Authenticate", "Basic realm=\"trino-tpu\"")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return None
+
     def do_POST(self):
         if self.path == "/v1/statement":
+            user = self._authenticate()
+            if user is None:
+                return
             n = int(self.headers.get("Content-Length", 0))
             sql = self.rfile.read(n).decode()
-            q = self.coordinator.submit(sql)
+            source = self.headers.get("X-Trino-Source", "")
+            q = self.coordinator.submit(sql, user, source)
             self._json(200, self.coordinator.results_doc(q, 0))
         elif self.path == "/v1/announcement":
             n = int(self.headers.get("Content-Length", 0))
@@ -236,6 +286,9 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
                 "totalQueries": len(co.queries),
             })
+            return
+        if self.path == "/v1/resourceGroupState":
+            self._json(200, co.resource_groups.info())
             return
         if self.path == "/v1/query":
             self._json(200, [
@@ -279,8 +332,14 @@ class _Handler(BaseHTTPRequestHandler):
 class CoordinatorServer:
     """In-process server handle (TestingTrinoServer analog)."""
 
-    def __init__(self, session: Session, port: int = 0, distributed: bool = False):
-        self.coordinator = Coordinator(session, distributed=distributed)
+    def __init__(self, session: Session, port: int = 0,
+                 distributed: bool = False,
+                 resource_groups: Optional[dict] = None,
+                 authenticator=None):
+        self.coordinator = Coordinator(
+            session, distributed=distributed,
+            resource_groups=resource_groups, authenticator=authenticator,
+        )
         handler = type("Handler", (_Handler,), {"coordinator": self.coordinator})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]

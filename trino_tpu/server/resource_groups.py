@@ -1,0 +1,169 @@
+"""Resource groups: admission control and query queueing.
+
+Reference parity: execution/resourcegroups/InternalResourceGroup +
+InternalResourceGroupManager and the file-based configuration manager
+(plugin/trino-resource-group-managers): a tree of groups with concurrency
+and queue limits, selectors matching (user, source) to a group, FIFO/fair
+start order, and QUERY_QUEUE_FULL rejection.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+
+class QueryQueueFullError(RuntimeError):
+    """Reference: StandardErrorCode.QUERY_QUEUE_FULL."""
+
+
+class InternalResourceGroup:
+    """One node of the group tree (InternalResourceGroup analog).
+
+    hard_concurrency_limit caps simultaneously running queries; max_queued
+    caps the wait queue; excess submissions are rejected.  A parent's
+    limits bound the sum of its children (checked transitively on
+    acquire)."""
+
+    def __init__(
+        self,
+        name: str,
+        hard_concurrency_limit: int = 100,
+        max_queued: int = 1000,
+        parent: Optional["InternalResourceGroup"] = None,
+    ):
+        self.name = name
+        self.hard_concurrency_limit = hard_concurrency_limit
+        self.max_queued = max_queued
+        self.parent = parent
+        self.running = 0
+        self.queue: deque = deque()  # callables to start queued queries
+        self.lock = parent.lock if parent else threading.Lock()
+        self.children: List["InternalResourceGroup"] = []
+        if parent is not None:
+            parent.children.append(self)
+
+    @property
+    def full_name(self) -> str:
+        if self.parent is None:
+            return self.name
+        return f"{self.parent.full_name}.{self.name}"
+
+    def _can_run_locked(self) -> bool:
+        g: Optional[InternalResourceGroup] = self
+        while g is not None:
+            if g.running >= g.hard_concurrency_limit:
+                return False
+            g = g.parent
+        return True
+
+    def _add_running_locked(self, delta: int):
+        g: Optional[InternalResourceGroup] = self
+        while g is not None:
+            g.running += delta
+            g = g.parent
+
+    def submit(self, start: Callable[[], None]) -> str:
+        """Either starts the query now (returns 'running'), queues it
+        (returns 'queued'; `start` runs later), or raises
+        QueryQueueFullError."""
+        with self.lock:
+            if self._can_run_locked():
+                self._add_running_locked(1)
+                run_now = True
+            elif len(self.queue) >= self.max_queued:
+                raise QueryQueueFullError(
+                    f"Too many queued queries for \"{self.full_name}\" "
+                    f"(max {self.max_queued})"
+                )
+            else:
+                self.queue.append(start)
+                run_now = False
+        if run_now:
+            start()
+            return "running"
+        return "queued"
+
+    def finish(self):
+        """Release one running slot and start queued queries anywhere in
+        the tree that now fit (processQueuedQueries walks from the root:
+        a slot freed under a shared parent can admit a sibling's query)."""
+        root: InternalResourceGroup = self
+        while root.parent is not None:
+            root = root.parent
+        to_start: List[Callable[[], None]] = []
+        with self.lock:
+            self._add_running_locked(-1)
+            progress = True
+            while progress:
+                progress = False
+                stack = [root]
+                while stack:
+                    g = stack.pop()
+                    while g.queue and g._can_run_locked():
+                        g._add_running_locked(1)
+                        to_start.append(g.queue.popleft())
+                        progress = True
+                    stack.extend(g.children)
+        for start in to_start:
+            start()
+
+    def stats(self) -> dict:
+        with self.lock:
+            return {
+                "name": self.full_name,
+                "running": self.running,
+                "queued": len(self.queue),
+                "hardConcurrencyLimit": self.hard_concurrency_limit,
+                "maxQueued": self.max_queued,
+            }
+
+
+class ResourceGroupManager:
+    """Selector-based group assignment (InternalResourceGroupManager +
+    the file-based SelectorSpec: first selector whose user/source regexes
+    match wins; no match falls through to the root 'global' group)."""
+
+    def __init__(self, config: Optional[dict] = None):
+        # config: {"groups": [{"name", "hardConcurrencyLimit", "maxQueued",
+        #                      "subGroups": [...]}, ...],
+        #          "selectors": [{"user": regex, "source": regex,
+        #                         "group": dotted.name}, ...]}
+        self.groups: Dict[str, InternalResourceGroup] = {}
+        self.selectors: List[dict] = []
+        cfg = config or {}
+        for g in cfg.get("groups", ()) or ():
+            self._build_group(g, None)
+        if "global" not in self.groups:
+            self._build_group({"name": "global"}, None)
+        for sel in cfg.get("selectors", ()) or ():
+            self.selectors.append(sel)
+
+    def _build_group(self, spec: dict, parent):
+        g = InternalResourceGroup(
+            spec["name"],
+            int(spec.get("hardConcurrencyLimit", 100)),
+            int(spec.get("maxQueued", 1000)),
+            parent,
+        )
+        self.groups[g.full_name] = g
+        for sub in spec.get("subGroups", ()) or ():
+            self._build_group(sub, g)
+        return g
+
+    def select(self, user: str, source: str = "") -> InternalResourceGroup:
+        for sel in self.selectors:
+            if "user" in sel and not re.fullmatch(sel["user"], user or ""):
+                continue
+            if "source" in sel and not re.fullmatch(
+                sel["source"], source or ""
+            ):
+                continue
+            g = self.groups.get(sel["group"])
+            if g is not None:
+                return g
+        return self.groups["global"]
+
+    def info(self) -> List[dict]:
+        return [g.stats() for g in self.groups.values()]

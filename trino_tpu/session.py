@@ -12,6 +12,7 @@ The coordinator HTTP server (server/coordinator.py) wraps this same path.
 """
 from __future__ import annotations
 
+import time
 import uuid
 from typing import Optional
 
@@ -36,6 +37,7 @@ class Session:
         self,
         catalog: Optional[str] = None,
         config: Optional[dict] = None,
+        user: str = "user",
     ):
         self.catalogs = CatalogManager()
         self.catalogs.register_factory(TpchConnectorFactory())
@@ -67,6 +69,17 @@ class Session:
         # PREPARE name FROM ... statements (QueryPreparer / prepared
         # statement store; the reference keeps these per client session)
         self.prepared: dict = {}
+        from .security import AccessControlManager, Identity
+
+        self.identity = Identity(user)
+        self.access_control = AccessControlManager()
+        # system.runtime.queries backing store (QueryTracker history)
+        self.query_history: list = []
+        # the built-in system catalog (system.runtime.* etc.)
+        from .connectors.system import SystemConnectorFactory
+
+        self.catalogs.register_factory(SystemConnectorFactory())
+        self.catalogs.create_catalog("system", "system", {"session": self})
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
@@ -108,26 +121,50 @@ class Session:
         return P.plan_to_string(self.plan(sql))
 
     # ------------------------------------------------------------------
-    def execute(self, sql: str) -> Page:
+    def execute(self, sql: str, user: Optional[str] = None) -> Page:
+        from .security import Identity
+
+        identity = Identity(user) if user else self.identity
         query_id = f"q_{uuid.uuid4().hex[:12]}"
         created = self.events.query_created(query_id, sql)
+        entry = {
+            "query_id": query_id, "sql": sql, "state": "RUNNING",
+            "user": identity.user, "created": created,
+        }
+        self.query_history.append(entry)
+        del self.query_history[:-1000]  # bounded history
         try:
             with self.tracer.span("query", query_id=query_id):
                 with self.tracer.span("parse"):
                     stmt = parse(sql)
-                page = self._execute_statement(stmt, sql, query_id)
+                self.access_control.check_can_execute_query(identity)
+                page = self._execute_statement(
+                    stmt, sql, query_id, identity
+                )
             self.events.query_completed(
                 query_id, sql, "FINISHED", created, page.count
+            )
+            entry.update(
+                state="FINISHED", finished=time.time(),
+                rows=page.count,
             )
             return page
         except Exception as e:
             self.events.query_completed(
                 query_id, sql, "FAILED", created, error=str(e)
             )
+            entry.update(
+                state="FAILED", finished=time.time(),
+                error=str(e),
+            )
             raise
 
-    def _execute_statement(self, stmt, sql: str, query_id: str) -> Page:
+    def _execute_statement(self, stmt, sql: str, query_id: str,
+                           identity=None) -> Page:
+        if identity is None:
+            identity = self.identity
         if isinstance(stmt, ast.SetSession):
+            self.access_control.check_can_set_session(identity, stmt.name)
             self.properties.set(stmt.name, stmt.value)
             return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
         if isinstance(stmt, ast.ShowSession):
@@ -177,7 +214,7 @@ class Session:
                     f"{nparams} parameter(s) left unbound; "
                     f"EXECUTE ... USING must supply all values"
                 )
-            return self._execute_statement(bound, sql, query_id)
+            return self._execute_statement(bound, sql, query_id, identity)
         if isinstance(stmt, ast.Describe):
             if stmt.name.lower() not in self.prepared:
                 raise KeyError(f"prepared statement not found: {stmt.name}")
@@ -215,6 +252,9 @@ class Session:
             catalog, table = self.metadata.resolve_new_table(
                 stmt.table, self.default_catalog
             )
+            self.access_control.check_can_create_table(
+                identity, catalog, table
+            )
             md = self.catalogs.get(catalog).metadata()
             if stmt.if_not_exists and table in md.list_tables():
                 return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
@@ -232,6 +272,9 @@ class Session:
             catalog, table = self.metadata.resolve_new_table(
                 stmt.table, self.default_catalog
             )
+            self.access_control.check_can_drop_table(
+                identity, catalog, table
+            )
             md = self.catalogs.get(catalog).metadata()
             if stmt.if_exists and table not in md.list_tables():
                 return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
@@ -239,6 +282,7 @@ class Session:
             return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
 
         plan = self._plan_stmt(stmt)
+        self._check_plan_access(plan, identity)
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):
             page = executor.execute(plan)
@@ -270,6 +314,31 @@ class Session:
         )
         col = column_from_pylist(T.VARCHAR, text.split("\n"))
         return Page([col], len(text.split("\n")), ["Query Plan"])
+
+    def _check_plan_access(self, plan: P.PlanNode, identity) -> None:
+        """Table-level authorization over the planned statement: SELECT on
+        every scanned table, INSERT/DELETE/CREATE on write targets
+        (AccessControlManager checks made by StatementAnalyzer /
+        planner in the reference)."""
+        ac = self.access_control
+
+        def walk(n: P.PlanNode):
+            if isinstance(n, P.TableScan):
+                ac.check_can_select(
+                    identity, n.catalog, n.table,
+                    [c for _, c in n.assignments],
+                )
+            if isinstance(n, P.TableWriter):
+                if n.create_schema is not None:
+                    ac.check_can_create_table(identity, n.catalog, n.table)
+                elif n.report_deleted:
+                    ac.check_can_delete(identity, n.catalog, n.table)
+                else:
+                    ac.check_can_insert(identity, n.catalog, n.table)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
 
     def _plan_stmt(self, stmt) -> P.PlanNode:
         with self.tracer.span("analyze+plan"):
