@@ -1,0 +1,164 @@
+"""Array type, array functions, lambdas, and UNNEST tests.
+
+Reference parity: spi/block/ArrayBlock + operator/scalar array functions
+(ArrayTransformFunction, ArrayFilterFunction, ReduceFunction, ...) and
+operator/unnest/UnnestOperator.  Arrays here are dictionary-encoded
+(types.ArrayType); functions evaluate host-side per distinct array.
+"""
+import pytest
+
+from trino_tpu.session import Session, tpch_session
+from trino_tpu.sql.analyzer import SemanticError
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(0.001)
+
+
+def rows(s, sql):
+    return s.execute(sql).to_pylist()
+
+
+def test_array_literal_and_subscript(session):
+    assert rows(session, "select array[1, 2, 3]") == [([1, 2, 3],)]
+    assert rows(session, "select array[10, 20][2], element_at(array[10, 20], 1)") == [
+        (20, 10)
+    ]
+    assert rows(session, "select array['a', 'b']") == [(["a", "b"],)]
+    assert rows(session, "select array[1, null, 3]") == [([1, None, 3],)]
+
+
+def test_element_at_out_of_bounds_null(session):
+    assert rows(
+        session,
+        "select element_at(array[1, 2], 5), element_at(array[1, 2], -1)",
+    ) == [(None, 2)]
+
+
+def test_cardinality_contains_position(session):
+    assert rows(
+        session,
+        "select cardinality(array[1,2,3]), contains(array[1,2], 2), "
+        "contains(array[1,2], 9), array_position(array[5,6,7], 6)",
+    ) == [(3, True, False, 2)]
+
+
+def test_array_manipulation(session):
+    assert rows(
+        session,
+        "select array_sort(array[3,1,2]), array_distinct(array[1,1,2]), "
+        "array_reverse(array[1,2,3]), slice(array[1,2,3,4], 2, 2)",
+    ) == [([1, 2, 3], [1, 2], [3, 2, 1], [2, 3])]
+    assert rows(
+        session, "select array_min(array[4,9,2]), array_max(array[4,9,2])"
+    ) == [(2, 9)]
+    assert rows(session, "select array_join(array[1,2,3], '-')") == [("1-2-3",)]
+
+
+def test_sequence(session):
+    assert rows(session, "select sequence(1, 5)") == [([1, 2, 3, 4, 5],)]
+    assert rows(session, "select sequence(5, 1, -2)") == [([5, 3, 1],)]
+
+
+def test_split(session):
+    assert rows(session, "select split('a,b,c', ',')") == [(["a", "b", "c"],)]
+    out = rows(
+        session,
+        "select n_name, cardinality(split(n_comment, ' ')) from nation "
+        "order by n_nationkey limit 2",
+    )
+    assert out[0][0] == "ALGERIA" and out[0][1] > 0
+
+
+def test_transform_filter(session):
+    assert rows(
+        session, "select transform(array[1,2,3], x -> x * 10)"
+    ) == [([10, 20, 30],)]
+    assert rows(
+        session, "select filter(array[1,2,3,4], x -> x > 2)"
+    ) == [([3, 4],)]
+    assert rows(
+        session, "select transform(array['a','b'], s -> upper(s))"
+    ) == [(["A", "B"],)]
+
+
+def test_reduce(session):
+    assert rows(
+        session, "select reduce(array[1,2,3], 0, (s, x) -> s + x, s -> s)"
+    ) == [(6,)]
+    assert rows(
+        session,
+        "select reduce(array[1,2,3,4], 1, (s, x) -> s * x, s -> s)",
+    ) == [(24,)]
+
+
+def test_match_functions(session):
+    assert rows(
+        session,
+        "select any_match(array[1,2], x -> x > 1), "
+        "all_match(array[1,2], x -> x > 0), "
+        "none_match(array[1,2], x -> x > 5)",
+    ) == [(True, True, True)]
+
+
+def test_unnest_standalone(session):
+    assert rows(session, "select x from unnest(array[1,2,3]) as t(x)") == [
+        (1,), (2,), (3,),
+    ]
+    assert rows(
+        session,
+        "select x, o from unnest(array[7,8]) with ordinality as t(x, o)",
+    ) == [(7, 1), (8, 2)]
+
+
+def test_unnest_cross_join(session):
+    out = rows(
+        session,
+        "select n_name, i from nation cross join unnest(sequence(1,2)) "
+        "as t(i) where n_nationkey < 2 order by n_name, i",
+    )
+    assert out == [
+        ("ALGERIA", 1), ("ALGERIA", 2), ("ARGENTINA", 1), ("ARGENTINA", 2),
+    ]
+
+
+def test_unnest_split_column(session):
+    out = rows(
+        session,
+        "select u from nation cross join unnest(split(n_name, 'A')) "
+        "as t(u) where n_nationkey = 0",
+    )
+    assert out == [("",), ("LGERI",), ("",)]
+
+
+def test_unnest_then_aggregate(session):
+    out = rows(
+        session,
+        "select i, count(*) from nation cross join unnest(sequence(1,3)) "
+        "as t(i) group by i order by i",
+    )
+    assert out == [(1, 25), (2, 25), (3, 25)]
+
+
+def test_array_in_values_and_memory_table():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (a array(bigint))")
+    s.execute("insert into t values (array[1,2]), (array[3])")
+    assert s.execute(
+        "select cardinality(a) from t order by 1"
+    ).to_pylist() == [(1,), (2,)]
+    assert s.execute(
+        "select sum(x) from t cross join unnest(a) as u(x)"
+    ).to_pylist() == [(6,)]
+
+
+def test_lambda_outside_function_rejected(session):
+    with pytest.raises(SemanticError):
+        session.execute("select x -> x + 1")
+
+
+def test_non_array_unnest_rejected(session):
+    with pytest.raises(SemanticError):
+        session.execute("select * from unnest(1) as t(x)")

@@ -36,7 +36,7 @@ from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
 from ..ops import window as window_ops
-from ..page import Column, Page
+from ..page import Column, Page, pad_to
 from ..plan import nodes as P
 from ..spi import Split
 
@@ -473,6 +473,80 @@ class _TraceCtx:
             s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
         }
         return Batch(lanes, sel_sorted & boundary)
+
+    def _visit_unnest(self, node: P.Unnest) -> Batch:
+        """UNNEST via host-side expansion: lengths come from the array
+        dictionary, rows replicate with np.repeat, elements flatten into a
+        fresh lane (UnnestOperator's row-replication, staged on host since
+        output size is data-dependent — the same reason the reference
+        streams it row-by-row)."""
+        b = self.visit(node.source)
+        sel = np.asarray(b.sel)
+        rows = np.nonzero(sel)[0]
+        av, aok = b.lanes[node.array_symbol]
+        codes = np.asarray(av)[rows]
+        avalid = np.asarray(aok)[rows]
+        entries = self.ex.dicts.get(node.array_symbol)
+        if entries is None:
+            raise ExecutionError(
+                f"no dictionary for array column {node.array_symbol}"
+            )
+        lengths = np.array(
+            [
+                len(entries[c]) if (ok and c >= 0) else 0
+                for c, ok in zip(codes, avalid)
+            ],
+            dtype=np.int64,
+        )
+        total = int(lengths.sum())
+        cap = _pad_capacity(max(total, 1))
+        rep = np.repeat(rows, lengths)  # source row per output row
+        elems: list = []
+        for c, ok, ln in zip(codes, avalid, lengths):
+            if ln:
+                elems.extend(entries[c])
+        lanes = {}
+        for sym, (v, ok) in b.lanes.items():
+            if sym == node.array_symbol:
+                continue
+            vv = np.asarray(v)[rep]
+            vo = np.asarray(ok)[rep]
+            lanes[sym] = (
+                jnp.asarray(pad_to(vv, cap)),
+                jnp.asarray(pad_to(vo, cap, False)),
+            )
+        et = node.element_type
+        from ..page import column_from_pylist
+
+        if et.is_dictionary and not getattr(et, "is_array", False):
+            col = column_from_pylist(et, elems)
+            self.ex.dicts[node.element_symbol] = col.dictionary
+            ev = col.values
+            eo = (
+                np.ones(total, dtype=bool)
+                if col.validity is None
+                else col.validity
+            )
+        elif getattr(et, "is_array", False):
+            raise ExecutionError("UNNEST of nested arrays is not supported")
+        else:
+            ev = np.array(
+                [0 if x is None else x for x in elems], dtype=et.np_dtype
+            )
+            eo = np.array([x is not None for x in elems], dtype=bool)
+        lanes[node.element_symbol] = (
+            jnp.asarray(pad_to(ev, cap)),
+            jnp.asarray(pad_to(eo, cap, False)),
+        )
+        if node.ordinality_symbol:
+            ords = np.concatenate(
+                [np.arange(1, ln + 1, dtype=np.int64) for ln in lengths]
+            ) if total else np.zeros(0, dtype=np.int64)
+            lanes[node.ordinality_symbol] = (
+                jnp.asarray(pad_to(ords, cap)),
+                jnp.asarray(pad_to(np.ones(total, bool), cap, False)),
+            )
+        return Batch(lanes, jnp.arange(cap) < total)
 
     def _visit_groupid(self, node: P.GroupId) -> Batch:
         """GROUPING SETS row expansion: tile every lane once per grouping

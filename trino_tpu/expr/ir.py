@@ -168,6 +168,20 @@ class Cast(Expr):
         return (self.term,)
 
 
+@dataclasses.dataclass(frozen=True)
+class Lambda(Expr):
+    """Lambda argument of a higher-order function (sql/ir/Lambda).
+    `type` is the body's result type; params resolve as ColumnRefs inside
+    the body and are bound per element by the array-function evaluator."""
+
+    type: T.Type
+    params: Tuple[str, ...]
+    body: Expr
+
+    def children(self):
+        return (self.body,)
+
+
 def walk(e: Expr):
     yield e
     for c in e.children():

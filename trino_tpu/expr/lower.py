@@ -117,7 +117,11 @@ def compile_expr(
                         jnp.full(shape, -1, dtype=jnp.int32),
                         jnp.zeros(shape, dtype=bool),
                     )
-                ctx.expr_dicts[node] = np.array([node.value], dtype=object)
+                # safe 1-element object array (np.array([tuple]) would
+                # build a 2-D array for array-typed constants)
+                entry = np.empty(1, dtype=object)
+                entry[0] = node.value
+                ctx.expr_dicts[node] = entry
                 return (
                     jnp.zeros(shape, dtype=jnp.int32),
                     jnp.ones(shape, dtype=bool),
@@ -421,12 +425,17 @@ def _lower_call(node: ir.Call, cols, ev, ctx: LoweringContext) -> Lane:
     fn = FUNCTIONS.get(node.name)
     if fn is None:
         raise NotImplementedError(f"function {node.name}")
-    # string constants (LIKE patterns etc.) are consumed host-side from the
-    # node itself; they have no device lane
-    lanes = [
-        None
-        if (isinstance(a, ir.Constant) and isinstance(a.value, str))
-        else ev(a, cols)
-        for a in node.args
-    ]
+    # string constants (LIKE patterns etc.) and lambdas are consumed
+    # host-side from the node itself; they have no device lane — except a
+    # constant FIRST argument of dictionary-transforming functions like
+    # split(), which needs a real (single-entry-dictionary) lane
+    lanes = []
+    for i, a in enumerate(node.args):
+        if isinstance(a, ir.Lambda):
+            lanes.append(None)
+        elif (isinstance(a, ir.Constant) and isinstance(a.value, str)
+                and not (i == 0 and node.name in ("split",))):
+            lanes.append(None)
+        else:
+            lanes.append(ev(a, cols))
     return fn(node, lanes, ctx)

@@ -64,7 +64,15 @@ class Column:
             else np.asarray(self.validity)[:n]
         )
         out: list = []
-        if self.type.is_dictionary:
+        if getattr(self.type, "is_array", False):
+            d = self.dictionary
+            decode = _element_decoder(self.type.element)
+            for v, ok in zip(vals, valid):
+                if ok and int(v) >= 0:
+                    out.append([decode(x) for x in d[int(v)]])
+                else:
+                    out.append(None)
+        elif self.type.is_dictionary:
             d = self.dictionary
             for v, ok in zip(vals, valid):
                 out.append(str(d[int(v)]) if (ok and int(v) >= 0) else None)
@@ -128,8 +136,52 @@ class Page:
         return [tuple(vals) for vals in zip(*cols)] if cols else []
 
 
+def _element_decoder(et: T.Type):
+    """Array dictionary entries keep IR-constant conventions; decode to
+    client python values (matching Column.to_python per-type rules)."""
+    import numpy as _np
+
+    if et.is_decimal and et.scale:
+        div = 10 ** et.scale
+
+        return lambda x: None if x is None else x / div
+    if et.name == "date":
+        epoch = _np.datetime64("1970-01-01")
+
+        return lambda x: (
+            None if x is None else str(epoch + _np.timedelta64(int(x), "D"))
+        )
+    return lambda x: x
+
+
+def _element_encoder(et: T.Type):
+    """Inverse of _element_decoder: client python values -> IR conventions."""
+    import numpy as _np
+
+    if et.is_decimal and et.scale:
+        mul = 10 ** et.scale
+
+        return lambda x: None if x is None else int(round(float(x) * mul))
+    if et.name == "date":
+        epoch = _np.datetime64("1970-01-01")
+
+        return lambda x: (
+            None
+            if x is None
+            else int((_np.datetime64(x, "D") - epoch).astype(int))
+            if isinstance(x, str)
+            else int(x)
+        )
+    return lambda x: x
+
+
 def column_from_pylist(typ: T.Type, data: Sequence, dictionary=None) -> Column:
     """Build a Column from python values (None = NULL). Test helper."""
+    if getattr(typ, "is_array", False):
+        enc = _element_encoder(typ.element)
+        data = [
+            None if v is None else tuple(enc(x) for x in v) for v in data
+        ]
     n = len(data)
     validity = None
     if any(v is None for v in data):
@@ -140,7 +192,12 @@ def column_from_pylist(typ: T.Type, data: Sequence, dictionary=None) -> Column:
             for v in data:
                 if v is not None and v not in seen:
                     seen[v] = len(seen)
-            dictionary = np.array(list(seen.keys()), dtype=object)
+            entries = list(seen.keys())
+            # element-wise object array: np.array() would make equal-length
+            # tuple entries (arrays) into a 2-D array
+            dictionary = np.empty(len(entries), dtype=object)
+            for _i, _v in enumerate(entries):
+                dictionary[_i] = _v
         lookup = {v: i for i, v in enumerate(dictionary)}
         codes = np.array(
             [lookup.get(v, -1) if v is not None else -1 for v in data],

@@ -204,6 +204,13 @@ class Fragmenter:
         rs = self._cut(partial, part, keys, HASH, syms)
         return P.Distinct(rs), HASH, syms
 
+    def _do_unnest(self, node: P.Unnest):
+        # per-row expansion, streaming: partitioning unchanged
+        src, part, keys = self._rewrite(node.source)
+        if part == HASH and node.array_symbol in keys:
+            keys = ()
+        return dataclasses.replace(node, source=src), part, keys
+
     def _do_groupid(self, node: P.GroupId):
         # row expansion is local to each task; gid joins the hash keys of
         # the aggregation above, so partitioning is unchanged here

@@ -70,6 +70,44 @@ class Values(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Unnest(PlanNode):
+    """UNNEST expansion (UnnestNode + operator/unnest/UnnestOperator):
+    each input row replicates once per element of its array column; source
+    columns carry over, the element column and optional ordinality column
+    are appended."""
+
+    source: PlanNode
+    array_symbol: str
+    element_symbol: str
+    element_type: T.Type
+    ordinality_symbol: Optional[str] = None
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        out = [
+            s for s in self.source.output_symbols() if s != self.array_symbol
+        ]
+        out.append(self.element_symbol)
+        if self.ordinality_symbol:
+            out.append(self.ordinality_symbol)
+        return out
+
+    def output_types(self):
+        out = {
+            s: t
+            for s, t in self.source.output_types().items()
+            if s != self.array_symbol
+        }
+        out[self.element_symbol] = self.element_type
+        if self.ordinality_symbol:
+            out[self.ordinality_symbol] = T.BIGINT
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
 class TableWriter(PlanNode):
     """INSERT/CTAS/DELETE write sink (TableWriterNode + TableFinishNode
     combined: the reference splits writing and commit/stats collection into

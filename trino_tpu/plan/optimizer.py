@@ -163,7 +163,7 @@ def _rewrite_sources(node: P.PlanNode, new_sources: Tuple[P.PlanNode, ...]):
 
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
                          P.Limit, P.Distinct, P.Output, P.Exchange,
-                         P.Window, P.GroupId, P.TableWriter)):
+                         P.Window, P.GroupId, P.TableWriter, P.Unnest)):
         return dataclasses.replace(node, source=new_sources[0])
     if isinstance(node, P.Join):
         return dataclasses.replace(node, left=new_sources[0], right=new_sources[1])
@@ -457,6 +457,11 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
                 node,
                 source=prune(node.source, set(node.source.output_symbols())),
             )
+        if isinstance(node, P.Unnest):
+            need = (set(required) - {node.element_symbol,
+                                     node.ordinality_symbol})
+            need.add(node.array_symbol)
+            return dataclasses.replace(node, source=prune(node.source, need))
         if isinstance(node, P.TableScan):
             kept = tuple(
                 (s, c) for s, c in node.assignments if s in required

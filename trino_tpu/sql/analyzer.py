@@ -329,7 +329,12 @@ class Analyzer:
                 if c.value is None:
                     vals.append(None)
                 elif t.is_dictionary:
-                    code = codes[i].setdefault(str(c.value), len(codes[i]))
+                    entry = (
+                        tuple(c.value)
+                        if getattr(t, "is_array", False)
+                        else str(c.value)
+                    )
+                    code = codes[i].setdefault(entry, len(codes[i]))
                     vals.append(code)
                 elif t.is_decimal:
                     cs = c.type.scale if c.type.is_decimal else 0
@@ -1114,7 +1119,50 @@ class Analyzer:
             return RelationPlan(rp.root, Scope(fields))
         if isinstance(rel, ast.Join):
             return self._plan_join(rel)
+        if isinstance(rel, ast.UnnestRelation):
+            # standalone FROM UNNEST(constant-array): expand against dual
+            sym = self.symbols.new("dual")
+            dual = RelationPlan(
+                P.Values((sym,), ((sym, T.BIGINT),), ((0,),)), Scope([])
+            )
+            return self._plan_unnest(dual, rel)
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_unnest(
+        self, left: RelationPlan, u: ast.UnnestRelation
+    ) -> RelationPlan:
+        """CROSS JOIN UNNEST(arr): one output row per array element, left
+        columns replicated (UnnestNode + UnnestOperator; the reference also
+        zips multiple arrays/maps — single-array form here)."""
+        if len(u.exprs) != 1:
+            raise SemanticError("UNNEST supports a single array argument")
+        ea = ExprAnalyzer(self, left)
+        arr = ea.analyze(u.exprs[0])
+        left = ea.relation
+        if not getattr(arr.type, "is_array", False):
+            raise SemanticError("UNNEST argument must be an array")
+        if isinstance(arr, ir.ColumnRef):
+            arr_sym = arr.name
+            root = left.root
+        else:
+            arr_sym = self.symbols.new("unnestarr")
+            passthrough = [
+                (f.symbol, ir.ColumnRef(f.type, f.symbol))
+                for f in left.scope.fields
+            ]
+            root = P.Project(left.root, tuple(passthrough + [(arr_sym, arr)]))
+        elem_t = arr.type.element
+        elem_sym = self.symbols.new("unnest")
+        ord_sym = self.symbols.new("ordinality") if u.ordinality else None
+        node = P.Unnest(root, arr_sym, elem_sym, elem_t, ord_sym)
+        cols = list(u.columns) if u.columns else []
+        elem_name = (cols[0] if cols else (u.alias or "unnest")).lower()
+        fields = list(left.scope.fields)
+        fields.append(Field(u.alias, elem_name, elem_sym, elem_t))
+        if ord_sym is not None:
+            ord_name = (cols[1] if len(cols) > 1 else "ordinality").lower()
+            fields.append(Field(u.alias, ord_name, ord_sym, T.BIGINT))
+        return RelationPlan(node, Scope(fields))
 
     def _plan_table(self, t: ast.Table) -> RelationPlan:
         name = t.name[-1].lower()
@@ -1149,6 +1197,11 @@ class Analyzer:
         return RelationPlan(node, Scope(fields))
 
     def _plan_join(self, j: ast.Join) -> RelationPlan:
+        if isinstance(j.right, ast.UnnestRelation):
+            if j.kind not in ("cross", "inner", "left"):
+                raise SemanticError(f"{j.kind} JOIN UNNEST is not supported")
+            left = self.plan_relation(j.left)
+            return self._plan_unnest(left, j.right)
         left = self.plan_relation(j.left)
         right = self.plan_relation(j.right)
         scope = Scope(left.scope.fields + right.scope.fields)
@@ -1329,6 +1382,8 @@ class ExprAnalyzer:
         self.relation = relation
         # symbols produced by scalar subqueries (allowed post-aggregation)
         self.scalar_syms: set = set()
+        # lambda parameter types, bound while analyzing a lambda body
+        self.lambda_bindings: Dict[str, T.Type] = {}
 
     # -- entry ----------------------------------------------------------
     def analyze(self, e: ast.Node) -> ir.Expr:
@@ -1357,7 +1412,18 @@ class ExprAnalyzer:
 
     def _an(self, e: ast.Node) -> ir.Expr:
         if isinstance(e, ast.Identifier):
+            if (len(e.parts) == 1
+                    and e.parts[0].lower() in self.lambda_bindings):
+                name = e.parts[0].lower()
+                return ir.ColumnRef(self.lambda_bindings[name], name)
             return self._resolve_column(e.parts)
+        if isinstance(e, ast.ArrayLiteral):
+            return self._array_literal(e)
+        if isinstance(e, ast.Lambda):
+            raise SemanticError(
+                "lambda expressions are only valid as arguments of "
+                "higher-order functions (transform, filter, reduce, ...)"
+            )
         if isinstance(e, ast.Literal):
             return _literal(e)
         if isinstance(e, ast.TypedLiteral):
@@ -1509,6 +1575,11 @@ class ExprAnalyzer:
             # our kernels already mask error rows to NULL (divide-by-zero,
             # bad casts), matching TRY semantics without a control transfer
             return self._an(e.args[0])
+        if e.name in ("transform", "filter", "any_match", "all_match",
+                      "none_match", "reduce"):
+            return self._lambda_call(e)
+        if e.name == "sequence":
+            return self._sequence(e)
         from ..expr.functions import SIGNATURES
 
         if e.name in SIGNATURES:
@@ -1519,6 +1590,96 @@ class ExprAnalyzer:
                 raise SemanticError(str(err)) from err
             return _fold(ir.Call(rt, e.name, args))
         raise SemanticError(f"unknown function: {e.name}")
+
+    def _array_literal(self, e: ast.ArrayLiteral) -> ir.Expr:
+        """ARRAY[...] of constants -> ir.Constant with a tuple value
+        (ArrayConstructor; non-constant elements are out of scope — array
+        columns are dictionary-encoded, see types.ArrayType)."""
+        items = tuple(_fold(self._an(x)) for x in e.items)
+        if not items:
+            return ir.Constant(T.array_of(T.UNKNOWN), ())
+        if not all(isinstance(x, ir.Constant) for x in items):
+            raise SemanticError(
+                "ARRAY[...] elements must be constants in this engine"
+            )
+        et = items[0].type
+        for x in items[1:]:
+            et = T.common_super_type(et, x.type)
+        if et.name == "unknown":
+            et = T.BIGINT
+        vals = tuple(_coerce_const_value(x, et) for x in items)
+        return ir.Constant(T.array_of(et), vals)
+
+    def _sequence(self, e: ast.FunctionCall) -> ir.Expr:
+        args = [_fold(self._an(a)) for a in e.args]
+        if not (2 <= len(args) <= 3) or not all(
+            isinstance(a, ir.Constant) and a.value is not None for a in args
+        ):
+            raise SemanticError("sequence() requires constant bounds")
+        start, stop = int(args[0].value), int(args[1].value)
+        step = int(args[2].value) if len(args) > 2 else (
+            1 if stop >= start else -1
+        )
+        if step == 0:
+            raise SemanticError("sequence() step must not be zero")
+        if len(range(start, stop + (1 if step > 0 else -1), step)) > 10000:
+            raise SemanticError("sequence is too large (max 10000)")
+        vals = tuple(range(start, stop + (1 if step > 0 else -1), step))
+        return ir.Constant(T.array_of(T.BIGINT), vals)
+
+    def _lambda_call(self, e: ast.FunctionCall) -> ir.Expr:
+        """Higher-order functions: type the lambda body with its parameter
+        bound to the element type (FunctionResolver's function-type
+        inference for ArrayTransformFunction etc.)."""
+
+        def analyze_lambda(lam: ast.Node, bindings: Dict[str, T.Type]):
+            if not isinstance(lam, ast.Lambda):
+                raise SemanticError(f"{e.name}() expects a lambda argument")
+            if len(lam.params) != len(bindings):
+                raise SemanticError(
+                    f"lambda must take {len(bindings)} parameter(s)"
+                )
+            names = [p.lower() for p in lam.params]
+            saved = dict(self.lambda_bindings)
+            self.lambda_bindings.update(zip(names, bindings.values()))
+            try:
+                body = self._an(lam.body)
+            finally:
+                self.lambda_bindings = saved
+            return ir.Lambda(body.type, tuple(names), body)
+
+        arr = self._an(e.args[0])
+        if not getattr(arr.type, "is_array", False):
+            raise SemanticError(f"{e.name}() requires an array argument")
+        et = arr.type.element
+        if e.name == "reduce":
+            if len(e.args) != 4:
+                raise SemanticError(
+                    "reduce(array, initial, (s, x) -> ..., s -> ...)"
+                )
+            init = _fold(self._an(e.args[1]))
+            if not isinstance(init, ir.Constant):
+                raise SemanticError("reduce() initial state must be constant")
+            st = init.type if init.type.name != "unknown" else T.BIGINT
+            step = analyze_lambda(e.args[2], {"s": st, "x": et})
+            try:
+                st2 = T.common_super_type(st, step.type)
+            except TypeError:
+                st2 = step.type
+            if st2 != st:
+                step = analyze_lambda(e.args[2], {"s": st2, "x": et})
+            out = analyze_lambda(e.args[3], {"s": st2})
+            return ir.Call(out.type, "reduce", (arr, init, step, out))
+        if len(e.args) != 2:
+            raise SemanticError(f"{e.name}(array, lambda)")
+        lam = analyze_lambda(e.args[1], {"x": et})
+        if e.name == "transform":
+            rt: T.Type = T.array_of(lam.type)
+        elif e.name == "filter":
+            rt = arr.type
+        else:
+            rt = T.BOOLEAN
+        return ir.Call(rt, e.name, (arr, lam))
 
     def _scalar_subquery(self, q: ast.Query) -> ir.Expr:
         sub, _, corr = self.a._plan_subquery_correlated(q, self.relation.scope)
@@ -1794,6 +1955,22 @@ class PostAggAnalyzer:
 
 # ----------------------------------------------------------------------
 # literals, folding, typing helpers
+
+
+def _coerce_const_value(c: "ir.Constant", t: T.Type):
+    """Constant value -> IR convention of type t (decimal rescale etc.)."""
+    if c.value is None:
+        return None
+    if t.is_decimal:
+        cs = c.type.scale if c.type.is_decimal else 0
+        if t.scale >= cs:
+            return int(c.value) * 10 ** (t.scale - cs)
+        return int(c.value) // 10 ** (cs - t.scale)
+    if t.name in ("double", "real"):
+        if c.type.is_decimal:
+            return float(c.value) / 10 ** c.type.scale
+        return float(c.value)
+    return c.value
 
 
 def _literal(e: ast.Literal) -> ir.Constant:

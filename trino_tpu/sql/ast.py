@@ -181,6 +181,21 @@ class CaseExpr(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayLiteral(Node):
+    """ARRAY[e1, e2, ...]"""
+
+    items: Tuple[Node, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class Lambda(Node):
+    """x -> expr | (x, y) -> expr (higher-order function argument)."""
+
+    params: Tuple[str, ...]
+    body: Node
+
+
+@dataclasses.dataclass(frozen=True)
 class Star(Node):
     qualifier: Optional[str] = None  # t.* qualifier
 
@@ -207,6 +222,16 @@ class Join(Node):
     left: Node
     right: Node
     condition: Optional[Node]  # ON expr (None for cross)
+
+
+@dataclasses.dataclass(frozen=True)
+class UnnestRelation(Node):
+    """UNNEST(expr, ...) [WITH ORDINALITY] [AS alias (cols)]"""
+
+    exprs: Tuple[Node, ...]
+    alias: Optional[str] = None
+    columns: Optional[Tuple[str, ...]] = None
+    ordinality: bool = False
 
 
 # --- query structure ---------------------------------------------------

@@ -23,7 +23,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||=>|[-+*/%(),.;=<>\[\]?])
+  | (?P<op><>|!=|>=|<=|\|\||=>|->|[-+*/%(),.;=<>\[\]?])
 """,
     re.VERBOSE | re.DOTALL,
 )
@@ -523,6 +523,35 @@ class Parser:
             rel = ast.Join(kind, rel, right, cond)
 
     def relation_primary(self) -> ast.Node:
+        t = self.peek()
+        if (t.kind == "ident" and t.text.lower() == "unnest"
+                and self.peek(1).kind == "op" and self.peek(1).text == "("):
+            self.next()
+            self.next()
+            exprs = [self.expr()]
+            while self.accept_op(","):
+                exprs.append(self.expr())
+            self.expect_op(")")
+            ordinality = False
+            if self.accept_kw("with"):
+                if not self.accept_soft("ordinality"):
+                    raise ParseError("expected ORDINALITY after WITH")
+                ordinality = True
+            alias = None
+            cols = None
+            if self.accept_kw("as"):
+                alias = self.ident()
+            elif self.peek().kind == "ident":
+                alias = self.next().text
+            if alias is not None and self.accept_op("("):
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+            return ast.UnnestRelation(
+                tuple(exprs), alias, tuple(cols) if cols else None,
+                ordinality,
+            )
         if self.accept_op("("):
             # subquery or parenthesized join
             if self.at_kw("select", "with", "values"):
@@ -671,10 +700,51 @@ class Parser:
 
     def postfix(self) -> ast.Node:
         e = self.primary()
+        while self.accept_op("["):
+            # subscript: a[i] == element_at(a, i) (SqlBase.g4 subscript)
+            idx = self.expr()
+            self.expect_op("]")
+            e = ast.FunctionCall("element_at", (e, idx))
         return e
 
     def primary(self) -> ast.Node:
         t = self.peek()
+        # lambda: x -> body | (x, y) -> body
+        if (t.kind == "ident" and self.peek(1).kind == "op"
+                and self.peek(1).text == "->"):
+            name = self.next().text
+            self.next()  # ->
+            return ast.Lambda((name,), self.expr())
+        if (t.kind == "op" and t.text == "("
+                and self.peek(1).kind == "ident"
+                and self.peek(2).kind == "op"
+                and self.peek(2).text in (",", ")")):
+            # possible multi-param lambda: scan for ') ->'
+            save = self.i
+            self.next()
+            params = [self.peek().text]
+            if self.peek().kind == "ident":
+                self.next()
+                while self.accept_op(","):
+                    if self.peek().kind != "ident":
+                        params = None
+                        break
+                    params.append(self.next().text)
+                if (params is not None and self.accept_op(")")
+                        and self.accept_op("->")):
+                    return ast.Lambda(tuple(params), self.expr())
+            self.i = save
+        if (t.kind == "ident" and t.text.lower() == "array"
+                and self.peek(1).kind == "op" and self.peek(1).text == "["):
+            self.next()
+            self.next()
+            items: List[ast.Node] = []
+            if not self.accept_op("]"):
+                items.append(self.expr())
+                while self.accept_op(","):
+                    items.append(self.expr())
+                self.expect_op("]")
+            return ast.ArrayLiteral(tuple(items))
         if t.kind == "op" and t.text == "?":
             self.next()
             p = ast.Parameter(self._param_count)

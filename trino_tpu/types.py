@@ -58,6 +58,10 @@ class Type:
     def orderable(self) -> bool:
         return True
 
+    @property
+    def is_array(self) -> bool:
+        return False
+
 
 @dataclasses.dataclass(frozen=True)
 class FixedWidthType(Type):
@@ -94,6 +98,38 @@ class DecimalType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class ArrayType(Type):
+    """ARRAY(element).  Device representation: dictionary-encoded — int32
+    codes into a host-side dictionary of distinct python tuples (the
+    DictionaryBlock-over-ArrayBlock analog; reference spi/block/
+    ArrayBlock.java stores offsets+flat values, which here live host-side
+    since array columns are off the hot TPC path).  Element values inside
+    dictionary entries use IR-constant conventions (decimal -> unscaled
+    int, date -> epoch days, varchar -> str)."""
+
+    element: "Type" = None
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype("int32")  # dictionary code
+
+    @property
+    def is_dictionary(self) -> bool:
+        return True
+
+    @property
+    def is_array(self) -> bool:
+        return True
+
+    @property
+    def orderable(self) -> bool:
+        return False
+
+    def __str__(self) -> str:
+        return f"array({self.element})"
+
+
+@dataclasses.dataclass(frozen=True)
 class VarcharType(Type):
     """Dictionary-encoded varchar. length is advisory (like VARCHAR(n))."""
 
@@ -126,6 +162,10 @@ UNKNOWN = FixedWidthType("unknown", "int8")  # type of NULL literal
 
 def decimal(precision: int, scale: int) -> DecimalType:
     return DecimalType("decimal", precision, scale)
+
+
+def array_of(element: Type) -> ArrayType:
+    return ArrayType("array", element)
 
 
 def varchar(length: Optional[int] = None) -> VarcharType:
@@ -180,14 +220,24 @@ def common_super_type(a: Type, b: Type) -> Type:
         return TIMESTAMP
     if a.name == "timestamp" and b.name == "date":
         return TIMESTAMP
+    if getattr(a, "is_array", False) or getattr(b, "is_array", False):
+        if (
+            getattr(a, "is_array", False)
+            and getattr(b, "is_array", False)
+        ):
+            return array_of(common_super_type(a.element, b.element))
+        raise TypeError(f"no common type for {a} and {b}")
     if a.is_dictionary and b.is_dictionary:
         return VARCHAR
     raise TypeError(f"no common type for {a} and {b}")
 
 
 def parse_type(s: str) -> Type:
-    """Parse a SQL type name like 'decimal(12,2)' or 'varchar(25)'."""
+    """Parse a SQL type name like 'decimal(12,2)' or 'array(bigint)'."""
     s = s.strip().lower()
+    if s.startswith("array"):
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        return array_of(parse_type(inner))
     if s.startswith("decimal"):
         if "(" in s:
             inner = s[s.index("(") + 1 : s.rindex(")")]
