@@ -1,0 +1,205 @@
+"""SQL routines, SHOW statements, verifier and proxy services.
+
+Reference parity: sql/routine/ (CREATE FUNCTION), service/trino-verifier,
+service/trino-proxy.
+"""
+import pytest
+
+from trino_tpu.services.proxy import ProxyServer
+from trino_tpu.services.verifier import Verifier
+from trino_tpu.session import Session, tpch_session
+from trino_tpu.sql.analyzer import SemanticError
+
+
+@pytest.fixture()
+def session():
+    return tpch_session(0.001)
+
+
+def rows(s, sql):
+    return s.execute(sql).to_pylist()
+
+
+# -- SQL routines -------------------------------------------------------
+
+
+def test_create_function_scalar(session):
+    rows(session, "create function answer() returns bigint return 42")
+    assert rows(session, "select answer()") == [(42,)]
+
+
+def test_function_over_columns(session):
+    rows(
+        session,
+        "create function double_it(x bigint) returns bigint return x * 2",
+    )
+    assert rows(
+        session,
+        "select double_it(n_nationkey) from nation order by 1 limit 3",
+    ) == [(0,), (2,), (4,)]
+    # usable inside aggregates and predicates
+    expected = rows(
+        session,
+        "select sum(n_nationkey * 2) from nation where n_regionkey * 2 = 4",
+    )
+    assert rows(
+        session,
+        "select sum(double_it(n_nationkey)) from nation "
+        "where double_it(n_regionkey) = 4",
+    ) == expected
+
+
+def test_function_param_cast_and_nesting(session):
+    rows(
+        session,
+        "create function tax(price double, rate double) "
+        "returns double return price * (1 + rate)",
+    )
+    # integer arguments cast to the declared double parameters
+    assert rows(session, "select tax(100, 0)") == [(100.0,)]
+    rows(
+        session,
+        "create function double_it(x bigint) returns bigint return x * 2",
+    )
+    rows(
+        session,
+        "create function quad(x bigint) returns bigint "
+        "return double_it(double_it(x))",
+    )
+    assert rows(session, "select quad(3), double_it(quad(1))") == [(12, 8)]
+
+
+def test_create_or_replace(session):
+    rows(session, "create function f1() returns bigint return 1")
+    with pytest.raises(ValueError):
+        session.execute("create function f1() returns bigint return 2")
+    rows(session, "create or replace function f1() returns bigint return 2")
+    assert rows(session, "select f1()") == [(2,)]
+
+
+def test_recursive_function_rejected(session):
+    rows(
+        session,
+        "create function loop_fn(x bigint) returns bigint "
+        "return loop_fn(x)",
+    )
+    with pytest.raises(SemanticError):
+        session.execute("select loop_fn(1)")
+
+
+def test_drop_function(session):
+    rows(session, "create function gone() returns bigint return 0")
+    rows(session, "drop function gone")
+    with pytest.raises(SemanticError):
+        session.execute("select gone()")
+    rows(session, "drop function if exists gone")
+
+
+def test_show_functions_and_catalogs(session):
+    rows(session, "create function myfn() returns bigint return 7")
+    fns = dict(rows(session, "show functions"))
+    assert fns["myfn"] == "sql"
+    assert fns["ln"] == "scalar"
+    assert fns["sum"] == "aggregate"
+    cats = [c for (c,) in rows(session, "show catalogs")]
+    assert "tpch" in cats and "system" in cats
+
+
+def test_varchar_function(session):
+    rows(
+        session,
+        "create function shout(s varchar) returns varchar "
+        "return upper(s)",
+    )
+    assert rows(
+        session,
+        "select shout(n_name) from nation where n_nationkey = 0",
+    ) == [("ALGERIA",)]
+
+
+# -- verifier -----------------------------------------------------------
+
+
+def test_verifier_sessions_match():
+    control = tpch_session(0.001)
+    test = tpch_session(0.001)
+    v = Verifier(control, test)
+    results = v.verify([
+        "select count(*) from nation",
+        "select n_regionkey, count(*) from nation group by n_regionkey",
+        "select sum(o_totalprice) from orders",
+    ])
+    assert all(r.status == "MATCH" for r in results)
+    assert Verifier.summarize(results)["MATCH"] == 3
+
+
+def test_verifier_detects_mismatch():
+    control = tpch_session(0.001)
+    test = tpch_session(0.002)  # different scale factor -> different data
+    v = Verifier(control, test)
+    r = v.verify_one("select count(*) from orders")
+    assert r.status == "MISMATCH"
+    assert "rows" in r.detail
+
+
+def test_verifier_reports_failures():
+    control = tpch_session(0.001)
+    test = tpch_session(0.001)
+    v = Verifier(control, test)
+    assert v.verify_one("select bogus from nation").status == "CONTROL_FAILED"
+
+
+def test_verifier_over_http():
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    control = CoordinatorServer(tpch_session(0.001)).start()
+    test = CoordinatorServer(tpch_session(0.001)).start()
+    try:
+        v = Verifier(control.uri, test.uri)
+        r = v.verify_one("select count(*) from lineitem")
+        assert r.status == "MATCH"
+    finally:
+        control.stop()
+        test.stop()
+
+
+# -- proxy --------------------------------------------------------------
+
+
+def test_proxy_forwards_statements():
+    from trino_tpu.client.client import StatementClient
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    backend = CoordinatorServer(tpch_session(0.001)).start()
+    proxy = ProxyServer(backend.uri).start()
+    try:
+        client = StatementClient(proxy.uri)
+        cols, data = client.execute("select count(*) from nation")
+        assert data == [[25]]
+    finally:
+        proxy.stop()
+        backend.stop()
+
+
+def test_proxy_forwards_auth():
+    import urllib.error
+
+    from trino_tpu.client.client import StatementClient
+    from trino_tpu.security import PasswordAuthenticator
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    backend = CoordinatorServer(
+        tpch_session(0.001),
+        authenticator=PasswordAuthenticator({"alice": "pw"}),
+    ).start()
+    proxy = ProxyServer(backend.uri).start()
+    try:
+        good = StatementClient(proxy.uri, user="alice", password="pw")
+        _, data = good.execute("select 5")
+        assert data == [[5]]
+        bad = StatementClient(proxy.uri, user="alice", password="no")
+        with pytest.raises(urllib.error.HTTPError):
+            bad.execute("select 5")
+    finally:
+        proxy.stop()
+        backend.stop()

@@ -1,0 +1,2 @@
+"""Auxiliary services (reference service/ modules: trino-verifier,
+trino-proxy)."""

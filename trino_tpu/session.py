@@ -69,6 +69,8 @@ class Session:
         # PREPARE name FROM ... statements (QueryPreparer / prepared
         # statement store; the reference keeps these per client session)
         self.prepared: dict = {}
+        # CREATE FUNCTION registry (LanguageFunctionManager analog)
+        self.sql_functions: dict = {}
         from .security import AccessControlManager, Identity
 
         self.identity = Identity(user)
@@ -111,7 +113,8 @@ class Session:
         stmt = parse(sql)
         if isinstance(stmt, ast.Explain):
             stmt = stmt.query
-        analyzer = Analyzer(self.metadata, self.default_catalog)
+        analyzer = Analyzer(self.metadata, self.default_catalog,
+                            self.sql_functions)
         plan = analyzer.plan_statement(stmt)
         if optimized:
             plan = optimize(plan, self.metadata)
@@ -193,6 +196,52 @@ class Session:
                     "column": [c.name for c in schema.columns],
                     "type": [str(c.type) for c in schema.columns],
                 },
+            )
+        if isinstance(stmt, ast.CreateFunction):
+            from .sql.analyzer import SqlFunction
+
+            name = stmt.name.lower()
+            if name in self.sql_functions and not stmt.replace:
+                raise ValueError(f"function {name} already exists")
+            T.parse_type(stmt.return_type)  # validate eagerly
+            for _, pt in stmt.params:
+                T.parse_type(pt)
+            self.sql_functions[name] = SqlFunction(
+                name, tuple((p.lower(), t) for p, t in stmt.params),
+                stmt.return_type, stmt.body,
+            )
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.DropFunction):
+            name = stmt.name.lower()
+            if name not in self.sql_functions:
+                if stmt.if_exists:
+                    return page_from_pydict(
+                        [("result", T.BOOLEAN)], {"result": [True]}
+                    )
+                raise KeyError(f"function not found: {name}")
+            del self.sql_functions[name]
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.ShowFunctions):
+            from .expr.functions import SIGNATURES
+            from .sql.analyzer import AGGREGATES
+
+            names = sorted(
+                set(SIGNATURES) | AGGREGATES | set(self.sql_functions)
+            )
+            kinds = [
+                "sql" if n in self.sql_functions
+                else "aggregate" if n in AGGREGATES
+                else "scalar"
+                for n in names
+            ]
+            return page_from_pydict(
+                [("function", T.VARCHAR), ("kind", T.VARCHAR)],
+                {"function": names, "kind": kinds},
+            )
+        if isinstance(stmt, ast.ShowCatalogs):
+            return page_from_pydict(
+                [("catalog", T.VARCHAR)],
+                {"catalog": sorted(self.catalogs.names())},
             )
         if isinstance(stmt, ast.Prepare):
             self.prepared[stmt.name.lower()] = stmt.statement
@@ -342,7 +391,8 @@ class Session:
 
     def _plan_stmt(self, stmt) -> P.PlanNode:
         with self.tracer.span("analyze+plan"):
-            analyzer = Analyzer(self.metadata, self.default_catalog)
+            analyzer = Analyzer(self.metadata, self.default_catalog,
+                            self.sql_functions)
             plan = analyzer.plan_statement(stmt)
         with self.tracer.span("optimize"):
             plan = optimize(plan, self.metadata)

@@ -122,11 +122,17 @@ class RelationPlan:
 class Analyzer:
     """One statement analysis+planning session (LogicalPlanner.plan)."""
 
-    def __init__(self, metadata: Metadata, default_catalog: Optional[str]):
+    def __init__(self, metadata: Metadata, default_catalog: Optional[str],
+                 sql_functions: Optional[Dict[str, "SqlFunction"]] = None):
         self.metadata = metadata
         self.default_catalog = default_catalog
         self.symbols = SymbolAllocator()
         self.ctes: Dict[str, ast.Query] = {}
+        # CREATE FUNCTION registry (LanguageFunctionManager analog);
+        # expanded inline at analysis like the reference inlines SQL
+        # routines into the plan (sql/routine/SqlRoutineCompiler inlining)
+        self.sql_functions = sql_functions or {}
+        self._udf_stack: set = set()
         # correlated-subquery support: while planning a subquery, outer
         # scopes are visible for resolution; outer symbols actually used
         # are recorded per level (ApplyNode correlation list analog)
@@ -1411,6 +1417,8 @@ class ExprAnalyzer:
         return ir.ColumnRef(f.type, f.symbol)
 
     def _an(self, e: ast.Node) -> ir.Expr:
+        if isinstance(e, ast.Resolved):
+            return e.expr
         if isinstance(e, ast.Identifier):
             if (len(e.parts) == 1
                     and e.parts[0].lower() in self.lambda_bindings):
@@ -1575,6 +1583,9 @@ class ExprAnalyzer:
             # our kernels already mask error rows to NULL (divide-by-zero,
             # bad casts), matching TRY semantics without a control transfer
             return self._an(e.args[0])
+        fdef = self.a.sql_functions.get(e.name)
+        if fdef is not None and not e.is_star and e.window is None:
+            return self._expand_sql_function(fdef, e)
         if e.name in ("transform", "filter", "any_match", "all_match",
                       "none_match", "reduce"):
             return self._lambda_call(e)
@@ -1590,6 +1601,48 @@ class ExprAnalyzer:
                 raise SemanticError(str(err)) from err
             return _fold(ir.Call(rt, e.name, args))
         raise SemanticError(f"unknown function: {e.name}")
+
+    def _expand_sql_function(self, fdef: "SqlFunction",
+                             e: ast.FunctionCall) -> ir.Expr:
+        """Inline a CREATE FUNCTION body with arguments substituted for
+        parameters, then analyze it (the reference compiles routine IR to
+        bytecode; this engine inlines the expression so it fuses into the
+        surrounding kernel)."""
+        if fdef.name in self.a._udf_stack:
+            raise SemanticError(
+                f"recursive SQL function {fdef.name} is not supported"
+            )
+        if len(e.args) != len(fdef.params):
+            raise SemanticError(
+                f"{fdef.name}() takes {len(fdef.params)} argument(s)"
+            )
+        # arguments are analyzed in the caller's scope FIRST (so a nested
+        # call of the same function in an argument is not mistaken for
+        # recursion), then adopt the declared parameter types
+        mapping = {}
+        for (p, ptype), arg in zip(fdef.params, e.args):
+            a = self._an(arg)
+            pt = T.parse_type(ptype)
+            if a.type != pt:
+                a = _fold(ir.Cast(pt, a))
+            mapping[p.lower()] = ast.Resolved(a)
+
+        def subst(n):
+            if (isinstance(n, ast.Identifier) and len(n.parts) == 1
+                    and n.parts[0].lower() in mapping):
+                return mapping[n.parts[0].lower()]
+            return n
+
+        body = ast.transform(fdef.body, subst)
+        self.a._udf_stack.add(fdef.name)
+        try:
+            expr = self._an(body)
+        finally:
+            self.a._udf_stack.discard(fdef.name)
+        rt = T.parse_type(fdef.return_type)
+        if expr.type != rt:
+            expr = _fold(ir.Cast(rt, expr))
+        return expr
 
     def _array_literal(self, e: ast.ArrayLiteral) -> ir.Expr:
         """ARRAY[...] of constants -> ir.Constant with a tuple value
@@ -2224,3 +2277,13 @@ def _eval_const(name: str, out_t: T.Type, args) -> object:
                 "divide": av / bv if bv else None,
             }[name]
     raise NotImplementedError(name)
+
+
+@dataclasses.dataclass(frozen=True)
+class SqlFunction:
+    """A CREATE FUNCTION definition (expression-bodied SQL routine)."""
+
+    name: str
+    params: Tuple[Tuple[str, str], ...]  # (name, type text)
+    return_type: str
+    body: ast.Node

@@ -196,6 +196,15 @@ class Lambda(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Resolved(Node):
+    """Wrapper carrying an already-analyzed ir.Expr through AST analysis
+    (used when inlining SQL function bodies: arguments are analyzed in the
+    caller's scope first, then spliced into the body)."""
+
+    expr: object
+
+
+@dataclasses.dataclass(frozen=True)
 class Star(Node):
     qualifier: Optional[str] = None  # t.* qualifier
 
@@ -392,6 +401,34 @@ class Describe(Node):
 
     kind: str  # input | output
     name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateFunction(Node):
+    """CREATE [OR REPLACE] FUNCTION name (p type, ...) RETURNS type
+    RETURN expr  (SQL routine; reference sql/routine/ + LanguageFunctionManager)"""
+
+    name: str
+    params: Tuple[Tuple[str, str], ...]  # (name, type text)
+    return_type: str
+    body: Node
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropFunction(Node):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowFunctions(Node):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCatalogs(Node):
+    pass
 
 
 @dataclasses.dataclass(frozen=True)

@@ -154,6 +154,12 @@ class Parser:
             if self.accept_kw("tables"):
                 self._finish()
                 return ast.ShowTables()
+            if self.accept_soft("functions"):
+                self._finish()
+                return ast.ShowFunctions()
+            if self.accept_soft("catalogs"):
+                self._finish()
+                return ast.ShowCatalogs()
             if self.accept_kw("columns"):
                 self.expect_kw("from")
                 name = self.qualified_name()
@@ -208,6 +214,13 @@ class Parser:
             self._finish()
             return ast.ShowColumns(name)
         if self.accept_kw("create"):
+            replace = False
+            if self.accept_kw("or"):
+                if not self.accept_soft("replace"):
+                    raise ParseError("expected REPLACE after CREATE OR")
+                replace = True
+            if self.accept_soft("function"):
+                return self._create_function(replace)
             self.expect_kw("table")
             ine = False
             if self.accept_soft("if"):
@@ -248,6 +261,14 @@ class Parser:
             self._finish()
             return ast.Delete(name, where)
         if self.accept_kw("drop"):
+            if self.accept_soft("function"):
+                ie = False
+                if self.accept_soft("if"):
+                    self.expect_kw("exists")
+                    ie = True
+                name = self.ident()
+                self._finish()
+                return ast.DropFunction(name, ie)
             self.expect_kw("table")
             ie = False
             if self.accept_soft("if"):
@@ -263,17 +284,54 @@ class Parser:
     def column_def(self) -> Tuple[str, str]:
         """column definition: name + SQL type text (types.parse_type forms)."""
         name = self.ident()
+        return name, self.type_text()
+
+    def type_text(self) -> str:
         t = self.next()
         if t.kind not in ("ident", "kw"):
             raise ParseError(f"expected a type name at {t!r}")
         type_text = t.text
         if self.accept_op("("):
-            args = [self.next().text]
+            depth = 1
+            type_text += "("
+            while depth:
+                tok = self.next()
+                if tok.kind == "eof":
+                    raise ParseError("unterminated type")
+                if tok.kind == "op" and tok.text == "(":
+                    depth += 1
+                if tok.kind == "op" and tok.text == ")":
+                    depth -= 1
+                    if not depth:
+                        break
+                type_text += tok.text
+            type_text += ")"
+        return type_text
+
+    def _create_function(self, replace: bool) -> ast.Node:
+        """CREATE FUNCTION name (p type, ...) RETURNS type
+        [DETERMINISTIC] RETURN expr  (SqlBase.g4 functionSpecification,
+        expression-bodied SQL routines)."""
+        name = self.ident()
+        self.expect_op("(")
+        params: List[Tuple[str, str]] = []
+        if not self.accept_op(")"):
+            params.append(self.column_def())
             while self.accept_op(","):
-                args.append(self.next().text)
+                params.append(self.column_def())
             self.expect_op(")")
-            type_text += "(" + ",".join(args) + ")"
-        return name, type_text
+        if not self.accept_soft("returns"):
+            raise ParseError("expected RETURNS in CREATE FUNCTION")
+        rtype = self.type_text()
+        self.accept_soft("deterministic")
+        if not self.accept_soft("return"):
+            raise ParseError(
+                "expected RETURN <expression> (only expression-bodied "
+                "functions are supported)"
+            )
+        body = self.expr()
+        self._finish()
+        return ast.CreateFunction(name, tuple(params), rtype, body, replace)
 
     def _finish(self):
         self.accept_op(";")
