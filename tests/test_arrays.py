@@ -162,3 +162,39 @@ def test_lambda_outside_function_rejected(session):
 def test_non_array_unnest_rejected(session):
     with pytest.raises(SemanticError):
         session.execute("select * from unnest(1) as t(x)")
+
+
+# -- maps ---------------------------------------------------------------
+
+
+def test_map_constructor_and_subscript(session):
+    assert rows(
+        session, "select map(array['a','b'], array[1,2])"
+    ) == [({"a": 1, "b": 2},)]
+    assert rows(
+        session, "select map(array['a','b'], array[1,2])['b']"
+    ) == [(2,)]
+    assert rows(
+        session,
+        "select element_at(map(array['a'], array[1]), 'z'), "
+        "cardinality(map(array['a','b'], array[1,2]))",
+    ) == [(None, 2)]
+
+
+def test_map_keys_values_concat(session):
+    assert rows(
+        session,
+        "select map_keys(map(array['a','b'], array[1,2])), "
+        "map_values(map(array['a','b'], array[1,2]))",
+    ) == [(["a", "b"], [1, 2])]
+    # later keys win on concat
+    assert rows(
+        session,
+        "select map_concat(map(array['a'], array[1]), "
+        "map(array['a','b'], array[9,2]))",
+    ) == [({"a": 9, "b": 2},)]
+
+
+def test_map_duplicate_keys_rejected(session):
+    with pytest.raises(SemanticError):
+        session.execute("select map(array['a','a'], array[1,2])")

@@ -155,3 +155,45 @@ def test_insert_varchar_dictionary_merge(session):
     assert rows(
         session, "select b, count(*) from t group by b order by b"
     ) == [("a", 1), ("b", 2), ("c", 1)]
+
+
+def test_update_where(session):
+    rows(session, "create table t (a bigint, b varchar)")
+    rows(session, "insert into t values (1,'x'), (2,'y'), (3,'z')")
+    assert rows(session, "update t set b = 'Q' where a >= 2") == [(2,)]
+    assert rows(session, "select * from t order by a") == [
+        (1, "x"), (2, "Q"), (3, "Q"),
+    ]
+
+
+def test_update_all_rows_expression(session):
+    rows(session, "create table t (a bigint)")
+    rows(session, "insert into t values (1), (2)")
+    assert rows(session, "update t set a = a * 10") == [(2,)]
+    assert rows(session, "select * from t order by a") == [(10,), (20,)]
+
+
+def test_update_multiple_columns(session):
+    rows(session, "create table t (a bigint, b varchar)")
+    rows(session, "insert into t values (1,'x'), (2,'y')")
+    assert rows(
+        session, "update t set a = a + 100, b = upper(b) where a = 2"
+    ) == [(1,)]
+    assert rows(session, "select * from t order by a") == [
+        (1, "x"), (102, "Y"),
+    ]
+
+
+def test_update_null_predicate_untouched(session):
+    rows(session, "create table t (a bigint)")
+    rows(session, "insert into t values (1), (null), (3)")
+    assert rows(session, "update t set a = 0 where a > 2") == [(1,)]
+    assert rows(session, "select * from t order by a") == [
+        (0,), (1,), (None,),
+    ]
+
+
+def test_update_unknown_column_rejected(session):
+    rows(session, "create table t (a bigint)")
+    with pytest.raises(SemanticError):
+        session.execute("update t set nope = 1")

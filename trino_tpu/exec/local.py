@@ -235,8 +235,11 @@ class LocalExecutor:
         before = 0
         if w.report_deleted:
             before = int(md.get_table_statistics(w.table).row_count)
+        names = list(w.columns)
+        if w.count_symbol is not None:
+            names.append("__update_count__")
         inner = P.Output(
-            w.source, tuple(w.columns), tuple(w.source.output_symbols())
+            w.source, tuple(names), tuple(w.source.output_symbols())
         )
         page = self.execute(inner)
         sink = conn.page_sink_provider().create_sink(
@@ -244,7 +247,15 @@ class LocalExecutor:
         )
         sink.append(page)
         written = sink.finish()
-        result = before - written if w.report_deleted else written
+        if w.count_symbol is not None:
+            marker = page.by_name("__update_count__")
+            result = int(
+                np.asarray(marker.values)[: page.count].sum()
+            )
+        elif w.report_deleted:
+            result = before - written
+        else:
+            result = written
         return Page(
             [Column(T.BIGINT, np.array([result], dtype=np.int64))], 1,
             ["rows"],

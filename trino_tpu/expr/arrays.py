@@ -189,23 +189,35 @@ def _derived_fn(node, lanes, ctx, fn):
 
 
 def _cardinality(node, lanes, ctx):
+    # arrays: element count; maps: entry count (entries are (k, v) pairs)
     return _table_fn(node, lanes, ctx, lambda a: len(a), np.int64)
 
 
 def _element_at(node, lanes, ctx):
-    arr_t = node.args[0].type
+    coll_t = node.args[0].type
     idx = node.args[1]
     if not isinstance(idx, ir.Constant):
-        raise NotImplementedError("element_at index must be constant")
-    i = int(idx.value)
+        raise NotImplementedError("element_at index/key must be constant")
+    if getattr(coll_t, "is_map", False):
+        key = idx.value
 
-    def pick(entry):
-        n = len(entry)
-        if i == 0 or abs(i) > n:
+        def pick(entry):
+            for k, v in entry:
+                if k == key:
+                    return v
             return None
-        return entry[i - 1] if i > 0 else entry[n + i]
 
-    et = arr_t.element
+        et = coll_t.value
+    else:
+        i = int(idx.value)
+
+        def pick(entry):
+            n = len(entry)
+            if i == 0 or abs(i) > n:
+                return None
+            return entry[i - 1] if i > 0 else entry[n + i]
+
+        et = coll_t.element
     if et.is_dictionary or getattr(et, "is_array", False):
         return _derived_fn(node, lanes, ctx, pick)
     return _table_fn(node, lanes, ctx, pick, et.np_dtype)
@@ -428,6 +440,41 @@ def _reduce(node, lanes, ctx):
     return _table_fn(node, lanes, ctx, fn, rt.np_dtype)
 
 
+# -- map functions ------------------------------------------------------
+
+
+def _map_keys(node, lanes, ctx):
+    return _derived_fn(node, lanes, ctx, lambda e: tuple(k for k, _ in e))
+
+
+def _map_values(node, lanes, ctx):
+    return _derived_fn(node, lanes, ctx, lambda e: tuple(v for _, v in e))
+
+
+def _map_entry_count(node, lanes, ctx):
+    return _table_fn(node, lanes, ctx, lambda e: len(e), np.int64)
+
+
+def _map_concat(node, lanes, ctx):
+    # map_concat(m1, m2): later keys win (reference MapConcatFunction)
+    d2 = ctx.dict_for_expr(node.args[1])
+    if d2 is None:
+        raise NotImplementedError("map_concat requires dictionary maps")
+    if len(d2) != 1:
+        raise NotImplementedError(
+            "map_concat second argument must be a constant map"
+        )
+    other = list(d2[0])
+
+    def fn(entry):
+        merged = {k: v for k, v in entry}
+        for k, v in other:
+            merged[k] = v
+        return tuple(merged.items())
+
+    return _derived_fn(node, lanes, ctx, fn)
+
+
 ARRAY_FUNCTIONS = {
     "cardinality": _cardinality,
     "element_at": _element_at,
@@ -447,4 +494,7 @@ ARRAY_FUNCTIONS = {
     "all_match": _all_match,
     "none_match": _none_match,
     "reduce": _reduce,
+    "map_keys": _map_keys,
+    "map_values": _map_values,
+    "map_concat": _map_concat,
 }

@@ -72,6 +72,15 @@ class Column:
                     out.append([decode(x) for x in d[int(v)]])
                 else:
                     out.append(None)
+        elif getattr(self.type, "is_map", False):
+            d = self.dictionary
+            dk = _element_decoder(self.type.key)
+            dv = _element_decoder(self.type.value)
+            for v, ok in zip(vals, valid):
+                if ok and int(v) >= 0:
+                    out.append({dk(k): dv(x) for k, x in d[int(v)]})
+                else:
+                    out.append(None)
         elif self.type.is_dictionary:
             d = self.dictionary
             for v, ok in zip(vals, valid):

@@ -125,6 +125,8 @@ class TableWriter(PlanNode):
     # CTAS: (column, Type) schema to create before writing
     create_schema: Optional[Tuple[Tuple[str, T.Type], ...]] = None
     if_not_exists: bool = False
+    # UPDATE: source marker column counting changed rows (reported result)
+    count_symbol: Optional[str] = None
 
     @property
     def sources(self):

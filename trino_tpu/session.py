@@ -380,6 +380,9 @@ class Session:
             if isinstance(n, P.TableWriter):
                 if n.create_schema is not None:
                     ac.check_can_create_table(identity, n.catalog, n.table)
+                elif n.count_symbol is not None:  # UPDATE rewrites rows
+                    ac.check_can_insert(identity, n.catalog, n.table)
+                    ac.check_can_delete(identity, n.catalog, n.table)
                 elif n.report_deleted:
                     ac.check_can_delete(identity, n.catalog, n.table)
                 else:

@@ -174,6 +174,8 @@ class Analyzer:
             return self._plan_ctas(stmt)
         if isinstance(stmt, ast.Delete):
             return self._plan_delete(stmt)
+        if isinstance(stmt, ast.Update):
+            return self._plan_update(stmt)
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
 
     # -- DML planning (QueryPlanner.planInsert / planDelete analogs) -----
@@ -245,6 +247,66 @@ class Analyzer:
             rp.root, catalog, table, tuple(n for n, _ in create_schema),
             create_schema=create_schema,
             if_not_exists=stmt.if_not_exists,
+        )
+        return P.Output(writer, ("rows",), ("rows",))
+
+    def _plan_update(self, stmt: ast.Update) -> P.PlanNode:
+        """UPDATE as whole-table rewrite: each column becomes
+        CASE WHEN pred THEN new_value ELSE old END, plus a marker column
+        counting changed rows (the reference routes updates through
+        MergeWriterNode; rewrite matches this engine's DELETE path)."""
+        catalog, schema = self.metadata.resolve_table(
+            stmt.table, self.default_catalog
+        )
+        known = {c.name for c in schema.columns}
+        assigned = {}
+        for col, expr in stmt.assignments:
+            if col.lower() not in known:
+                raise SemanticError(f"column {col} not in table {schema.name}")
+            if col.lower() in assigned:
+                raise SemanticError(f"column {col} assigned twice")
+            assigned[col.lower()] = expr
+        pred = stmt.where if stmt.where is not None else ast.Literal(
+            "boolean", True
+        )
+        items = []
+        for c in schema.columns:
+            old = ast.Identifier((c.name,))
+            if c.name in assigned:
+                e = ast.CaseExpr(
+                    None,
+                    (ast.WhenClause(pred, assigned[c.name]),),
+                    old,
+                )
+            else:
+                e = old
+            items.append(ast.SelectItem(e, c.name))
+        items.append(
+            ast.SelectItem(
+                ast.CaseExpr(
+                    None,
+                    (ast.WhenClause(pred, ast.Literal("integer", 1)),),
+                    ast.Literal("integer", 0),
+                ),
+                "__updated__",
+            )
+        )
+        spec = ast.QuerySpec(
+            items=tuple(items),
+            relation=ast.Table(stmt.table),
+            where=None,
+            group_by=(),
+            having=None,
+        )
+        rp, _ = self.plan_query(ast.Query(spec))
+        ttypes = [schema.column_type(c.name) for c in schema.columns]
+        ttypes.append(T.BIGINT)
+        src = self._coerced_source(rp, ttypes)
+        count_sym = src.output_symbols()[-1]
+        writer = P.TableWriter(
+            src, catalog, schema.name,
+            tuple(c.name for c in schema.columns),
+            overwrite=True, count_symbol=count_sym,
         )
         return P.Output(writer, ("rows",), ("rows",))
 
@@ -1591,6 +1653,8 @@ class ExprAnalyzer:
             return self._lambda_call(e)
         if e.name == "sequence":
             return self._sequence(e)
+        if e.name == "map":
+            return self._map_constructor(e)
         from ..expr.functions import SIGNATURES
 
         if e.name in SIGNATURES:
@@ -1679,6 +1743,32 @@ class ExprAnalyzer:
             raise SemanticError("sequence is too large (max 10000)")
         vals = tuple(range(start, stop + (1 if step > 0 else -1), step))
         return ir.Constant(T.array_of(T.BIGINT), vals)
+
+    def _map_constructor(self, e: ast.FunctionCall) -> ir.Expr:
+        """map(ARRAY[k...], ARRAY[v...]) over constants -> map Constant
+        (MapConstructor; duplicate keys rejected like the reference)."""
+        if len(e.args) == 0:
+            return ir.Constant(T.map_of(T.UNKNOWN, T.UNKNOWN), ())
+        if len(e.args) != 2:
+            raise SemanticError("map(keys_array, values_array)")
+        ka = _fold(self._an(e.args[0]))
+        va = _fold(self._an(e.args[1]))
+        for a in (ka, va):
+            if not (isinstance(a, ir.Constant)
+                    and getattr(a.type, "is_array", False)):
+                raise SemanticError(
+                    "map() requires constant array arguments in this engine"
+                )
+        if len(ka.value) != len(va.value):
+            raise SemanticError("map() key and value arrays differ in length")
+        if any(k is None for k in ka.value):
+            raise SemanticError("map keys cannot be NULL")
+        if len(set(ka.value)) != len(ka.value):
+            raise SemanticError("duplicate map keys")
+        entries = tuple(zip(ka.value, va.value))
+        return ir.Constant(
+            T.map_of(ka.type.element, va.type.element), entries
+        )
 
     def _lambda_call(self, e: ast.FunctionCall) -> ir.Expr:
         """Higher-order functions: type the lambda body with its parameter

@@ -352,6 +352,15 @@ class Insert(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Update(Node):
+    """UPDATE t SET c = expr, ... [WHERE pred]"""
+
+    table: Tuple[str, ...]
+    assignments: Tuple[Tuple[str, Node], ...]
+    where: Optional[Node] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Delete(Node):
     """DELETE FROM t [WHERE pred]"""
 

@@ -30,7 +30,7 @@ _TOKEN_RE = re.compile(
 
 KEYWORDS = {
     "select", "from", "where", "group", "by", "having", "order", "limit",
-    "create", "table", "insert", "into", "delete", "drop",
+    "create", "table", "insert", "into", "delete", "drop", "update",
     "as", "and", "or", "not", "in", "exists", "between", "like", "escape",
     "is", "null", "true", "false", "case", "when", "then", "else", "end",
     "cast", "try_cast", "extract", "join", "inner", "left", "right", "full",
@@ -260,6 +260,19 @@ class Parser:
             where = self.expr() if self.accept_kw("where") else None
             self._finish()
             return ast.Delete(name, where)
+        if self.accept_kw("update"):
+            name = self.qualified_name()
+            self.expect_kw("set")
+            assigns = []
+            while True:
+                col = self.ident()
+                self.expect_op("=")
+                assigns.append((col, self.expr()))
+                if not self.accept_op(","):
+                    break
+            where = self.expr() if self.accept_kw("where") else None
+            self._finish()
+            return ast.Update(name, tuple(assigns), where)
         if self.accept_kw("drop"):
             if self.accept_soft("function"):
                 ie = False

@@ -62,6 +62,10 @@ class Type:
     def is_array(self) -> bool:
         return False
 
+    @property
+    def is_map(self) -> bool:
+        return False
+
 
 @dataclasses.dataclass(frozen=True)
 class FixedWidthType(Type):
@@ -130,6 +134,35 @@ class ArrayType(Type):
 
 
 @dataclasses.dataclass(frozen=True)
+class MapType(Type):
+    """MAP(key, value) — dictionary-encoded like ArrayType: entries are
+    tuples of (key, value) pairs in IR-constant conventions (the
+    DictionaryBlock-over-MapBlock analog of spi/block/MapBlock.java)."""
+
+    key: "Type" = None
+    value: "Type" = None
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype("int32")
+
+    @property
+    def is_dictionary(self) -> bool:
+        return True
+
+    @property
+    def is_map(self) -> bool:
+        return True
+
+    @property
+    def orderable(self) -> bool:
+        return False
+
+    def __str__(self) -> str:
+        return f"map({self.key}, {self.value})"
+
+
+@dataclasses.dataclass(frozen=True)
 class VarcharType(Type):
     """Dictionary-encoded varchar. length is advisory (like VARCHAR(n))."""
 
@@ -166,6 +199,10 @@ def decimal(precision: int, scale: int) -> DecimalType:
 
 def array_of(element: Type) -> ArrayType:
     return ArrayType("array", element)
+
+
+def map_of(key: Type, value: Type) -> MapType:
+    return MapType("map", key, value)
 
 
 def varchar(length: Optional[int] = None) -> VarcharType:
@@ -238,6 +275,19 @@ def parse_type(s: str) -> Type:
     if s.startswith("array"):
         inner = s[s.index("(") + 1 : s.rindex(")")]
         return array_of(parse_type(inner))
+    if s.startswith("map"):
+        inner = s[s.index("(") + 1 : s.rindex(")")]
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return map_of(
+                    parse_type(inner[:i]), parse_type(inner[i + 1:])
+                )
+        raise ValueError(f"bad map type: {s}")
     if s.startswith("decimal"):
         if "(" in s:
             inner = s[s.index("(") + 1 : s.rindex(")")]
