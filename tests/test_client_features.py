@@ -1,0 +1,184 @@
+"""DB-API driver + JSON/URL/digest function tests.
+
+Reference parity: client/trino-jdbc (PEP 249 here) and
+operator/scalar/json/JsonFunctions, UrlFunctions, VarbinaryFunctions.
+"""
+import hashlib
+
+import pytest
+
+import trino_tpu.client.dbapi as dbapi
+from trino_tpu.session import Session, tpch_session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(0.001)
+
+
+def rows(s, sql):
+    return s.execute(sql).to_pylist()
+
+
+# -- DB-API -------------------------------------------------------------
+
+
+def test_dbapi_embedded(session):
+    conn = dbapi.connect(session)
+    cur = conn.cursor()
+    cur.execute("select n_name, n_regionkey from nation order by n_name limit 2")
+    assert [d[0] for d in cur.description] == ["n_name", "n_regionkey"]
+    assert cur.rowcount == 2
+    assert cur.fetchone() == ("ALGERIA", 0)
+    assert cur.fetchall() == [("ARGENTINA", 1)]
+    assert cur.fetchone() is None
+
+
+def test_dbapi_qmark_parameters(session):
+    conn = dbapi.connect(session)
+    cur = conn.cursor()
+    cur.execute(
+        "select count(*) from orders where o_totalprice > ? and "
+        "o_orderpriority = ?",
+        (100000, "1-URGENT"),
+    )
+    expected = rows(
+        session,
+        "select count(*) from orders where o_totalprice > 100000 and "
+        "o_orderpriority = '1-URGENT'",
+    )
+    assert cur.fetchall() == expected
+
+
+def test_dbapi_param_escaping(session):
+    conn = dbapi.connect(session)
+    cur = conn.cursor()
+    cur.execute("select ?", ("it's",))
+    assert cur.fetchall() == [("it's",)]
+    # ? inside a string literal is not a parameter
+    cur.execute("select '?'")
+    assert cur.fetchall() == [("?",)]
+
+
+def test_dbapi_param_count_errors(session):
+    cur = dbapi.connect(session).cursor()
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select ?", ())
+    with pytest.raises(dbapi.ProgrammingError):
+        cur.execute("select 1", (5,))
+
+
+def test_dbapi_iteration_and_many(session):
+    conn = dbapi.connect(session)
+    cur = conn.cursor()
+    cur.execute("select n_nationkey from nation order by 1 limit 3")
+    assert list(cur) == [(0,), (1,), (2,)]
+    cur.execute("select n_nationkey from nation order by 1 limit 5")
+    assert cur.fetchmany(2) == [(0,), (1,)]
+    assert cur.fetchmany(2) == [(2,), (3,)]
+
+
+def test_dbapi_over_http():
+    from trino_tpu.server.coordinator import CoordinatorServer
+
+    server = CoordinatorServer(tpch_session(0.001)).start()
+    try:
+        conn = dbapi.connect(server.uri, user="http-user")
+        cur = conn.cursor()
+        cur.execute("select count(*) from nation")
+        assert cur.fetchall() == [(25,)]
+    finally:
+        server.stop()
+
+
+def test_dbapi_errors_and_close(session):
+    conn = dbapi.connect(session)
+    cur = conn.cursor()
+    with pytest.raises(dbapi.DatabaseError):
+        cur.execute("select bogus from nowhere")
+    conn.close()
+    with pytest.raises(dbapi.InterfaceError):
+        conn.cursor()
+
+
+def test_dbapi_dml_roundtrip():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    conn = dbapi.connect(s)
+    cur = conn.cursor()
+    cur.execute("create table t (a bigint, b varchar)")
+    cur.executemany("insert into t values (?, ?)", [(1, "x"), (2, "y")])
+    cur.execute("select * from t order by a")
+    assert cur.fetchall() == [(1, "x"), (2, "y")]
+
+
+# -- JSON functions -----------------------------------------------------
+
+
+def test_json_extract_scalar():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table j (doc varchar)")
+    s.execute(
+        'insert into j values (\'{"a": {"b": 7}, "arr": [1, 2, 3]}\'), '
+        "('not json'), (null)"
+    )
+    out = rows(s, "select json_extract_scalar(doc, '$.a.b') from j")
+    assert out == [("7",), (None,), (None,)]
+    out = rows(s, "select json_extract(doc, '$.arr') from j")
+    assert out == [("[1,2,3]",), (None,), (None,)]
+    out = rows(s, "select json_size(doc, '$.a') from j")
+    assert out == [(1,), (None,), (None,)]
+
+
+def test_json_array_functions(session):
+    assert rows(
+        session,
+        "select json_array_length('[1,2,3]'), "
+        "json_array_contains('[1,2,3]', 2), "
+        "json_array_contains('[\"a\"]', 'a'), "
+        "json_format('{\"b\": 1,  \"a\": 2}')",
+    ) == [(3, True, True, '{"b":1,"a":2}')]
+
+
+# -- URL functions ------------------------------------------------------
+
+
+def test_url_functions(session):
+    url = "'https://example.com:8080/p/a?q=1&r=two#frag'"
+    assert rows(
+        session,
+        f"select url_extract_host({url}), url_extract_path({url}), "
+        f"url_extract_port({url}), url_extract_protocol({url}), "
+        f"url_extract_parameter({url}, 'r')",
+    ) == [("example.com", "/p/a", 8080, "https", "two")]
+    assert rows(
+        session, "select url_encode('a b&c'), url_decode('a%20b%26c')"
+    ) == [("a%20b%26c", "a b&c")]
+
+
+# -- digests ------------------------------------------------------------
+
+
+def test_digest_functions(session):
+    out = rows(
+        session,
+        "select md5(n_name), sha256(n_name) from nation where n_nationkey = 0",
+    )
+    assert out == [(
+        hashlib.md5(b"ALGERIA").hexdigest(),
+        hashlib.sha256(b"ALGERIA").hexdigest(),
+    )]
+    assert rows(
+        session,
+        "select to_base64('hi'), from_base64('aGk='), to_hex('hi'), "
+        "crc32('hi')",
+    ) == [("aGk=", "hi", "6869".upper(), 3633523372)]
+
+
+def test_levenshtein(session):
+    assert rows(
+        session,
+        "select levenshtein_distance(n_name, 'ALGERIA') from nation "
+        "where n_nationkey in (0, 1) order by 1",
+    ) == [(0,), (4,)]

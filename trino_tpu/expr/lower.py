@@ -421,6 +421,20 @@ def _cast_varchar_parse(node: ir.Cast, v, ok, ctx: LoweringContext) -> Lane:
     return res, ok & okt
 
 
+# functions whose FIRST argument is consumed through its dictionary
+# (dict_for_expr); a constant string argument must still get a lane +
+# single-entry dictionary
+DICT_INPUT_FNS = frozenset({
+    "split", "json_extract_scalar", "json_extract", "json_array_length",
+    "json_size", "json_array_contains", "json_format",
+    "url_extract_host", "url_extract_path", "url_extract_query",
+    "url_extract_protocol", "url_extract_fragment", "url_extract_port",
+    "url_extract_parameter", "url_encode", "url_decode",
+    "md5", "sha1", "sha256", "sha512", "crc32",
+    "to_base64", "from_base64", "to_hex", "levenshtein_distance",
+})
+
+
 def _lower_call(node: ir.Call, cols, ev, ctx: LoweringContext) -> Lane:
     fn = FUNCTIONS.get(node.name)
     if fn is None:
@@ -434,7 +448,7 @@ def _lower_call(node: ir.Call, cols, ev, ctx: LoweringContext) -> Lane:
         if isinstance(a, ir.Lambda):
             lanes.append(None)
         elif (isinstance(a, ir.Constant) and isinstance(a.value, str)
-                and not (i == 0 and node.name in ("split",))):
+                and not (i == 0 and node.name in DICT_INPUT_FNS)):
             lanes.append(None)
         else:
             lanes.append(ev(a, cols))
