@@ -84,6 +84,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "jit_fragments",
+            "compile each fragment into one cached XLA program "
+            "(off: eager op-by-op, used by EXPLAIN ANALYZE)",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "dynamic_filtering",
             "prune probe-side scans with build-side join domains",
             _bool, True,

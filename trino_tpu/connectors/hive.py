@@ -278,6 +278,8 @@ class HivePageSourceProvider(PageSourceProvider):
 
 
 class HiveConnector(Connector):
+    cacheable = False  # backing files may change on disk
+
     def __init__(self, name: str, warehouse: str):
         self.name = name
         self.warehouse = warehouse

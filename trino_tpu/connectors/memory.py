@@ -35,6 +35,7 @@ class _Store:
     def __init__(self):
         self.tables: Dict[str, Page] = {}
         self.schemas: Dict[str, TableSchema] = {}
+        self.version = 0  # bumped on every write (scan-cache invalidation)
 
 
 class MemoryMetadata(ConnectorMetadata):
@@ -55,6 +56,7 @@ class MemoryMetadata(ConnectorMetadata):
     def create_table(self, schema: TableSchema) -> None:
         if schema.name in self.store.tables:
             raise ValueError(f"table {schema.name} already exists")
+        self.store.version += 1
         cols = [column_from_pylist(c.type, []) for c in schema.columns]
         self.store.tables[schema.name] = Page(
             cols, 0, [c.name for c in schema.columns]
@@ -64,6 +66,7 @@ class MemoryMetadata(ConnectorMetadata):
     def drop_table(self, table: str) -> None:
         if table not in self.store.tables:
             raise KeyError(f"table {table} does not exist")
+        self.store.version += 1
         del self.store.tables[table]
         del self.store.schemas[table]
 
@@ -146,6 +149,7 @@ class MemoryPageSink(PageSink):
             cols, len(data[schema.columns[0].name]),
             [c.name for c in schema.columns],
         )
+        self.store.version += 1
         return self.rows
 
 
@@ -164,8 +168,12 @@ class MemoryConnector(Connector):
         self.name = name
         self.store = _Store()
 
+    def data_version(self) -> int:
+        return self.store.version
+
     def create_table(self, name: str, schema, data: dict):
         """schema: list of (col, Type); data: col -> python values."""
+        self.store.version += 1
         cols = [column_from_pylist(t, data[c]) for c, t in schema]
         counts = {len(c) for c in cols}
         assert len(counts) == 1

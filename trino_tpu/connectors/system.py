@@ -195,6 +195,8 @@ class SystemPageSourceProvider(PageSourceProvider):
 
 
 class SystemConnector(Connector):
+    cacheable = False  # live engine state changes between queries
+
     def __init__(self, name: str, session):
         self.name = name
         self.source = _SystemSource(session)

@@ -43,6 +43,32 @@ from ..spi import Split
 DEFAULT_GROUP_CAPACITY = 4096
 
 
+class DeviceScanCache:
+    """Cross-query scan cache: host merged arrays + padded device lanes.
+
+    The reference streams pages from disk/page-cache every query; here the
+    analog of a warm OS page cache is warm HBM — repeated scans of an
+    unchanged (connector-versioned) table reuse uploaded device arrays,
+    which matters doubly when the accelerator sits behind a network tunnel.
+    Entries evict in insertion order once the byte budget is exceeded."""
+
+    def __init__(self, max_bytes: int = 6 << 30):
+        self.max_bytes = max_bytes
+        self.entries: Dict[tuple, dict] = {}
+        self.bytes = 0
+
+    def get(self, key: tuple):
+        return self.entries.get(key)
+
+    def put(self, key: tuple, entry: dict, nbytes: int):
+        while self.bytes + nbytes > self.max_bytes and self.entries:
+            _, old = self.entries.popitem()
+            self.bytes -= old.get("nbytes", 0)
+        entry["nbytes"] = nbytes
+        self.entries[key] = entry
+        self.bytes += nbytes
+
+
 class ExecutionError(RuntimeError):
     pass
 
@@ -53,6 +79,12 @@ class Batch:
     sel: jnp.ndarray
     ordered: bool = False  # rows already compacted+ordered (sort output)
     replicated: bool = False  # identical on every mesh device (mesh exec)
+
+
+def _contains(plan: P.PlanNode, node_type) -> bool:
+    if isinstance(plan, node_type):
+        return True
+    return any(_contains(s, node_type) for s in plan.sources)
 
 
 def _pad_capacity(n: int) -> int:
@@ -156,6 +188,9 @@ class LocalExecutor:
         # EXPLAIN ANALYZE: id(plan node) -> {rows, wall_s, calls}
         # (OperatorStats analog, filled when collect_node_stats is set)
         self.node_stats: Dict[int, dict] = {}
+        # scan-node id -> DeviceScanCache key (None when uncacheable)
+        self._scan_keys: Dict[int, tuple] = {}
+        self._scan_nodes: Dict[int, P.TableScan] = {}
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
@@ -186,10 +221,21 @@ class LocalExecutor:
             )
             self.join_factor = 1
 
+            use_jit = (
+                self.config.get("jit_fragments")
+                and not self.config.get("collect_node_stats")
+                and not _contains(plan, P.Unnest)
+            )
             for attempt in range(5):
-                ctx = self.trace_ctx_cls(self, scans, counts)
-                out_lanes, sel, ordered, checks = self._run(plan, ctx)
-                for join_node, dup in ctx.dup_checks:
+                if use_jit:
+                    out_lanes, sel, ordered, checks, dups = self._run_jitted(
+                        plan, scans, counts
+                    )
+                else:
+                    ctx = self.trace_ctx_cls(self, scans, counts)
+                    out_lanes, sel, ordered, checks = self._run(plan, ctx)
+                    dups = ctx.dup_checks
+                for join_node, dup in dups:
                     if int(dup) > 0:
                         raise ExecutionError(
                             "join build side has duplicate keys (many-to-many "
@@ -294,15 +340,48 @@ class LocalExecutor:
         if pool is not None:
             pool.reserve(self.query_id, total)  # freed after materialize
 
+    def _scan_cache_key(self, node: P.TableScan, splits):
+        conn = self.catalogs.get(node.catalog)
+        if not getattr(conn, "cacheable", False):
+            return None
+        return (
+            node.catalog,
+            node.table,
+            tuple(c for _, c in node.assignments),
+            node.constraint,
+            tuple(repr(sp) for sp in splits),
+            conn.data_version(),
+        )
+
     def _load_one_scan(self, node: P.TableScan, splits, scans, dicts, counts):
         """Load the given splits of one scan into host arrays (shared by
         local execution — all splits — and per-task fragment execution —
         the assigned subset, SqlTaskExecution.addSplitAssignments:256).
         Per-split string dictionaries are merged with codes remapped, so
         connectors may emit divergent dictionaries across splits (e.g.
-        parquet row-group dictionaries)."""
+        parquet row-group dictionaries).  Results are cached across queries
+        when the connector is versioned-cacheable (DeviceScanCache)."""
+        cache: Optional[DeviceScanCache] = self.config.get("scan_cache")
+        key = self._scan_cache_key(node, splits)
+        if cache is not None and key is not None:
+            hit = cache.get(key)
+            if hit is not None:
+                # re-bind cached arrays to this plan's symbols
+                sym_of = {c: self._sym_for(node, c)
+                          for _, c in node.assignments}
+                merged = {}
+                for col, lane in hit["merged"].items():
+                    merged[sym_of[col]] = lane
+                for col, d in hit["dicts"].items():
+                    dicts[sym_of[col]] = d
+                scans[id(node)] = merged
+                counts[id(node)] = hit["total"]
+                self._scan_keys[id(node)] = key
+                self._scan_nodes[id(node)] = node
+                return
         conn = self.catalogs.get(node.catalog)
         cols = [c for _, c in node.assignments]
+        self._scan_nodes[id(node)] = node
         provider = conn.page_source_provider()
         tmap = dict(node.types)
         sym_of = {c: self._sym_for(node, c) for c in cols}
@@ -335,6 +414,58 @@ class LocalExecutor:
                 dicts[s] = np.array([], dtype=object)
         scans[id(node)] = merged
         counts[id(node)] = total
+        self._scan_keys[id(node)] = key
+        if cache is not None and key is not None:
+            col_of = {s: c for s, c in node.assignments}
+            host_merged = {col_of[s]: lane for s, lane in merged.items()}
+            host_dicts = {
+                col_of[s]: dicts[s] for s, _ in node.assignments
+                if s in dicts
+            }
+            nbytes = sum(
+                int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
+                for v, ok in merged.values()
+            )
+            cache.put(
+                key,
+                {"merged": host_merged, "dicts": host_dicts, "total": total,
+                 "dev": {}},
+                nbytes,
+            )
+
+    def _device_lanes(self, node: P.TableScan, arrays, count):
+        """Pad + upload one scan's host arrays to device lanes, reusing
+        cached device arrays when the scan is version-cacheable (the
+        host->HBM transfer dominates when the TPU is tunnel-attached)."""
+        cap = _pad_capacity(count)
+        cache: Optional[DeviceScanCache] = self.config.get("scan_cache")
+        key = self._scan_keys.get(id(node)) if node is not None else None
+        entry = cache.get(key) if (cache is not None and key) else None
+        # RemoteSource (exchange input) reuses this load path but has no
+        # column mapping and never caches (key is None for it)
+        sym_to_col = {
+            s: c for s, c in getattr(node, "assignments", None) or ()
+        }
+        lanes = {}
+        for sym, (arr, valid) in arrays.items():
+            col = sym_to_col.get(sym, sym)
+            if entry is not None and col in entry["dev"]:
+                lanes[sym] = entry["dev"][col]
+                continue
+            if arr.shape[0] < cap:
+                pad = np.zeros(cap - arr.shape[0], dtype=arr.dtype)
+                arr = np.concatenate([arr, pad])
+            v = jnp.asarray(arr)
+            if valid is None:
+                ok = jnp.ones(cap, dtype=bool)
+            else:
+                vv = np.zeros(cap, dtype=bool)
+                vv[: valid.shape[0]] = valid
+                ok = jnp.asarray(vv)
+            lanes[sym] = (v, ok)
+            if entry is not None:
+                entry["dev"][col] = (v, ok)
+        return lanes
 
     @staticmethod
     def _sym_for(scan: P.TableScan, col: str) -> str:
@@ -342,6 +473,58 @@ class LocalExecutor:
             if c == col:
                 return s
         raise KeyError(col)
+
+    # ------------------------------------------------------------------
+    def _run_jitted(self, plan: P.Output, scans, counts):
+        """One jitted XLA program per fragment (the architecture's codegen
+        slot: LocalExecutionPlanner -> generated bytecode in the reference,
+        -> one traced+compiled jax function here).  The compiled callable is
+        cached per (plan, shapes, capacities) in the session-owned jit
+        cache; eager mode remains for EXPLAIN ANALYZE and host-staged
+        operators (UNNEST)."""
+        cache = self.config.get("jit_cache")
+        if cache is None:
+            cache = {}
+        prep = {
+            nid: self._device_lanes(self._scan_nodes.get(nid), arrays,
+                                    counts[nid])
+            for nid, arrays in scans.items()
+        }
+        key = (
+            id(plan), self.group_capacity, self.join_factor,
+            tuple(sorted((nid, counts[nid]) for nid in scans)),
+        )
+        entry = cache.get(key)
+        if entry is None:
+            cell: Dict[str, object] = {}
+
+            def raw(prep_arg):
+                ctx = self.trace_ctx_cls(self, prep_arg, counts)
+                ctx.prepared = True
+                out_lanes, sel, ordered, checks = self._run(plan, ctx)
+                cell["ordered"] = ordered
+                cell["caps"] = [c for _, c in checks]
+                cell["dup_nodes"] = [n for n, _ in ctx.dup_checks]
+                return (
+                    out_lanes,
+                    sel,
+                    tuple(ng for ng, _ in checks),
+                    tuple(d for _, d in ctx.dup_checks),
+                )
+
+            fn = jax.jit(raw)
+            out = fn(prep)
+            cell["dicts"] = dict(self.dicts)
+            entry = {"fn": fn, "cell": cell, "plan": plan}
+            cache[key] = entry
+        else:
+            cell = entry["cell"]
+            self.dicts.update(cell["dicts"])
+            out = entry["fn"](prep)
+        out_lanes, sel, ngroups, dup_vals = out
+        checks = list(zip(ngroups, cell["caps"]))
+        dups = list(zip(cell["dup_nodes"], dup_vals))
+        return out_lanes, sel, cell["ordered"], checks, dups
 
     # ------------------------------------------------------------------
     def _run(self, plan: P.Output, ctx: "_TraceCtx"):
@@ -406,22 +589,13 @@ class _TraceCtx:
 
     # -- leaves ---------------------------------------------------------
     def _visit_tablescan(self, node: P.TableScan) -> Batch:
-        arrays = self.scans[id(node)]
         count = self.counts[id(node)]
         cap = _pad_capacity(count)
-        lanes = {}
-        for sym, (arr, valid) in arrays.items():
-            if arr.shape[0] < cap:
-                pad = np.zeros(cap - arr.shape[0], dtype=arr.dtype)
-                arr = np.concatenate([arr, pad])
-            v = jnp.asarray(arr)
-            if valid is None:
-                ok = jnp.ones(cap, dtype=bool)
-            else:
-                vv = np.zeros(cap, dtype=bool)
-                vv[: valid.shape[0]] = valid
-                ok = jnp.asarray(vv)
-            lanes[sym] = (v, ok)
+        if getattr(self, "prepared", False):
+            # jitted-fragment mode: lanes are traced jit arguments
+            lanes = dict(self.scans[id(node)])
+        else:
+            lanes = self.ex._device_lanes(node, self.scans[id(node)], count)
         sel = jnp.arange(cap) < count
         return Batch(lanes, sel)
 

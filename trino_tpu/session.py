@@ -82,6 +82,15 @@ class Session:
 
         self.catalogs.register_factory(SystemConnectorFactory())
         self.catalogs.create_catalog("system", "system", {"session": self})
+        # cross-query scan cache (warm-HBM reuse; exec/local.DeviceScanCache)
+        from .exec.local import DeviceScanCache
+
+        self._scan_cache = DeviceScanCache()
+        # compiled-fragment cache + plan cache (keyed by SQL text): repeat
+        # queries reuse the optimized plan object, whose identity keys the
+        # jitted XLA executable (one program per fragment)
+        self._jit_cache: dict = {}
+        self._plan_cache: dict = {}
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
@@ -100,7 +109,12 @@ class Session:
             ),
             "spill_enabled": self.properties.get("spill_enabled"),
             "memory_pool": self.memory_pool,
+            "scan_cache": self._scan_cache,
         }
+        exec_config["jit_fragments"] = bool(
+            self.properties.get("jit_fragments")
+        )
+        exec_config["jit_cache"] = self._jit_cache
         if self.properties.get("distributed"):
             from .parallel.mesh_executor import MeshExecutor, default_mesh
 
@@ -330,7 +344,21 @@ class Session:
             md.drop_table(table)
             return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
 
-        plan = self._plan_stmt(stmt)
+        if isinstance(stmt, ast.Query):
+            cached = self._plan_cache.get(sql)
+            if cached is None:
+                cached = self._plan_stmt(stmt)
+                self._plan_cache[sql] = cached
+                del_keys = list(self._plan_cache)[:-256]
+                for k in del_keys:  # bound the cache
+                    self._plan_cache.pop(k, None)
+            plan = cached
+        else:
+            # writes/DDL may change data or functions: planning state and
+            # compiled fragments are stale
+            self._plan_cache.clear()
+            self._jit_cache.clear()
+            plan = self._plan_stmt(stmt)
         self._check_plan_access(plan, identity)
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):

@@ -145,6 +145,14 @@ class Connector:
     """One mounted catalog (spi/connector/Connector)."""
 
     name: str
+    # deterministic sources (generators, immutable tables) may be cached
+    # across queries; mutable/live sources must set this False or bump
+    # data_version() on every change
+    cacheable: bool = True
+
+    def data_version(self) -> int:
+        """Monotonic change counter for cache invalidation."""
+        return 0
 
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError
