@@ -106,3 +106,28 @@ def test_distributed_session_property():
     s.execute("set session distributed = true")
     dist = s.execute("select count(*) from orders").to_pylist()
     assert dist == local
+
+
+def test_show_stats():
+    s = tpch_session(0.001)
+    out = s.execute("show stats for orders").to_pylist()
+    # summary row carries the table row count
+    assert out[-1][0] is None and out[-1][3] > 0
+    assert any(r[0] == "o_orderkey" for r in out)
+
+
+def test_show_create_table():
+    s = tpch_session(0.001)
+    ddl = s.execute("show create table nation").to_pylist()[0][0]
+    assert "CREATE TABLE" in ddl and "n_nationkey bigint" in ddl
+
+
+def test_datetime_constants():
+    s = tpch_session(0.001)
+    (d, y, ok1, ok2), = s.execute(
+        "select current_date, year(current_date), "
+        "to_unixtime(current_timestamp) > 1700000000, "
+        "now() > timestamp '2020-01-01'"
+    ).to_pylist()
+    assert y >= 2024 and ok1 and ok2
+    assert s.execute("select from_unixtime(0)").to_pylist() == [(0,)]

@@ -252,6 +252,56 @@ class Session:
                 [("function", T.VARCHAR), ("kind", T.VARCHAR)],
                 {"function": names, "kind": kinds},
             )
+        if isinstance(stmt, ast.ShowStats):
+            catalog, schema = self.metadata.resolve_table(
+                stmt.table, self.default_catalog
+            )
+            stats = self.metadata.table_statistics(catalog, schema.name)
+            names, dvs, nfs, lows, highs = [], [], [], [], []
+            for c in schema.columns:
+                cs = stats.columns.get(c.name)
+                names.append(c.name)
+                dvs.append(None if cs is None else cs.distinct_count)
+                nfs.append(None if cs is None else cs.null_fraction)
+                lows.append(
+                    None if cs is None or cs.min_value is None
+                    else str(cs.min_value)
+                )
+                highs.append(
+                    None if cs is None or cs.max_value is None
+                    else str(cs.max_value)
+                )
+            # summary row (the reference's NULL-column row_count row)
+            names.append(None)
+            dvs.append(None)
+            nfs.append(None)
+            lows.append(None)
+            highs.append(None)
+            rc = [None] * len(schema.columns) + [float(stats.row_count)]
+            return page_from_pydict(
+                [("column_name", T.VARCHAR),
+                 ("distinct_values_count", T.DOUBLE),
+                 ("nulls_fraction", T.DOUBLE),
+                 ("row_count", T.DOUBLE),
+                 ("low_value", T.VARCHAR),
+                 ("high_value", T.VARCHAR)],
+                {"column_name": names, "distinct_values_count": dvs,
+                 "nulls_fraction": nfs, "row_count": rc,
+                 "low_value": lows, "high_value": highs},
+            )
+        if isinstance(stmt, ast.ShowCreateTable):
+            catalog, schema = self.metadata.resolve_table(
+                stmt.table, self.default_catalog
+            )
+            cols = ",\n   ".join(
+                f"{c.name} {c.type}" for c in schema.columns
+            )
+            ddl = (
+                f"CREATE TABLE {catalog}.{schema.name} (\n   {cols}\n)"
+            )
+            return page_from_pydict(
+                [("create_table", T.VARCHAR)], {"create_table": [ddl]}
+            )
         if isinstance(stmt, ast.ShowCatalogs):
             return page_from_pydict(
                 [("catalog", T.VARCHAR)],

@@ -1655,6 +1655,16 @@ class ExprAnalyzer:
             return self._sequence(e)
         if e.name == "map":
             return self._map_constructor(e)
+        if e.name in ("current_date", "current_timestamp", "now",
+                      "localtimestamp"):
+            # evaluated once per query at analysis (reference: constant per
+            # query via Session start time)
+            now = datetime.datetime.now(datetime.timezone.utc)
+            if e.name == "current_date":
+                d = now.date()
+                return ir.Constant(T.DATE, days_from_civil(d.year, d.month, d.day))
+            us = int(now.timestamp() * 1_000_000)
+            return ir.Constant(T.TIMESTAMP, us)
         from ..expr.functions import SIGNATURES
 
         if e.name in SIGNATURES:

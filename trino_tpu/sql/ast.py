@@ -441,6 +441,18 @@ class ShowCatalogs(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowStats(Node):
+    """SHOW STATS FOR table"""
+
+    table: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCreateTable(Node):
+    table: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Explain(Node):
     query: Query
     analyze: bool = False

@@ -160,6 +160,16 @@ class Parser:
             if self.accept_soft("catalogs"):
                 self._finish()
                 return ast.ShowCatalogs()
+            if self.accept_soft("stats"):
+                self.expect_kw("for")
+                name = self.qualified_name()
+                self._finish()
+                return ast.ShowStats(name)
+            if self.accept_kw("create"):
+                self.expect_kw("table")
+                name = self.qualified_name()
+                self._finish()
+                return ast.ShowCreateTable(name)
             if self.accept_kw("columns"):
                 self.expect_kw("from")
                 name = self.qualified_name()
@@ -805,6 +815,11 @@ class Parser:
                         and self.accept_op("->")):
                     return ast.Lambda(tuple(params), self.expr())
             self.i = save
+        if t.kind == "ident" and t.text.lower() in (
+            "current_date", "current_timestamp", "localtimestamp",
+        ) and not (self.peek(1).kind == "op" and self.peek(1).text == "("):
+            self.next()
+            return ast.FunctionCall(t.text.lower(), ())
         if (t.kind == "ident" and t.text.lower() == "array"
                 and self.peek(1).kind == "op" and self.peek(1).text == "["):
             self.next()
