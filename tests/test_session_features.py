@@ -131,3 +131,41 @@ def test_datetime_constants():
     ).to_pylist()
     assert y >= 2024 and ok1 and ok2
     assert s.execute("select from_unixtime(0)").to_pylist() == [(0,)]
+
+
+def test_use_statement():
+    s = Session()
+    s.create_catalog("tpch", "tpch", {"tpch.scale-factor": 0.001})
+    s.create_catalog("memory", "memory", {})
+    s.execute("use memory")
+    s.execute("create table t (a bigint)")
+    assert s.execute("show tables").to_pylist() == [("t",)]
+    s.execute("use tpch")
+    assert s.execute("select count(*) from nation").to_pylist() == [(25,)]
+    with pytest.raises(KeyError):
+        s.execute("use nope")
+
+
+def test_tablesample():
+    s = tpch_session(0.01)
+    total = s.execute("select count(*) from orders").to_pylist()[0][0]
+    n = s.execute(
+        "select count(*) from orders tablesample bernoulli (10)"
+    ).to_pylist()[0][0]
+    assert 0 < n < total
+    # deterministic: same sample on re-execution
+    n2 = s.execute(
+        "select count(*) from orders tablesample bernoulli (10)"
+    ).to_pylist()[0][0]
+    assert n == n2
+    assert s.execute(
+        "select count(*) from orders tablesample system (100)"
+    ).to_pylist() == [(total,)]
+
+
+def test_transaction_control():
+    s = tpch_session(0.001)
+    assert s.execute("start transaction").to_pylist() == [(True,)]
+    assert s.execute("commit").to_pylist() == [(True,)]
+    with pytest.raises(ValueError):
+        s.execute("rollback")

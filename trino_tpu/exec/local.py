@@ -619,6 +619,18 @@ class _TraceCtx:
         return Batch(lanes, sel)
 
     # -- unary ----------------------------------------------------------
+    def _visit_sample(self, node: P.Sample) -> Batch:
+        b = self.visit(node.source)
+        n = b.sel.shape[0]
+        # deterministic splitmix64 of the row index -> uniform [0, 1)
+        z = jnp.arange(n, dtype=jnp.uint64) + jnp.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> 30)) * jnp.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> 27)) * jnp.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> 31)
+        u = (z >> 11).astype(jnp.float64) / float(1 << 53)
+        keep = u < node.fraction
+        return Batch(b.lanes, b.sel & keep, b.ordered, b.replicated)
+
     def _visit_filter(self, node: P.Filter) -> Batch:
         b = self.visit(node.source)
         f = compile_expr(node.predicate, self.lowering)

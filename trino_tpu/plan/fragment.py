@@ -211,6 +211,10 @@ class Fragmenter:
             keys = ()
         return dataclasses.replace(node, source=src), part, keys
 
+    def _do_sample(self, node: P.Sample):
+        src, part, keys = self._rewrite(node.source)
+        return dataclasses.replace(node, source=src), part, keys
+
     def _do_groupid(self, node: P.GroupId):
         # row expansion is local to each task; gid joins the hash keys of
         # the aggregation above, so partitioning is unchanged here

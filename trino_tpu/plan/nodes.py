@@ -140,6 +140,25 @@ class TableWriter(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class Sample(PlanNode):
+    """TABLESAMPLE: keep ~fraction of rows (SampleNode; both BERNOULLI and
+    SYSTEM execute as deterministic per-row bernoulli here)."""
+
+    source: PlanNode
+    fraction: float
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return self.source.output_symbols()
+
+    def output_types(self):
+        return self.source.output_types()
+
+
+@dataclasses.dataclass(frozen=True)
 class Filter(PlanNode):
     source: PlanNode
     predicate: ir.Expr

@@ -163,7 +163,8 @@ def _rewrite_sources(node: P.PlanNode, new_sources: Tuple[P.PlanNode, ...]):
 
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
                          P.Limit, P.Distinct, P.Output, P.Exchange,
-                         P.Window, P.GroupId, P.TableWriter, P.Unnest)):
+                         P.Window, P.GroupId, P.TableWriter, P.Unnest,
+                         P.Sample)):
         return dataclasses.replace(node, source=new_sources[0])
     if isinstance(node, P.Join):
         return dataclasses.replace(node, left=new_sources[0], right=new_sources[1])

@@ -252,6 +252,18 @@ class Session:
                 [("function", T.VARCHAR), ("kind", T.VARCHAR)],
                 {"function": names, "kind": kinds},
             )
+        if isinstance(stmt, ast.Use):
+            catalog = stmt.name[0]
+            self.catalogs.get(catalog)  # raises if unknown
+            self.default_catalog = catalog
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.TransactionControl):
+            if stmt.kind == "rollback":
+                raise ValueError(
+                    "ROLLBACK is not supported: statements auto-commit "
+                    "(one transaction per query)"
+                )
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
         if isinstance(stmt, ast.ShowStats):
             catalog, schema = self.metadata.resolve_table(
                 stmt.table, self.default_catalog

@@ -1261,7 +1261,14 @@ class Analyzer:
             assigns.append((sym, c.name))
             types_.append((sym, c.type))
             fields.append(Field(qual, c.name.lower(), sym, c.type))
-        node = P.TableScan(catalog, schema.name, tuple(assigns), tuple(types_))
+        node: P.PlanNode = P.TableScan(
+            catalog, schema.name, tuple(assigns), tuple(types_)
+        )
+        if t.sample is not None:
+            _, pct = t.sample
+            if not (0.0 <= pct <= 100.0):
+                raise SemanticError("TABLESAMPLE percentage must be in [0, 100]")
+            node = P.Sample(node, pct / 100.0)
         return RelationPlan(node, Scope(fields))
 
     def _plan_join(self, j: ast.Join) -> RelationPlan:

@@ -216,6 +216,9 @@ class Star(Node):
 class Table(Node):
     name: Tuple[str, ...]  # (catalog, schema, table) suffix-qualified
     alias: Optional[str] = None
+    # TABLESAMPLE (method, percentage); engine treats both methods as
+    # BERNOULLI row sampling
+    sample: Optional[Tuple[str, float]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -438,6 +441,22 @@ class ShowFunctions(Node):
 @dataclasses.dataclass(frozen=True)
 class ShowCatalogs(Node):
     pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Use(Node):
+    """USE catalog | USE catalog.schema"""
+
+    name: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransactionControl(Node):
+    """START TRANSACTION | COMMIT | ROLLBACK (autocommit engine: START
+    and COMMIT are accepted no-ops, ROLLBACK errors — reference
+    transaction/TransactionManager runs one transaction per query)."""
+
+    kind: str  # start | commit | rollback
 
 
 @dataclasses.dataclass(frozen=True)

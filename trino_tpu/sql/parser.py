@@ -192,6 +192,23 @@ class Parser:
                 raise ParseError(f"bad SET SESSION value {t!r}")
             self._finish()
             return ast.SetSession(name, value)
+        if self.accept_soft("use"):
+            name = self.qualified_name()
+            self._finish()
+            return ast.Use(name)
+        if self.accept_soft("start"):
+            if not self.accept_soft("transaction"):
+                raise ParseError("expected TRANSACTION after START")
+            self._finish()
+            return ast.TransactionControl("start")
+        if self.accept_soft("commit"):
+            self.accept_soft("work")
+            self._finish()
+            return ast.TransactionControl("commit")
+        if self.accept_soft("rollback"):
+            self.accept_soft("work")
+            self._finish()
+            return ast.TransactionControl("rollback")
         if self.accept_soft("prepare"):
             name = self.ident()
             self.expect_kw("from")
@@ -654,12 +671,26 @@ class Parser:
             self.expect_op(")")
             return rel
         name = self.qualified_name()
+        sample = None
+        if self.accept_soft("tablesample"):
+            t2 = self.next()
+            if t2.kind != "ident" or t2.text.lower() not in (
+                "bernoulli", "system",
+            ):
+                raise ParseError("TABLESAMPLE BERNOULLI|SYSTEM (p)")
+            method = t2.text.lower()
+            self.expect_op("(")
+            pct = self.next()
+            if pct.kind != "number":
+                raise ParseError("TABLESAMPLE percentage must be a number")
+            self.expect_op(")")
+            sample = (method, float(pct.text))
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()
         elif self.peek().kind == "ident":
             alias = self.next().text
-        return ast.Table(name, alias)
+        return ast.Table(name, alias, sample)
 
     def qualified_name(self) -> Tuple[str, ...]:
         parts = [self.ident()]
