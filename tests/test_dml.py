@@ -197,3 +197,68 @@ def test_update_unknown_column_rejected(session):
     rows(session, "create table t (a bigint)")
     with pytest.raises(SemanticError):
         session.execute("update t set nope = 1")
+
+
+# -- MERGE --------------------------------------------------------------
+
+
+def _merge_fixture(session):
+    rows(session, "create table tgt (k bigint, v varchar)")
+    rows(session, "insert into tgt values (1,'a'), (2,'b'), (3,'c')")
+    rows(session, "create table src (k bigint, v varchar)")
+    rows(session, "insert into src values (2,'B'), (3, null), (4,'D')")
+
+
+def test_merge_update_delete_insert(session):
+    _merge_fixture(session)
+    out = rows(session, """merge into tgt t using src s on t.k = s.k
+        when matched and s.v is null then delete
+        when matched then update set v = s.v
+        when not matched then insert values (s.k, s.v)""")
+    assert out == [(3,)]  # 1 update + 1 delete + 1 insert
+    assert rows(session, "select * from tgt order by k") == [
+        (1, "a"), (2, "B"), (4, "D"),
+    ]
+
+
+def test_merge_update_only(session):
+    _merge_fixture(session)
+    assert rows(session, """merge into tgt t using src s on t.k = s.k
+        when matched then update set v = upper(s.v)""") == [(2,)]
+    # k=3 matched but s.v NULL -> upper(NULL) = NULL assigned
+    assert rows(session, "select * from tgt order by k") == [
+        (1, "a"), (2, "B"), (3, None),
+    ]
+
+
+def test_merge_insert_only(session):
+    _merge_fixture(session)
+    assert rows(session, """merge into tgt t using src s on t.k = s.k
+        when not matched then insert (k) values (s.k)""") == [(1,)]
+    assert rows(session, "select * from tgt order by k") == [
+        (1, "a"), (2, "b"), (3, "c"), (4, None),
+    ]
+
+
+def test_merge_first_clause_wins(session):
+    _merge_fixture(session)
+    # update listed first with no extra condition: delete never fires
+    assert rows(session, """merge into tgt t using src s on t.k = s.k
+        when matched then update set v = 'U'
+        when matched and s.v is null then delete""") == [(2,)]
+    assert rows(session, "select count(*) from tgt") == [(3,)]
+
+
+def test_merge_conditional_insert(session):
+    _merge_fixture(session)
+    assert rows(session, """merge into tgt t using src s on t.k = s.k
+        when not matched and s.k > 100 then insert values (s.k, s.v)""") \
+        == [(0,)]
+    assert rows(session, "select count(*) from tgt") == [(3,)]
+
+
+def test_merge_invalid_clause_rejected(session):
+    _merge_fixture(session)
+    with pytest.raises(SemanticError):
+        session.execute("""merge into tgt t using src s on t.k = s.k
+            when not matched then update set v = 'x'""")

@@ -166,6 +166,15 @@ def merge_pages_to_arrays(pages, symbols, types, dicts):
     return merged, total
 
 
+def _is_null_expr(e: ir.Expr) -> bool:
+    while isinstance(e, ir.Cast):
+        e = e.term
+    if isinstance(e, ir.Constant) and e.value is None:
+        return True
+    # a column of UNKNOWN type can only hold NULLs (NULL-literal columns)
+    return e.type.name == "unknown"
+
+
 def _valid_of(col: Column, n: int) -> np.ndarray:
     return (
         np.ones(n, bool)
@@ -279,7 +288,7 @@ class LocalExecutor:
                 )
             )
         before = 0
-        if w.report_deleted:
+        if w.report_deleted or w.count_mode == "merge":
             before = int(md.get_table_statistics(w.table).row_count)
         names = list(w.columns)
         if w.count_symbol is not None:
@@ -293,7 +302,15 @@ class LocalExecutor:
         )
         sink.append(page)
         written = sink.finish()
-        if w.count_symbol is not None:
+        if w.count_symbol is not None and w.count_mode == "merge":
+            m = np.asarray(
+                page.by_name("__update_count__").values
+            )[: page.count]
+            updates = int((m == 1).sum())
+            inserts = int((m == 2).sum())
+            deletes = before + inserts - page.count
+            result = updates + inserts + deletes
+        elif w.count_symbol is not None:
             marker = page.by_name("__update_count__")
             result = int(
                 np.asarray(marker.values)[: page.count].sum()
@@ -649,6 +666,10 @@ class _TraceCtx:
                 d = self.lowering.dict_for_expr(e)
                 if d is not None:
                     self.ex.dicts[sym] = d
+                elif e.type.is_dictionary and _is_null_expr(e):
+                    # NULL literal projected as varchar (e.g. unmentioned
+                    # MERGE insert columns): every row invalid, empty dict
+                    self.ex.dicts[sym] = np.array([], dtype=object)
         return Batch(out, b.sel, b.ordered, b.replicated)
 
     def _visit_limit(self, node: P.Limit) -> Batch:

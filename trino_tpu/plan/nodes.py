@@ -125,8 +125,11 @@ class TableWriter(PlanNode):
     # CTAS: (column, Type) schema to create before writing
     create_schema: Optional[Tuple[Tuple[str, T.Type], ...]] = None
     if_not_exists: bool = False
-    # UPDATE: source marker column counting changed rows (reported result)
+    # UPDATE/MERGE: source marker column for the affected-row count;
+    # count_mode "update" sums the marker, "merge" combines marker values
+    # (1=updated, 2=inserted) with the before/after row-count delta
     count_symbol: Optional[str] = None
+    count_mode: str = "update"
 
     @property
     def sources(self):

@@ -176,6 +176,8 @@ class Analyzer:
             return self._plan_delete(stmt)
         if isinstance(stmt, ast.Update):
             return self._plan_update(stmt)
+        if isinstance(stmt, ast.MergeInto):
+            return self._plan_merge(stmt)
         raise SemanticError(f"unsupported statement: {type(stmt).__name__}")
 
     # -- DML planning (QueryPlanner.planInsert / planDelete analogs) -----
@@ -307,6 +309,181 @@ class Analyzer:
             src, catalog, schema.name,
             tuple(c.name for c in schema.columns),
             overwrite=True, count_symbol=count_sym,
+        )
+        return P.Output(writer, ("rows",), ("rows",))
+
+    def _plan_merge(self, stmt: ast.MergeInto) -> P.PlanNode:
+        """MERGE as whole-table rewrite (the reference's MergeWriterNode
+        machinery): kept target rows = target LEFT JOIN source with CASE
+        per column (UPDATE) and a keep-predicate (DELETE), UNION ALL the
+        NOT-MATCHED inserts from an anti-join; a marker column (1=updated,
+        2=inserted) plus the before/after counts yields the affected-row
+        count.  First-listed matched clause wins when both apply."""
+        catalog, schema = self.metadata.resolve_table(
+            stmt.table, self.default_catalog
+        )
+        talias = stmt.target_alias or schema.name
+        upd = dele = ins = None
+        order: Dict[str, int] = {}
+        for i, w in enumerate(stmt.whens):
+            if w.matched and w.action == "update":
+                if upd:
+                    raise SemanticError("multiple WHEN MATCHED UPDATE clauses")
+                upd = w
+                order["update"] = i
+            elif w.matched and w.action == "delete":
+                if dele:
+                    raise SemanticError("multiple WHEN MATCHED DELETE clauses")
+                dele = w
+                order["delete"] = i
+            elif not w.matched and w.action == "insert":
+                if ins:
+                    raise SemanticError("multiple WHEN NOT MATCHED clauses")
+                ins = w
+            else:
+                raise SemanticError(
+                    f"WHEN {'MATCHED' if w.matched else 'NOT MATCHED'} THEN "
+                    f"{w.action.upper()} is not a valid MERGE clause"
+                )
+        salias = getattr(stmt.source, "alias", None)
+        if salias is None and isinstance(stmt.source, ast.Table):
+            salias = stmt.source.name[-1]
+        if salias is None:
+            raise SemanticError("MERGE source requires an alias")
+        known = {c.name for c in schema.columns}
+        if upd:
+            for col, _ in upd.assignments:
+                if col.lower() not in known:
+                    raise SemanticError(
+                        f"column {col} not in table {schema.name}"
+                    )
+
+        TRUE = ast.Literal("boolean", True)
+
+        def and_(*terms):
+            terms = [t for t in terms if t is not None]
+            if not terms:
+                return TRUE
+            if len(terms) == 1:
+                return terms[0]
+            return ast.LogicalOp("and", tuple(terms))
+
+        def not_true(e):
+            # NOT (e IS TRUE): false only when e evaluates true
+            return ast.LogicalOp(
+                "or", (ast.NotOp(e), ast.IsNullOp(e, False))
+            )
+
+        marker_col = "__merge_matched__"
+        matched = ast.IsNullOp(ast.Identifier((salias, marker_col)), True)
+        upd_eff = del_eff = None
+        if upd:
+            upd_eff = and_(matched, upd.condition)
+        if dele:
+            del_eff = and_(matched, dele.condition)
+        if upd and dele:  # first-listed clause wins
+            if order["update"] < order["delete"]:
+                guard = upd.condition
+                del_eff = (
+                    and_(del_eff, not_true(guard)) if guard is not None
+                    else ast.Literal("boolean", False)
+                )
+            else:
+                guard = dele.condition
+                upd_eff = (
+                    and_(upd_eff, not_true(guard)) if guard is not None
+                    else ast.Literal("boolean", False)
+                )
+
+        wrapped_source = ast.SubqueryRelation(
+            ast.Query(ast.QuerySpec(
+                items=(ast.Star(),
+                       ast.SelectItem(TRUE, marker_col)),
+                relation=stmt.source,
+                where=None, group_by=(), having=None,
+            )),
+            alias=salias,
+        )
+        assigned = {c.lower(): e for c, e in (upd.assignments if upd else ())}
+        items = []
+        for c in schema.columns:
+            base: ast.Node = ast.Identifier((talias, c.name))
+            if c.name in assigned and upd_eff is not None:
+                base = ast.CaseExpr(
+                    None,
+                    (ast.WhenClause(upd_eff, assigned[c.name]),),
+                    base,
+                )
+            items.append(ast.SelectItem(base, c.name))
+        mark_a: ast.Node = ast.Literal("integer", 0)
+        if upd_eff is not None:
+            mark_a = ast.CaseExpr(
+                None,
+                (ast.WhenClause(upd_eff, ast.Literal("integer", 1)),),
+                ast.Literal("integer", 0),
+            )
+        items.append(ast.SelectItem(mark_a, "__merge_marker__"))
+        part_a = ast.QuerySpec(
+            items=tuple(items),
+            relation=ast.Join(
+                "left",
+                ast.Table(stmt.table, stmt.target_alias),
+                wrapped_source,
+                stmt.condition,
+            ),
+            where=not_true(del_eff) if del_eff is not None else None,
+            group_by=(), having=None,
+        )
+        body: ast.Node = part_a
+        if ins:
+            ins_cols = (
+                [c.lower() for c in ins.insert_columns]
+                if ins.insert_columns
+                else [c.name for c in schema.columns]
+            )
+            if len(ins_cols) != len(ins.insert_values):
+                raise SemanticError(
+                    "MERGE INSERT column/value count mismatch"
+                )
+            for c in ins_cols:
+                if c not in known:
+                    raise SemanticError(
+                        f"column {c} not in table {schema.name}"
+                    )
+            by_col = dict(zip(ins_cols, ins.insert_values))
+            b_items = []
+            for c in schema.columns:
+                b_items.append(ast.SelectItem(
+                    by_col.get(c.name, ast.Literal("null", None)), c.name
+                ))
+            b_items.append(ast.SelectItem(
+                ast.Literal("integer", 2), "__merge_marker__"
+            ))
+            anti = ast.Exists(
+                ast.Query(ast.QuerySpec(
+                    items=(ast.SelectItem(ast.Literal("integer", 1)),),
+                    relation=ast.Table(stmt.table, stmt.target_alias),
+                    where=stmt.condition,
+                    group_by=(), having=None,
+                )),
+                negate=True,
+            )
+            part_b = ast.QuerySpec(
+                items=tuple(b_items),
+                relation=stmt.source,
+                where=and_(anti, ins.condition),
+                group_by=(), having=None,
+            )
+            body = ast.SetOp("union", True, part_a, part_b)
+        rp, _ = self.plan_query(ast.Query(body))
+        ttypes = [schema.column_type(c.name) for c in schema.columns]
+        ttypes.append(T.BIGINT)
+        src = self._coerced_source(rp, ttypes)
+        marker_sym = src.output_symbols()[-1]
+        writer = P.TableWriter(
+            src, catalog, schema.name,
+            tuple(c.name for c in schema.columns),
+            overwrite=True, count_symbol=marker_sym, count_mode="merge",
         )
         return P.Output(writer, ("rows",), ("rows",))
 

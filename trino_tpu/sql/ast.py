@@ -364,6 +364,29 @@ class Update(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class MergeWhen(Node):
+    """One WHEN [NOT] MATCHED [AND cond] THEN action clause."""
+
+    matched: bool
+    condition: Optional[Node]  # extra AND condition
+    action: str  # update | delete | insert
+    assignments: Tuple[Tuple[str, Node], ...] = ()  # update
+    insert_columns: Tuple[str, ...] = ()  # insert ((), positional)
+    insert_values: Tuple[Node, ...] = ()  # insert
+
+
+@dataclasses.dataclass(frozen=True)
+class MergeInto(Node):
+    """MERGE INTO target USING source ON cond WHEN ... (MergeWriterNode)."""
+
+    table: Tuple[str, ...]
+    target_alias: Optional[str]
+    source: Node  # relation
+    condition: Node
+    whens: Tuple[MergeWhen, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Delete(Node):
     """DELETE FROM t [WHERE pred]"""
 

@@ -287,6 +287,64 @@ class Parser:
             where = self.expr() if self.accept_kw("where") else None
             self._finish()
             return ast.Delete(name, where)
+        if self.accept_soft("merge"):
+            self.expect_kw("into")
+            name = self.qualified_name()
+            talias = None
+            if self.accept_kw("as"):
+                talias = self.ident()
+            elif self.peek().kind == "ident" and not self.at_kw("using"):
+                talias = self.next().text
+            self.expect_kw("using")
+            source = self.relation_primary()
+            self.expect_kw("on")
+            cond = self.expr()
+            whens = []
+            while self.accept_kw("when"):
+                negate = self.accept_kw("not")
+                if not self.accept_soft("matched"):
+                    raise ParseError("expected MATCHED in MERGE WHEN clause")
+                extra = self.expr() if self.accept_kw("and") else None
+                self.expect_kw("then")
+                if self.accept_kw("update"):
+                    self.expect_kw("set")
+                    assigns = []
+                    while True:
+                        col = self.ident()
+                        self.expect_op("=")
+                        assigns.append((col, self.expr()))
+                        if not self.accept_op(","):
+                            break
+                    whens.append(ast.MergeWhen(
+                        not negate, extra, "update", tuple(assigns)
+                    ))
+                elif self.accept_kw("delete"):
+                    whens.append(ast.MergeWhen(not negate, extra, "delete"))
+                elif self.accept_kw("insert"):
+                    cols = []
+                    if self.accept_op("("):
+                        cols.append(self.ident())
+                        while self.accept_op(","):
+                            cols.append(self.ident())
+                        self.expect_op(")")
+                    self.expect_kw("values")
+                    self.expect_op("(")
+                    vals = [self.expr()]
+                    while self.accept_op(","):
+                        vals.append(self.expr())
+                    self.expect_op(")")
+                    whens.append(ast.MergeWhen(
+                        not negate, extra, "insert", (),
+                        tuple(cols), tuple(vals),
+                    ))
+                else:
+                    raise ParseError(
+                        "MERGE THEN expects UPDATE SET / DELETE / INSERT"
+                    )
+            if not whens:
+                raise ParseError("MERGE requires at least one WHEN clause")
+            self._finish()
+            return ast.MergeInto(name, talias, source, cond, tuple(whens))
         if self.accept_kw("update"):
             name = self.qualified_name()
             self.expect_kw("set")
