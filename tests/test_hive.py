@@ -174,3 +174,39 @@ def test_hive_statistics(session):
     assert stats.row_count == 4000
     assert stats.columns["id"].min_value == 0
     assert stats.columns["id"].max_value == 3999
+
+
+def test_hive_orc_csv_json_formats(tmp_path):
+    pa = pytest.importorskip("pyarrow")
+    from pyarrow import orc as paorc
+
+    wh = str(tmp_path)
+    import os
+
+    os.makedirs(f"{wh}/events")
+    paorc.write_table(
+        pa.table({"id": [1, 2, 3], "name": ["x", "y", "z"]}),
+        f"{wh}/events/part0.orc",
+    )
+    os.makedirs(f"{wh}/logs")
+    open(f"{wh}/logs/a.csv", "w").write("ts,msg\n1,hello\n2,world\n")
+    os.makedirs(f"{wh}/js")
+    open(f"{wh}/js/a.json", "w").write(
+        '{"a": 1, "b": "q"}\n{"a": 2, "b": "r"}\n'
+    )
+    from trino_tpu.session import Session
+
+    s = Session()
+    s.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
+    assert s.execute("show tables").to_pylist() == [
+        ("events",), ("js",), ("logs",),
+    ]
+    assert s.execute("select * from events order by id").to_pylist() == [
+        (1, "x"), (2, "y"), (3, "z"),
+    ]
+    assert s.execute("select sum(ts), max(msg) from logs").to_pylist() == [
+        (3, "world"),
+    ]
+    assert s.execute("select a, b from js order by a").to_pylist() == [
+        (1, "q"), (2, "r"),
+    ]

@@ -169,3 +169,14 @@ def test_transaction_control():
     assert s.execute("commit").to_pylist() == [(True,)]
     with pytest.raises(ValueError):
         s.execute("rollback")
+
+
+def test_explain_distributed():
+    s = tpch_session(0.001)
+    lines = [r[0] for r in s.execute(
+        "explain (type distributed) select o_orderpriority, count(*) "
+        "from orders group by o_orderpriority"
+    ).to_pylist()]
+    text = "\n".join(lines)
+    assert "Fragment 1" in text and "step=partial" in text
+    assert "step=final" in text and "RemoteSource" in text

@@ -50,6 +50,24 @@ except ImportError:  # pragma: no cover
     pq = None
 
 
+def _read_arrow_table(path: str, fmt: str):
+    """Read a whole non-parquet file as an arrow table (the
+    lib/trino-orc / trino-hive-formats reader slot, via arrow)."""
+    if fmt == "orc":
+        from pyarrow import orc as _orc
+
+        return _orc.ORCFile(path).read()
+    if fmt == "csv":
+        from pyarrow import csv as _csv
+
+        return _csv.read_csv(path)
+    if fmt == "json":
+        from pyarrow import json as _json
+
+        return _json.read_json(path)
+    raise NotImplementedError(f"unsupported hive format {fmt}")
+
+
 def _require_pyarrow():
     if pq is None:  # pragma: no cover
         raise RuntimeError("hive connector requires pyarrow")
@@ -93,26 +111,41 @@ class HiveMetadata(ConnectorMetadata):
     def __init__(self, warehouse: str):
         self.warehouse = warehouse
 
+    FORMATS = ("parquet", "orc", "csv", "json")  # hive-formats analog
+
     def list_tables(self) -> List[str]:
         if not os.path.isdir(self.warehouse):
             return []
-        return sorted(
-            d
-            for d in os.listdir(self.warehouse)
-            if glob.glob(os.path.join(self.warehouse, d, "*.parquet"))
-        )
+        out = []
+        for d in sorted(os.listdir(self.warehouse)):
+            if any(
+                glob.glob(os.path.join(self.warehouse, d, f"*.{ext}"))
+                for ext in self.FORMATS
+            ):
+                out.append(d)
+        return out
 
     def _files(self, table: str) -> List[str]:
-        files = sorted(
-            glob.glob(os.path.join(self.warehouse, table, "*.parquet"))
-        )
-        if not files:
-            raise KeyError(f"hive table not found: {table}")
-        return files
+        for ext in self.FORMATS:
+            files = sorted(
+                glob.glob(os.path.join(self.warehouse, table, f"*.{ext}"))
+            )
+            if files:
+                return files
+        raise KeyError(f"hive table not found: {table}")
+
+    @staticmethod
+    def _format_of(path: str) -> str:
+        return path.rsplit(".", 1)[-1].lower()
 
     def get_table_schema(self, table: str) -> TableSchema:
         _require_pyarrow()
-        schema = pq.read_schema(self._files(table)[0])
+        path = self._files(table)[0]
+        fmt = self._format_of(path)
+        if fmt == "parquet":
+            schema = pq.read_schema(path)
+        else:
+            schema = _read_arrow_table(path, fmt).schema
         return TableSchema(
             table,
             tuple(
@@ -123,8 +156,16 @@ class HiveMetadata(ConnectorMetadata):
 
     def get_table_statistics(self, table: str) -> TableStatistics:
         """Row counts from footers; per-column min/max/nulls from row-group
-        statistics (the reference reads these via ParquetMetadata for CBO)."""
+        statistics (the reference reads these via ParquetMetadata for CBO).
+        Non-parquet formats report row counts only."""
         _require_pyarrow()
+        files = self._files(table)
+        if self._format_of(files[0]) != "parquet":
+            rows = sum(
+                _read_arrow_table(p, self._format_of(p)).num_rows
+                for p in files
+            )
+            return TableStatistics(float(rows), {})
         rows = 0
         mins: Dict[str, float] = {}
         maxs: Dict[str, float] = {}
@@ -169,9 +210,17 @@ class HiveSplitManager(SplitManager):
 
     def get_splits(self, table, desired, constraint=None) -> List[Split]:
         _require_pyarrow()
+        files = self.meta._files(table)
+        if HiveMetadata._format_of(files[0]) != "parquet":
+            # ORC/CSV/JSON: one split per file (no engine-side footer
+            # pruning; ORC stripe stats live with the reader)
+            return [
+                Split(table, i, len(files), {"path": p, "row_group": -1})
+                for i, p in enumerate(files)
+            ]
         ranges = {c: (lo, hi) for c, lo, hi in (constraint or ())}
         work: List[Tuple[str, int]] = []
-        for path in self.meta._files(table):
+        for path in files:
             md = pq.ParquetFile(path).metadata
             for rg in range(md.num_row_groups):
                 if ranges and self._pruned(md.row_group(rg), ranges):
@@ -212,10 +261,14 @@ class HivePageSource(PageSource):
 
     def pages(self):
         _require_pyarrow()
-        pf = pq.ParquetFile(self.split.info["path"])
-        tbl = pf.read_row_group(
-            int(self.split.info["row_group"]), columns=self.columns
-        )
+        path = self.split.info["path"]
+        rg = int(self.split.info["row_group"])
+        if rg < 0:  # whole-file split: ORC/CSV/JSON formats
+            fmt = HiveMetadata._format_of(path)
+            tbl = _read_arrow_table(path, fmt).select(self.columns)
+        else:
+            pf = pq.ParquetFile(path)
+            tbl = pf.read_row_group(rg, columns=self.columns)
         n = tbl.num_rows
         cols = []
         for name in self.columns:

@@ -368,7 +368,27 @@ class Session:
         if isinstance(stmt, ast.Explain):
             if stmt.analyze:
                 return self._explain_analyze(stmt.query, query_id)
-            text = P.plan_to_string(self._plan_stmt(stmt.query))
+            plan = self._plan_stmt(stmt.query)
+            if stmt.plan_type == "distributed":
+                from .plan.fragment import fragment_plan
+
+                parts = []
+                for f in fragment_plan(plan):
+                    parts.append(
+                        f"Fragment {f.id} [{f.partitioning}"
+                        + (f" keys={list(f.partition_keys)}"
+                           if f.partition_keys else "")
+                        + f" -> output {f.output_partitioning}]"
+                    )
+                    parts.append(
+                        "\n".join(
+                            "  " + line
+                            for line in P.plan_to_string(f.root).split("\n")
+                        )
+                    )
+                text = "\n".join(parts)
+            else:
+                text = P.plan_to_string(plan)
             col = column_from_pylist(T.VARCHAR, text.split("\n"))
             return Page([col], len(text.split("\n")), ["Query Plan"])
         if isinstance(stmt, ast.CreateTable):

@@ -498,6 +498,7 @@ class ShowCreateTable(Node):
 class Explain(Node):
     query: Query
     analyze: bool = False
+    plan_type: str = "logical"  # logical | distributed
 
 
 @dataclasses.dataclass(frozen=True)

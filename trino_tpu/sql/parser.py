@@ -147,9 +147,24 @@ class Parser:
     def parse_statement(self) -> ast.Node:
         if self.accept_kw("explain"):
             analyze = self.accept_kw("analyze")
+            plan_type = "logical"
+            if self.accept_op("("):
+                while True:
+                    if self.accept_soft("type"):
+                        t = self.next()
+                        if t.text.lower() not in ("logical", "distributed"):
+                            raise ParseError(
+                                "EXPLAIN (TYPE LOGICAL|DISTRIBUTED)"
+                            )
+                        plan_type = t.text.lower()
+                    else:
+                        raise ParseError(f"unknown EXPLAIN option {self.peek()!r}")
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
             q = self.parse_query()
             self._finish()
-            return ast.Explain(q, analyze)
+            return ast.Explain(q, analyze, plan_type)
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
                 self._finish()
