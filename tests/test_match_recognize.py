@@ -1,0 +1,121 @@
+"""MATCH_RECOGNIZE tests.
+
+Reference parity: operator/window/matcher (NFA row pattern matching) and
+the PatternRecognitionNode planning path; the classic V-shape stock
+example from the reference docs.
+"""
+import pytest
+
+from trino_tpu.session import Session
+from trino_tpu.sql.analyzer import SemanticError
+
+
+@pytest.fixture()
+def session():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table trades (sym varchar, ts bigint, price bigint)")
+    s.execute("""insert into trades values
+        ('A',1,10),('A',2,8),('A',3,7),('A',4,9),('A',5,12),
+        ('A',6,11),('A',7,13),
+        ('B',1,5),('B',2,6),('B',3,4),('B',4,7)""")
+    return s
+
+
+MR_V = """select * from trades match_recognize (
+  partition by sym order by ts
+  measures first(price) as start_price,
+           last(down.price) as bottom,
+           last(price) as end_price,
+           match_number() as mno
+  one row per match
+  after match skip past last row
+  pattern (strt down+ up+)
+  define down as price < prev(price), up as price > prev(price)
+) mr order by sym, mno"""
+
+
+def test_v_shape(session):
+    assert session.execute(MR_V).to_pylist() == [
+        ("A", 10, 7, 12, 1),
+        ("B", 6, 4, 7, 1),
+    ]
+
+
+def test_skip_to_next_row_finds_overlaps(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures first(price) as a, last(price) as b
+        one row per match
+        after match skip to next row
+        pattern (x down)
+        define down as price < prev(price)
+    ) order by sym, a""").to_pylist()
+    # every adjacent falling pair: A 10->8, 8->7, 12->11; B 6->4
+    assert out == [
+        ("A", 8, 7), ("A", 10, 8), ("A", 12, 11), ("B", 6, 4),
+    ]
+
+
+def test_quantifier_star_and_alternation(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures match_number() as mno, classifier() as cls
+        one row per match
+        pattern (up | down)
+        define up as price > prev(price), down as price < prev(price)
+    ) where sym = 'A' order by mno""").to_pylist()
+    # each row after the first classifies as UP or DOWN
+    assert len(out) == 6
+    assert {r[2] for r in out} == {"UP", "DOWN"}
+
+
+def test_classifier_and_match_number(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures classifier() as cls, match_number() as mno
+        one row per match
+        pattern (down)
+        define down as price < prev(price)
+    ) where sym = 'B'""").to_pylist()
+    assert out == [("B", "DOWN", 1)]
+
+
+def test_unknown_define_variable_rejected(session):
+    with pytest.raises(SemanticError):
+        session.execute("""select * from trades match_recognize (
+            partition by sym order by ts
+            measures match_number() as mno
+            one row per match
+            pattern (a)
+            define b as price > 0
+        )""")
+
+
+def test_optional_quantifier(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures first(price) as a, last(price) as b
+        one row per match
+        pattern (strt down down?)
+        define down as price < prev(price)
+    ) where sym = 'A' order by a""").to_pylist()
+    # greedy: 10 -> 8 -> 7 consumes both downs; 12 -> 11 single down
+    assert out == [("A", 10, 7), ("A", 12, 11)]
+
+
+def test_varchar_measures_and_defines():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table ev (u bigint, seq bigint, kind varchar)")
+    s.execute("""insert into ev values
+        (1,1,'view'),(1,2,'cart'),(1,3,'buy'),
+        (2,1,'view'),(2,2,'view'),(2,3,'cart')""")
+    out = s.execute("""select * from ev match_recognize (
+        partition by u order by seq
+        measures first(kind) as first_kind, last(kind) as last_kind
+        one row per match
+        pattern (v c b)
+        define v as kind = 'view', c as kind = 'cart', b as kind = 'buy'
+    ) order by u""").to_pylist()
+    assert out == [(1, "view", "buy")]

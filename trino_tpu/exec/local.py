@@ -233,7 +233,7 @@ class LocalExecutor:
             use_jit = (
                 self.config.get("jit_fragments")
                 and not self.config.get("collect_node_stats")
-                and not _contains(plan, P.Unnest)
+                and not _contains(plan, (P.Unnest, P.MatchRecognize))
             )
             for attempt in range(5):
                 if use_jit:
@@ -763,6 +763,109 @@ class _TraceCtx:
             lanes[node.ordinality_symbol] = (
                 jnp.asarray(pad_to(ords, cap)),
                 jnp.asarray(pad_to(np.ones(total, bool), cap, False)),
+            )
+        return Batch(lanes, jnp.arange(cap) < total)
+
+    def _visit_matchrecognize(self, node: P.MatchRecognize) -> Batch:
+        """MATCH_RECOGNIZE, host-staged (output size is data-dependent and
+        the automaton is inherently sequential per partition — the
+        reference's window/matcher is also a row-at-a-time NFA)."""
+        import functools
+
+        from ..ops.matcher import find_matches
+
+        b = self.visit(node.source)
+        sel = np.asarray(b.sel)
+        rows = np.nonzero(sel)[0]
+        n = len(rows)
+        src_types = node.source.output_types()
+        cols: Dict[str, list] = {}
+        for sym in node.source.output_symbols():
+            if sym not in b.lanes:
+                continue
+            v, ok = b.lanes[sym]
+            vv = np.asarray(v)[rows]
+            oo = np.asarray(ok)[rows]
+            t = src_types[sym]
+            if t.is_dictionary and not getattr(t, "is_array", False):
+                d = self.ex.dicts.get(sym)
+                cols[sym] = [
+                    (str(d[int(c)]) if (okk and int(c) >= 0) else None)
+                    for c, okk in zip(vv, oo)
+                ]
+            else:
+                cols[sym] = [
+                    (v_.item() if okk else None)
+                    for v_, okk in zip(vv, oo)
+                ]
+        # order rows: partition keys first, then ORDER BY keys
+        keys = [(s, True, False) for s in node.partition_by] + [
+            (k.column, k.ascending, k.nulls_first)
+            for k in node.order_by
+        ]
+
+        def cmp(a, bidx):
+            for col, asc, nulls_first in keys:
+                va, vb = cols[col][a], cols[col][bidx]
+                if va is None and vb is None:
+                    continue
+                if va is None:
+                    return -1 if nulls_first else 1
+                if vb is None:
+                    return 1 if nulls_first else -1
+                if va == vb:
+                    continue
+                lt = va < vb
+                return (-1 if lt else 1) if asc else (1 if lt else -1)
+            return 0
+
+        order = sorted(range(n), key=functools.cmp_to_key(cmp))
+        defines = dict(node.defines)
+        measures = [(s, e) for s, e, _ in node.measures]
+        out_rows: List[dict] = []
+        i = 0
+        while i < n:
+            j = i
+            pkey = tuple(cols[s][order[i]] for s in node.partition_by)
+            while j < n and tuple(
+                cols[s][order[j]] for s in node.partition_by
+            ) == pkey:
+                j += 1
+            part_idx = order[i:j]
+            pcols = {c: [vals[k] for k in part_idx] for c, vals in cols.items()}
+            for m in find_matches(
+                pcols, len(part_idx), node.pattern, defines, measures,
+                node.after_match,
+            ):
+                for s, v in zip(node.partition_by, pkey):
+                    m[s] = v
+                out_rows.append(m)
+            i = j
+        total = len(out_rows)
+        cap = _pad_capacity(max(total, 1))
+        out_types = node.output_types()
+        lanes = {}
+        from ..page import column_from_pylist
+
+        for sym in node.output_symbols():
+            t = out_types[sym]
+            vals = [m.get(sym) for m in out_rows]
+            if t.is_dictionary and not getattr(t, "is_array", False):
+                col = column_from_pylist(t, vals)
+                self.ex.dicts[sym] = col.dictionary
+                arr = np.asarray(col.values)
+                okv = (
+                    np.ones(total, bool) if col.validity is None
+                    else np.asarray(col.validity)
+                )
+            else:
+                arr = np.array(
+                    [0 if x is None else x for x in vals], dtype=t.np_dtype
+                )
+                okv = np.array([x is not None for x in vals], dtype=bool)
+            lanes[sym] = (
+                jnp.asarray(pad_to(arr, cap)),
+                jnp.asarray(pad_to(okv, cap, False)),
             )
         return Batch(lanes, jnp.arange(cap) < total)
 

@@ -32,9 +32,15 @@ class _EvalError(ValueError):
     pass
 
 
-def eval_ir(e: ir.Expr, env: Dict[str, object]):
+def eval_ir(e: ir.Expr, env: Dict[str, object], special=None):
     """Evaluate an IR expression over python values (env: param -> value).
-    Values follow IR-constant conventions.  Returns None for NULL."""
+    Values follow IR-constant conventions.  Returns None for NULL.
+    `special(e, env)` may claim a node first (returns (True, value)); used
+    by MATCH_RECOGNIZE navigation (ops/matcher.py)."""
+    if special is not None:
+        handled, v = special(e, env)
+        if handled:
+            return v
     if isinstance(e, ir.Constant):
         return e.value
     if isinstance(e, ir.ColumnRef):
@@ -46,7 +52,7 @@ def eval_ir(e: ir.Expr, env: Dict[str, object]):
 
         args = []
         for a in e.args:
-            v = eval_ir(a, env)
+            v = eval_ir(a, env, special)
             if v is None:
                 return None  # scalar functions are null-propagating
             args.append(ir.Constant(a.type, v))
@@ -55,8 +61,8 @@ def eval_ir(e: ir.Expr, env: Dict[str, object]):
         except NotImplementedError:
             raise _EvalError(f"{e.name}() is not supported inside lambdas")
     if isinstance(e, ir.Comparison):
-        lv = eval_ir(e.left, env)
-        rv = eval_ir(e.right, env)
+        lv = eval_ir(e.left, env, special)
+        rv = eval_ir(e.right, env, special)
         if e.op == "is_distinct":
             return _coerce(lv, e.left.type) != _coerce(rv, e.right.type)
         if lv is None or rv is None:
@@ -68,7 +74,7 @@ def eval_ir(e: ir.Expr, env: Dict[str, object]):
             "<": a < b, "<=": a <= b, ">": a > b, ">=": a >= b,
         }[e.op]
     if isinstance(e, ir.Logical):
-        vals = [eval_ir(t, env) for t in e.terms]
+        vals = [eval_ir(t, env, special) for t in e.terms]
         if e.op == "and":
             if any(v is False for v in vals):
                 return False
@@ -77,15 +83,15 @@ def eval_ir(e: ir.Expr, env: Dict[str, object]):
             return True
         return None if any(v is None for v in vals) else False
     if isinstance(e, ir.Not):
-        v = eval_ir(e.term, env)
+        v = eval_ir(e.term, env, special)
         return None if v is None else (not v)
     if isinstance(e, ir.IsNull):
-        v = eval_ir(e.term, env)
+        v = eval_ir(e.term, env, special)
         return (v is not None) if e.negate else (v is None)
     if isinstance(e, ir.Between):
-        v = eval_ir(e.value, env)
-        lo = eval_ir(e.low, env)
-        hi = eval_ir(e.high, env)
+        v = eval_ir(e.value, env, special)
+        lo = eval_ir(e.low, env, special)
+        hi = eval_ir(e.high, env, special)
         if v is None or lo is None or hi is None:
             return None
         r = (
@@ -94,22 +100,22 @@ def eval_ir(e: ir.Expr, env: Dict[str, object]):
         )
         return (not r) if e.negate else r
     if isinstance(e, ir.In):
-        v = eval_ir(e.value, env)
+        v = eval_ir(e.value, env, special)
         if v is None:
             return None
         vv = _coerce(v, e.value.type)
         hit = any(
-            i.value is not None and _coerce(eval_ir(i, env), i.type) == vv
+            i.value is not None and _coerce(eval_ir(i, env, special), i.type) == vv
             for i in e.items
         )
         return (not hit) if e.negate else hit
     if isinstance(e, ir.Case):
         for w in e.whens:
-            if eval_ir(w.condition, env) is True:
-                return eval_ir(w.result, env)
-        return eval_ir(e.default, env) if e.default is not None else None
+            if eval_ir(w.condition, env, special) is True:
+                return eval_ir(w.result, env, special)
+        return eval_ir(e.default, env, special) if e.default is not None else None
     if isinstance(e, ir.Cast):
-        v = eval_ir(e.term, env)
+        v = eval_ir(e.term, env, special)
         if v is None:
             return None
         return _cast_value(v, e.term.type, e.type)

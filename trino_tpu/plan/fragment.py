@@ -211,6 +211,11 @@ class Fragmenter:
             keys = ()
         return dataclasses.replace(node, source=src), part, keys
 
+    def _do_matchrecognize(self, node: P.MatchRecognize):
+        src, part, keys = self._rewrite(node.source)
+        src = self._gather(src, part, keys)  # single-stage like Window
+        return dataclasses.replace(node, source=src), SINGLE, ()
+
     def _do_sample(self, node: P.Sample):
         src, part, keys = self._rewrite(node.source)
         return dataclasses.replace(node, source=src), part, keys

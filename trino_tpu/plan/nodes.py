@@ -70,6 +70,34 @@ class Values(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
+class MatchRecognize(PlanNode):
+    """Row pattern recognition (PatternRecognitionNode + window/matcher).
+    ONE ROW PER MATCH: output = partition keys + measures."""
+
+    source: PlanNode
+    partition_by: Tuple[str, ...]
+    order_by: Tuple[SortKey, ...]
+    pattern: object  # ast.PatternTerm tree (frozen dataclasses)
+    defines: Tuple[Tuple[str, ir.Expr], ...]
+    measures: Tuple[Tuple[str, ir.Expr, T.Type], ...]  # (symbol, expr, type)
+    after_match: str = "past_last_row"
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_symbols(self):
+        return list(self.partition_by) + [s for s, _, _ in self.measures]
+
+    def output_types(self):
+        src = self.source.output_types()
+        out = {s: src[s] for s in self.partition_by}
+        for s, _, t in self.measures:
+            out[s] = t
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
 class Unnest(PlanNode):
     """UNNEST expansion (UnnestNode + operator/unnest/UnnestOperator):
     each input row replicates once per element of its array column; source

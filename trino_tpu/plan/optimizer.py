@@ -164,7 +164,7 @@ def _rewrite_sources(node: P.PlanNode, new_sources: Tuple[P.PlanNode, ...]):
     if isinstance(node, (P.Filter, P.Project, P.Aggregate, P.Sort, P.TopN,
                          P.Limit, P.Distinct, P.Output, P.Exchange,
                          P.Window, P.GroupId, P.TableWriter, P.Unnest,
-                         P.Sample)):
+                         P.Sample, P.MatchRecognize)):
         return dataclasses.replace(node, source=new_sources[0])
     if isinstance(node, P.Join):
         return dataclasses.replace(node, left=new_sources[0], right=new_sources[1])
@@ -458,6 +458,15 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
                 node,
                 source=prune(node.source, set(node.source.output_symbols())),
             )
+        if isinstance(node, P.MatchRecognize):
+            need = set(node.partition_by)
+            for k in node.order_by:
+                need.add(k.column)
+            for _, e in node.defines:
+                need.update(ir.referenced_columns(e))
+            for _, e, _ in node.measures:
+                need.update(ir.referenced_columns(e))
+            return dataclasses.replace(node, source=prune(node.source, need))
         if isinstance(node, P.Unnest):
             need = (set(required) - {node.element_symbol,
                                      node.ordinality_symbol})

@@ -1364,6 +1364,8 @@ class Analyzer:
             return RelationPlan(rp.root, Scope(fields))
         if isinstance(rel, ast.Join):
             return self._plan_join(rel)
+        if isinstance(rel, ast.MatchRecognize):
+            return self._plan_match_recognize(rel)
         if isinstance(rel, ast.UnnestRelation):
             # standalone FROM UNNEST(constant-array): expand against dual
             sym = self.symbols.new("dual")
@@ -1372,6 +1374,64 @@ class Analyzer:
             )
             return self._plan_unnest(dual, rel)
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    def _plan_match_recognize(self, mr: ast.MatchRecognize) -> RelationPlan:
+        """MATCH_RECOGNIZE -> P.MatchRecognize (PatternRecognitionNode):
+        DEFINE/MEASURES analyzed with a navigation-aware resolver
+        (PREV/NEXT/FIRST/LAST/CLASSIFIER/MATCH_NUMBER; A.col == LAST(A.col))."""
+        inner = self.plan_relation(mr.relation)
+        vars_: set = set()
+
+        def collect(t):
+            if t.kind == "var":
+                vars_.add(t.var)
+            for s in t.items:
+                collect(s)
+
+        collect(mr.pattern)
+        mrea = MrExprAnalyzer(self, inner, vars_)
+        part_syms = []
+        for p in mr.partition_by:
+            e = mrea.analyze(p)
+            if not isinstance(e, ir.ColumnRef):
+                raise SemanticError(
+                    "MATCH_RECOGNIZE PARTITION BY must be input columns"
+                )
+            part_syms.append(e.name)
+        order_keys = []
+        for si in mr.order_by:
+            e = mrea.analyze(si.expr)
+            if not isinstance(e, ir.ColumnRef):
+                raise SemanticError(
+                    "MATCH_RECOGNIZE ORDER BY must be input columns"
+                )
+            nf = si.nulls_first
+            order_keys.append(SortKey(
+                e.name, si.ascending,
+                (not si.ascending) if nf is None else nf,
+            ))
+        defines = []
+        for var, cond in mr.defines:
+            if var not in vars_:
+                raise SemanticError(
+                    f"DEFINE variable {var.upper()} not in PATTERN"
+                )
+            c = mrea.analyze(cond)
+            defines.append((var, c))
+        measures = []
+        fields = [
+            f for f in inner.scope.fields if f.symbol in part_syms
+        ]
+        for expr, name in mr.measures:
+            e = mrea.analyze(expr)
+            sym = self.symbols.new(name)
+            measures.append((sym, e, e.type))
+            fields.append(Field(mr.alias, name.lower(), sym, e.type))
+        node = P.MatchRecognize(
+            inner.root, tuple(part_syms), tuple(order_keys), mr.pattern,
+            tuple(defines), tuple(measures), mr.after_match,
+        )
+        return RelationPlan(node, Scope(fields))
 
     def _plan_unnest(
         self, left: RelationPlan, u: ast.UnnestRelation
@@ -2571,3 +2631,66 @@ class SqlFunction:
     params: Tuple[Tuple[str, str], ...]  # (name, type text)
     return_type: str
     body: ast.Node
+
+
+class MrExprAnalyzer(ExprAnalyzer):
+    """MATCH_RECOGNIZE expression analysis: pattern-variable-qualified
+    references and navigation functions lower to __mr_*__ calls the
+    matcher's evaluator resolves (ops/matcher.py)."""
+
+    NAV = {"prev": "__mr_prev__", "next": "__mr_next__",
+           "first": "__mr_first__", "last": "__mr_last__"}
+
+    def __init__(self, analyzer, relation, pattern_vars):
+        super().__init__(analyzer, relation)
+        self.pattern_vars = pattern_vars
+
+    def _var_ref(self, e: ast.Node):
+        """(colref, var) for A.col / col inside navigation, else None."""
+        if isinstance(e, ast.Identifier) and len(e.parts) == 2:
+            v = e.parts[0].lower()
+            if v in self.pattern_vars:
+                col = super()._an(ast.Identifier((e.parts[1],)))
+                return col, v
+        return None
+
+    def _an(self, e: ast.Node) -> ir.Expr:
+        vr = self._var_ref(e)
+        if vr is not None:  # bare A.col == LAST(A.col)
+            col, v = vr
+            return ir.Call(col.type, "__mr_last__",
+                           (col, ir.Constant(T.VARCHAR, v)))
+        if isinstance(e, ast.FunctionCall) and e.name in self.NAV:
+            nav = self.NAV[e.name]
+            if not e.args:
+                raise SemanticError(f"{e.name}() requires an argument")
+            if nav in ("__mr_prev__", "__mr_next__"):
+                arg = self._an(e.args[0])
+                if not isinstance(arg, ir.ColumnRef):
+                    raise SemanticError(
+                        f"{e.name}() supports column references only"
+                    )
+                n = 1
+                if len(e.args) > 1:
+                    c = self._an(e.args[1])
+                    if not isinstance(c, ir.Constant):
+                        raise SemanticError(f"{e.name}() offset must be constant")
+                    n = int(c.value)
+                return ir.Call(arg.type, nav,
+                               (arg, ir.Constant(T.BIGINT, n)))
+            vr = self._var_ref(e.args[0])
+            if vr is not None:
+                col, v = vr
+            else:
+                col = self._an(e.args[0])
+                v = ""
+                if not isinstance(col, ir.ColumnRef):
+                    raise SemanticError(
+                        f"{e.name}() supports column references only"
+                    )
+            return ir.Call(col.type, nav, (col, ir.Constant(T.VARCHAR, v)))
+        if isinstance(e, ast.FunctionCall) and e.name == "classifier":
+            return ir.Call(T.VARCHAR, "__mr_classifier__", ())
+        if isinstance(e, ast.FunctionCall) and e.name == "match_number":
+            return ir.Call(T.BIGINT, "__mr_match_number__", ())
+        return super()._an(e)

@@ -237,6 +237,33 @@ class Join(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class PatternTerm(Node):
+    """One pattern atom: variable or group, with a quantifier."""
+
+    kind: str  # var | group | alt
+    var: Optional[str] = None
+    items: Tuple["PatternTerm", ...] = ()  # group: sequence; alt: branches
+    quantifier: str = ""  # '' | '*' | '+' | '?'
+    greedy: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchRecognize(Node):
+    """t MATCH_RECOGNIZE (PARTITION BY .. ORDER BY .. MEASURES ..
+    [ONE ROW PER MATCH] [AFTER MATCH SKIP ..] PATTERN (..) DEFINE ..)
+    (SqlBase.g4 patternRecognition; window/matcher NFA in the reference)."""
+
+    relation: Node
+    partition_by: Tuple[Node, ...]
+    order_by: Tuple["SortItem", ...]
+    measures: Tuple[Tuple[Node, str], ...]  # (expr, name)
+    pattern: PatternTerm  # top-level sequence
+    defines: Tuple[Tuple[str, Node], ...]  # (variable, condition)
+    after_match: str = "past_last_row"  # past_last_row | to_next_row
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class UnnestRelation(Node):
     """UNNEST(expr, ...) [WITH ORDINALITY] [AS alias (cols)]"""
 

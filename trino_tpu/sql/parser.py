@@ -11,6 +11,7 @@ Operator precedence (low to high), matching SqlBase.g4's expression rules:
 """
 from __future__ import annotations
 
+import dataclasses
 import re
 from typing import List, Optional, Tuple
 
@@ -23,7 +24,7 @@ _TOKEN_RE = re.compile(
   | (?P<string>'(?:[^']|'')*')
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<ident>[A-Za-z_][A-Za-z0-9_$]*)
-  | (?P<op><>|!=|>=|<=|\|\||=>|->|[-+*/%(),.;=<>\[\]?])
+  | (?P<op><>|!=|>=|<=|\|\||=>|->|[-+*/%(),.;=<>\[\]?|])
 """,
     re.VERBOSE | re.DOTALL,
 )
@@ -693,6 +694,115 @@ class Parser:
                 raise ParseError("JOIN requires ON")
             rel = ast.Join(kind, rel, right, cond)
 
+    def _match_recognize(self, rel: ast.Node) -> ast.Node:
+        """MATCH_RECOGNIZE clause after a relation (row pattern recognition)."""
+        self.expect_op("(")
+        partition: List[ast.Node] = []
+        order: List[ast.SortItem] = []
+        measures: List[tuple] = []
+        after = "past_last_row"
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept_op(","):
+                partition.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.sort_item())
+            while self.accept_op(","):
+                order.append(self.sort_item())
+        if self.accept_soft("measures"):
+            while True:
+                e = self.expr()
+                self.expect_kw("as")
+                measures.append((e, self.ident()))
+                if not self.accept_op(","):
+                    break
+        if self.accept_soft("one"):
+            self.expect_kw("row")
+            if not self.accept_soft("per"):
+                raise ParseError("expected PER MATCH")
+            if not self.accept_soft("match"):
+                raise ParseError("expected MATCH")
+        elif self.accept_kw("all"):
+            raise ParseError("ALL ROWS PER MATCH is not supported yet")
+        if self.accept_soft("after"):
+            if not self.accept_soft("match"):
+                raise ParseError("expected MATCH after AFTER")
+            if not self.accept_soft("skip"):
+                raise ParseError("expected SKIP")
+            if self.accept_soft("past"):
+                self.expect_kw("last")
+                self.expect_kw("row")
+                after = "past_last_row"
+            elif self.accept_soft("to"):
+                if not self.accept_soft("next"):
+                    raise ParseError("expected NEXT ROW")
+                self.expect_kw("row")
+                after = "to_next_row"
+            else:
+                raise ParseError("AFTER MATCH SKIP PAST LAST ROW|TO NEXT ROW")
+        if not self.accept_soft("pattern"):
+            raise ParseError("MATCH_RECOGNIZE requires PATTERN (...)")
+        self.expect_op("(")
+        pattern = self._pattern_alt()
+        self.expect_op(")")
+        defines: List[tuple] = []
+        if self.accept_soft("define"):
+            while True:
+                var = self.ident().lower()
+                self.expect_kw("as")
+                defines.append((var, self.expr()))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().text
+        return ast.MatchRecognize(
+            rel, tuple(partition), tuple(order), tuple(measures),
+            pattern, tuple(defines), after, alias,
+        )
+
+    def _pattern_alt(self) -> ast.PatternTerm:
+        branches = [self._pattern_seq()]
+        while self.accept_op("|"):
+            branches.append(self._pattern_seq())
+        if len(branches) == 1:
+            return branches[0]
+        return ast.PatternTerm("alt", items=tuple(branches))
+
+    def _pattern_seq(self) -> ast.PatternTerm:
+        items: List[ast.PatternTerm] = []
+        while True:
+            t = self.peek()
+            if t.kind == "op" and t.text == "(":
+                self.next()
+                inner = self._pattern_alt()
+                self.expect_op(")")
+                atom = ast.PatternTerm("group", items=(inner,))
+            elif t.kind in ("ident", "kw") and t.text not in (")", "|"):
+                if t.kind == "kw" and t.text in ("define",):
+                    break
+                atom = ast.PatternTerm("var", var=self.next().text.lower())
+            else:
+                break
+            q = ""
+            greedy = True
+            nt = self.peek()
+            if nt.kind == "op" and nt.text in ("*", "+", "?"):
+                q = self.next().text
+                if self.peek().kind == "op" and self.peek().text == "?":
+                    self.next()
+                    greedy = False
+            atom = dataclasses.replace(atom, quantifier=q, greedy=greedy)
+            items.append(atom)
+        if len(items) == 1:
+            return items[0]
+        return ast.PatternTerm("group", items=tuple(items))
+
     def relation_primary(self) -> ast.Node:
         t = self.peek()
         if (t.kind == "ident" and t.text.lower() == "unnest"
@@ -758,6 +868,10 @@ class Parser:
                 raise ParseError("TABLESAMPLE percentage must be a number")
             self.expect_op(")")
             sample = (method, float(pct.text))
+        if (self.peek().kind == "ident"
+                and self.peek().text.lower() == "match_recognize"):
+            self.next()
+            return self._match_recognize(ast.Table(name, None, sample))
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()
