@@ -229,6 +229,18 @@ class LocalExecutor:
                 self.config.get("group_capacity", DEFAULT_GROUP_CAPACITY)
             )
             self.join_factor = 1
+            # start at the last successful capacities for this plan: the
+            # overflow ladder re-runs (and on first touch, re-COMPILES) the
+            # whole fragment per rung, so remembering the landing spot makes
+            # warm repeats single-shot (FlatHash keeps its size the same way)
+            hints = self.config.get("capacity_hints")
+            hint = hints.get(id(plan)) if hints is not None else None
+            if hint is not None:
+                self.group_capacity, self.join_factor = hint
+            else:
+                est = self._estimate_group_capacity(plan, counts)
+                if est is not None:
+                    self.group_capacity = max(self.group_capacity, est)
 
             use_jit = (
                 self.config.get("jit_fragments")
@@ -244,14 +256,20 @@ class LocalExecutor:
                     ctx = self.trace_ctx_cls(self, scans, counts)
                     out_lanes, sel, ordered, checks = self._run(plan, ctx)
                     dups = ctx.dup_checks
-                for join_node, dup in dups:
+                # one round trip for all control scalars (the accelerator
+                # may sit behind a high-latency tunnel: per-scalar int()
+                # costs one RTT each)
+                dup_vals, check_vals = jax.device_get(
+                    ([d for _, d in dups], [ng for ng, _ in checks])
+                )
+                for (join_node, _), dup in zip(dups, dup_vals):
                     if int(dup) > 0:
                         raise ExecutionError(
                             "join build side has duplicate keys (many-to-many "
                             f"join not yet supported): {join_node.criteria}"
                         )
                 overflow = False
-                for ngroups, cap in checks:
+                for ngroups, (_, cap) in zip(check_vals, checks):
                     if int(ngroups) > cap:
                         overflow = True
                 if not overflow:
@@ -261,6 +279,8 @@ class LocalExecutor:
             else:
                 raise ExecutionError("group capacity overflow after retries")
 
+            if hints is not None:
+                hints[id(plan)] = (self.group_capacity, self.join_factor)
             return self._materialize(plan, out_lanes, sel, ordered)
         finally:
             if pool is not None:
@@ -492,6 +512,52 @@ class LocalExecutor:
         raise KeyError(col)
 
     # ------------------------------------------------------------------
+    def _estimate_group_capacity(self, plan: P.PlanNode, counts) -> Optional[int]:
+        """Initial sort-group-by capacity from connector NDV statistics
+        (the CBO's AggregationStatsRule role): every overflow rung re-runs
+        and re-compiles the fragment, so landing near the real group count
+        on the first try matters.  Bounded by the scan row count (a group
+        per input row at worst)."""
+        ndv: Dict[str, float] = {}
+        max_rows = max(counts.values(), default=0)
+
+        def walk(n: P.PlanNode):
+            if isinstance(n, P.TableScan):
+                try:
+                    stats = self.metadata.table_statistics(n.catalog, n.table)
+                except Exception:
+                    return
+                for sym, col in n.assignments:
+                    cs = stats.columns.get(col)
+                    if cs is not None and cs.distinct_count:
+                        ndv[sym] = cs.distinct_count
+                    else:
+                        ndv.setdefault(sym, stats.row_count)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        best = None
+
+        def walk2(n: P.PlanNode):
+            nonlocal best
+            if isinstance(n, P.Aggregate) and n.keys:
+                est = 1.0
+                for k in n.keys:
+                    est *= ndv.get(k, float(DEFAULT_GROUP_CAPACITY))
+                    if est > 1e12:
+                        break
+                est = min(est, float(max_rows) or est)
+                best = max(best or 0, int(est))
+            for s in n.sources:
+                walk2(s)
+
+        walk2(plan)
+        if best is None or best <= DEFAULT_GROUP_CAPACITY:
+            return None
+        return _pad_capacity(min(best * 2, max_rows))
+
+    # ------------------------------------------------------------------
     def _run_jitted(self, plan: P.Output, scans, counts):
         """One jitted XLA program per fragment (the architecture's codegen
         slot: LocalExecutionPlanner -> generated bytecode in the reference,
@@ -551,19 +617,19 @@ class LocalExecutor:
 
     # ------------------------------------------------------------------
     def _materialize(self, plan: P.Output, lanes, sel, ordered) -> Page:
-        sel_np = np.asarray(sel)
+        # single device->host transfer for the selection mask and every
+        # output lane (per-array np.asarray would pay one tunnel RTT each)
+        host_lanes, sel_np = jax.device_get(
+            ({s: lanes[s] for s in plan.symbols}, sel)
+        )
         types = plan.source.output_types()
         cols = []
-        if ordered:
-            # rows already in order; selected prefix semantics
-            idx = np.nonzero(sel_np)[0]
-        else:
-            idx = np.nonzero(sel_np)[0]
+        idx = np.nonzero(sel_np)[0]
         n = len(idx)
         for name, sym in zip(plan.names, plan.symbols):
-            v, ok = lanes[sym]
-            vals = np.asarray(v)[idx]
-            valid = np.asarray(ok)[idx]
+            v, ok = host_lanes[sym]
+            vals = v[idx]
+            valid = ok[idx]
             t = types[sym]
             validity = None if valid.all() else valid
             cols.append(Column(t, vals, validity, self.dicts.get(sym)))

@@ -206,8 +206,48 @@ def distinct_count(
                                num_segments=capacity)
 
 
+# Scatter-add is slow on TPU (no native scatter unit): for small group
+# capacities a one-hot masked reduction is several times faster (measured
+# ~0.15s vs ~0.6s for 6M rows x 12 groups on v5e), so segment reductions
+# pick their implementation by capacity and backend.
+_SMALL_SEG_CAP = 32
+
+
+def _use_masked(cap: int) -> bool:
+    try:
+        return cap <= _SMALL_SEG_CAP and jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def _seg_sum(v, gid, cap):
+    if _use_masked(cap) and v.ndim == 1:
+        m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
+        zero = jnp.zeros((), dtype=v.dtype)
+        return jnp.sum(jnp.where(m, v[None, :], zero), axis=1)
     return jax.ops.segment_sum(v, gid, num_segments=cap)
+
+
+def _seg_min(v, gid, cap):
+    if _use_masked(cap) and v.ndim == 1:
+        if v.dtype.kind == "f":
+            sent = jnp.asarray(jnp.inf, dtype=v.dtype)
+        else:
+            sent = jnp.asarray(jnp.iinfo(v.dtype).max, dtype=v.dtype)
+        m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
+        return jnp.min(jnp.where(m, v[None, :], sent), axis=1)
+    return jax.ops.segment_min(v, gid, num_segments=cap)
+
+
+def _seg_max(v, gid, cap):
+    if _use_masked(cap) and v.ndim == 1:
+        if v.dtype.kind == "f":
+            sent = jnp.asarray(-jnp.inf, dtype=v.dtype)
+        else:
+            sent = jnp.asarray(jnp.iinfo(v.dtype).min, dtype=v.dtype)
+        m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
+        return jnp.max(jnp.where(m, v[None, :], sent), axis=1)
+    return jax.ops.segment_max(v, gid, num_segments=cap)
 
 
 def _splitmix64(v: jnp.ndarray) -> jnp.ndarray:
@@ -236,7 +276,7 @@ def _segment_bitwise(vals, live, gid, cap, op: str, live_cnt=None):
         jnp.int32
     )
     bits = jnp.where(live[:, None], bits, 0)
-    sums = jax.ops.segment_sum(bits, gid, num_segments=cap)  # [cap, 64]
+    sums = _seg_sum(bits, gid, cap)  # [cap, 64]
     if live_cnt is None:
         live_cnt = _seg_sum(live.astype(jnp.int32), gid, cap)
     if op == "or":
@@ -264,12 +304,11 @@ def _first_by_key(xlane, key, live, gid, cap, take_min: bool):
     else:
         sentinel = I64_MAX if take_min else -I64_MAX
         kv = jnp.where(live, key.astype(jnp.int64), sentinel)
-    seg = jax.ops.segment_min if take_min else jax.ops.segment_max
-    extremum = seg(kv, gid, num_segments=cap)
+    seg = _seg_min if take_min else _seg_max
+    extremum = seg(kv, gid, cap)
     cand = live & (kv == extremum[gid])
-    ridx = jax.ops.segment_min(
-        jnp.where(cand, jnp.arange(n, dtype=jnp.int64), n), gid,
-        num_segments=cap,
+    ridx = _seg_min(
+        jnp.where(cand, jnp.arange(n, dtype=jnp.int64), n), gid, cap
     )
     has = ridx < n
     safe = jnp.clip(ridx, 0, n - 1)
@@ -386,8 +425,8 @@ def accumulate(
             else:
                 sentinel = I64_MAX if s.kind == "min" else -I64_MAX
                 vv = jnp.where(live, v.astype(jnp.int64), sentinel)
-            seg = jax.ops.segment_min if s.kind == "min" else jax.ops.segment_max
-            out[f"{o}$val"] = seg(vv, gid, num_segments=cap)
+            seg = _seg_min if s.kind == "min" else _seg_max
+            out[f"{o}$val"] = seg(vv, gid, cap)
             out[f"{o}$valid"] = _seg_sum(live.astype(jnp.int64), gid, cap)
         elif s.kind in MOMENT_KINDS:
             sm, sq, cnt = _moment_sums(v, live, gid, cap, s.input_type)
@@ -413,10 +452,10 @@ def accumulate(
             cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
             if s.kind == "bool_and":
                 vv = jnp.where(live, v.astype(jnp.int64), 1)
-                out[f"{o}$val"] = jax.ops.segment_min(vv, gid, num_segments=cap)
+                out[f"{o}$val"] = _seg_min(vv, gid, cap)
             else:
                 vv = jnp.where(live, v.astype(jnp.int64), 0)
-                out[f"{o}$val"] = jax.ops.segment_max(vv, gid, num_segments=cap)
+                out[f"{o}$val"] = _seg_max(vv, gid, cap)
             out[f"{o}$valid"] = cnt
         elif s.kind in BITWISE_KINDS:
             op = {"bitwise_and_agg": "and", "bitwise_or_agg": "or",
@@ -434,9 +473,8 @@ def accumulate(
             out[f"{o}$valid"] = _seg_sum(sel.astype(jnp.int64), gid, cap)
         elif s.kind == "arbitrary":
             n = gid.shape[0]
-            ridx = jax.ops.segment_min(
-                jnp.where(live, jnp.arange(n, dtype=jnp.int64), n), gid,
-                num_segments=cap,
+            ridx = _seg_min(
+                jnp.where(live, jnp.arange(n, dtype=jnp.int64), n), gid, cap
             )
             has = ridx < n
             safe = jnp.clip(ridx, 0, n - 1)
@@ -506,8 +544,8 @@ def merge_accumulators(
             else:
                 sentinel = I64_MAX if s.kind == "min" else -I64_MAX
             vv = jnp.where(has, sv, sentinel)
-            seg = jax.ops.segment_min if s.kind == "min" else jax.ops.segment_max
-            out[f"{o}$val"] = seg(vv, gid, num_segments=cap)
+            seg = _seg_min if s.kind == "min" else _seg_max
+            out[f"{o}$val"] = seg(vv, gid, cap)
             out[f"{o}$valid"] = _seg_sum(jnp.where(w, cv, 0), gid, cap)
         elif s.kind in ("bool_and", "bool_or"):
             sv, _ = acc_lanes[f"{o}$val"]
@@ -515,10 +553,10 @@ def merge_accumulators(
             has = w & (cv > 0)
             if s.kind == "bool_and":
                 vv = jnp.where(has, sv, 1)
-                out[f"{o}$val"] = jax.ops.segment_min(vv, gid, num_segments=cap)
+                out[f"{o}$val"] = _seg_min(vv, gid, cap)
             else:
                 vv = jnp.where(has, sv, 0)
-                out[f"{o}$val"] = jax.ops.segment_max(vv, gid, num_segments=cap)
+                out[f"{o}$val"] = _seg_max(vv, gid, cap)
             out[f"{o}$valid"] = _seg_sum(jnp.where(w, cv, 0), gid, cap)
         elif s.kind in BITWISE_KINDS:
             sv, _ = acc_lanes[f"{o}$val"]
@@ -536,9 +574,8 @@ def merge_accumulators(
             cv, _ = acc_lanes[f"{o}$valid"]
             has = w & (cv > 0)
             n = gid.shape[0]
-            ridx = jax.ops.segment_min(
-                jnp.where(has, jnp.arange(n, dtype=jnp.int64), n), gid,
-                num_segments=cap,
+            ridx = _seg_min(
+                jnp.where(has, jnp.arange(n, dtype=jnp.int64), n), gid, cap
             )
             ok2 = ridx < n
             safe = jnp.clip(ridx, 0, n - 1)
@@ -679,9 +716,8 @@ def group_keys_output(
 ) -> List[Lane]:
     """Representative key values per group id (first selected row wins)."""
     n = gid.shape[0]
-    first = jax.ops.segment_min(
-        jnp.where(sel, jnp.arange(n, dtype=jnp.int64), n), gid,
-        num_segments=capacity,
+    first = _seg_min(
+        jnp.where(sel, jnp.arange(n, dtype=jnp.int64), n), gid, capacity
     )
     present = first < n
     safe = jnp.clip(first, 0, n - 1)

@@ -91,6 +91,7 @@ class Session:
         # jitted XLA executable (one program per fragment)
         self._jit_cache: dict = {}
         self._plan_cache: dict = {}
+        self._capacity_hints: dict = {}
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
@@ -115,6 +116,7 @@ class Session:
             self.properties.get("jit_fragments")
         )
         exec_config["jit_cache"] = self._jit_cache
+        exec_config["capacity_hints"] = self._capacity_hints
         if self.properties.get("distributed"):
             from .parallel.mesh_executor import MeshExecutor, default_mesh
 
@@ -440,6 +442,7 @@ class Session:
             # compiled fragments are stale
             self._plan_cache.clear()
             self._jit_cache.clear()
+            self._capacity_hints.clear()
             plan = self._plan_stmt(stmt)
         self._check_plan_access(plan, identity)
         executor = self._executor()
