@@ -47,7 +47,8 @@ def session():
 def oracle_conn():
     conn = sqlite3.connect(":memory:")
     for table in (
-        "date_dim", "item", "store_sales", "customer_demographics", "promotion"
+        "date_dim", "item", "store_sales", "customer_demographics",
+        "promotion", "store",
     ):
         schema = tpcds.SCHEMAS[table]
         conn.execute(
@@ -99,3 +100,104 @@ def test_tpcds_q7(session, oracle_conn):
     actual = session.execute(Q7).to_pylist()
     expected = oracle_conn.execute(Q7).fetchall()
     assert_rows_match(actual, expected, tol=2e-2)
+
+
+Q42 = """
+select dt.d_year, item.i_category_id, item.i_category,
+       sum(ss_ext_sales_price) as total
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11 and dt.d_year = 2000
+group by dt.d_year, item.i_category_id, item.i_category
+order by total desc, dt.d_year, item.i_category_id, item.i_category
+limit 100
+"""
+
+Q52 = """
+select dt.d_year, item.i_brand_id as brand_id, item.i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim dt, store_sales, item
+where dt.d_date_sk = store_sales.ss_sold_date_sk
+  and store_sales.ss_item_sk = item.i_item_sk
+  and item.i_manager_id = 1
+  and dt.d_moy = 11 and dt.d_year = 2000
+group by dt.d_year, item.i_brand, item.i_brand_id
+order by dt.d_year, ext_price desc, brand_id
+limit 100
+"""
+
+Q55 = """
+select i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11 and d_year = 1999
+group by i_brand, i_brand_id
+order by ext_price desc, brand_id
+limit 100
+"""
+
+Q43 = """
+select s_store_name, s_store_id, sum(ss_sales_price) as total
+from date_dim, store_sales, store
+where d_date_sk = ss_sold_date_sk
+  and s_store_sk = ss_store_sk
+  and d_year = 2000
+group by s_store_name, s_store_id
+order by s_store_name, s_store_id, total
+limit 100
+"""
+
+Q27 = """
+select i_item_id, s_store_id,
+       avg(ss_quantity) as agg1,
+       avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3,
+       avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, store, item
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_store_sk = s_store_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and d_year = 2002
+group by i_item_id, s_store_id
+order by i_item_id, s_store_id
+limit 100
+"""
+
+
+def test_tpcds_q42(session, oracle_conn):
+    assert_rows_match(
+        session.execute(Q42).to_pylist(), oracle_conn.execute(Q42).fetchall()
+    )
+
+
+def test_tpcds_q52(session, oracle_conn):
+    assert_rows_match(
+        session.execute(Q52).to_pylist(), oracle_conn.execute(Q52).fetchall()
+    )
+
+
+def test_tpcds_q55(session, oracle_conn):
+    assert_rows_match(
+        session.execute(Q55).to_pylist(), oracle_conn.execute(Q55).fetchall()
+    )
+
+
+def test_tpcds_q43(session, oracle_conn):
+    assert_rows_match(
+        session.execute(Q43).to_pylist(), oracle_conn.execute(Q43).fetchall()
+    )
+
+
+def test_tpcds_q27(session, oracle_conn):
+    assert_rows_match(
+        session.execute(Q27).to_pylist(), oracle_conn.execute(Q27).fetchall()
+    )

@@ -81,6 +81,7 @@ SCHEMAS: Dict[str, List[Tuple[str, T.Type]]] = {
         ("i_brand_id", T.BIGINT),
         ("i_brand", T.VARCHAR),
         ("i_manufact_id", T.BIGINT),
+        ("i_manager_id", T.BIGINT),
         ("i_category_id", T.BIGINT),
         ("i_category", T.VARCHAR),
         ("i_class_id", T.BIGINT),
@@ -198,6 +199,8 @@ def generate(
                 dicts[c] = BRANDS
             elif c == "i_manufact_id":
                 values[c] = uint_in(c, idx, 1, 1000)
+            elif c == "i_manager_id":
+                values[c] = uint_in(c, idx, 1, 100)
             elif c == "i_category_id":
                 values[c] = uint_in(c, idx, 1, 10)
             elif c == "i_category":
