@@ -198,3 +198,17 @@ def test_map_keys_values_concat(session):
 def test_map_duplicate_keys_rejected(session):
     with pytest.raises(SemanticError):
         session.execute("select map(array['a','a'], array[1,2])")
+
+
+def test_left_join_unnest_preserves_empty():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (k bigint, a array(bigint))")
+    s.execute("insert into t values (1, array[10, 20]), (2, array[]), (3, null)")
+    assert s.execute(
+        "select k, x from t left join unnest(a) as u(x) on true order by k, x"
+    ).to_pylist() == [(1, 10), (1, 20), (2, None), (3, None)]
+    # cross join drops empty/null-array rows
+    assert s.execute(
+        "select k, x from t cross join unnest(a) as u(x) order by k, x"
+    ).to_pylist() == [(1, 10), (1, 20)]

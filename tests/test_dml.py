@@ -262,3 +262,21 @@ def test_merge_invalid_clause_rejected(session):
     with pytest.raises(SemanticError):
         session.execute("""merge into tgt t using src s on t.k = s.k
             when not matched then update set v = 'x'""")
+
+
+def test_insert_duplicate_column_rejected(session):
+    rows(session, "create table t (a bigint, b bigint)")
+    with pytest.raises(SemanticError):
+        session.execute("insert into t (a, a) values (1, 2)")
+
+
+def test_python_api_write_invalidates_compiled_fragments(session):
+    # the memory connector bumps data_version on python-API writes; compiled
+    # fragments must not reuse stale dictionary snapshots
+    conn = session.catalogs.get("memory")
+    from trino_tpu import types as T
+
+    conn.create_table("vt", [("b", T.VARCHAR)], {"b": ["x", "y"]})
+    assert rows(session, "select b from vt order by b") == [("x",), ("y",)]
+    conn.create_table("vt", [("b", T.VARCHAR)], {"b": ["p", "q"]})
+    assert rows(session, "select b from vt order by b") == [("p",), ("q",)]

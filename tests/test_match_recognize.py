@@ -119,3 +119,14 @@ def test_varchar_measures_and_defines():
         define v as kind = 'view', c as kind = 'cart', b as kind = 'buy'
     ) order by u""").to_pylist()
     assert out == [(1, "view", "buy")]
+
+
+def test_prev_with_qualified_column(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures last(price) as p
+        one row per match
+        pattern (d)
+        define d as d.price < prev(d.price)
+    ) where sym = 'B'""").to_pylist()
+    assert out == [("B", 4)]

@@ -101,3 +101,10 @@ def test_explain_analyze_matches_plain_execution(session):
     lines = rows(session, "explain analyze select count(*) from lineitem")
     assert any("Aggregate" in r[0] for r in lines)
     assert plain == rows(session, "select count(*) from lineitem")
+
+
+def test_reprepare_invalidates_plan_cache(session):
+    session.execute("prepare rp from select 41")
+    assert rows(session, "execute rp") == [(41,)]
+    session.execute("prepare rp from select 42")
+    assert rows(session, "execute rp") == [(42,)]

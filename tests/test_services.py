@@ -203,3 +203,13 @@ def test_proxy_forwards_auth():
     finally:
         proxy.stop()
         backend.stop()
+
+
+def test_replace_function_invalidates_cached_plans(session):
+    rows(session, "create function cf(x bigint) returns bigint return x + 1")
+    assert rows(session, "select cf(1)") == [(2,)]
+    rows(
+        session,
+        "create or replace function cf(x bigint) returns bigint return x * 10",
+    )
+    assert rows(session, "select cf(1)") == [(10,)]

@@ -180,3 +180,12 @@ def test_explain_distributed():
     text = "\n".join(lines)
     assert "Fragment 1" in text and "step=partial" in text
     assert "step=final" in text and "RemoteSource" in text
+
+
+def test_tablesample_after_alias():
+    s = tpch_session(0.01)
+    n = s.execute(
+        "select count(*) from orders o tablesample bernoulli (10)"
+    ).to_pylist()[0][0]
+    total = s.execute("select count(*) from orders").to_pylist()[0][0]
+    assert 0 < n < total

@@ -62,8 +62,8 @@ class DeviceScanCache:
 
     def put(self, key: tuple, entry: dict, nbytes: int):
         while self.bytes + nbytes > self.max_bytes and self.entries:
-            _, old = self.entries.popitem()
-            self.bytes -= old.get("nbytes", 0)
+            oldest = next(iter(self.entries))
+            self.bytes -= self.entries.pop(oldest).get("nbytes", 0)
         entry["nbytes"] = nbytes
         self.entries[key] = entry
         self.bytes += nbytes
@@ -236,7 +236,7 @@ class LocalExecutor:
             hints = self.config.get("capacity_hints")
             hint = hints.get(id(plan)) if hints is not None else None
             if hint is not None:
-                self.group_capacity, self.join_factor = hint
+                self.group_capacity, self.join_factor, _ = hint
             else:
                 est = self._estimate_group_capacity(plan, counts)
                 if est is not None:
@@ -246,6 +246,13 @@ class LocalExecutor:
                 self.config.get("jit_fragments")
                 and not self.config.get("collect_node_stats")
                 and not _contains(plan, (P.Unnest, P.MatchRecognize))
+                # unversioned sources (system tables, hive files) may change
+                # without shape changes: no safe compiled-fragment reuse
+                and all(
+                    self._scan_keys.get(nid) is not None
+                    for nid in scans
+                    if nid in self._scan_nodes
+                )
             )
             for attempt in range(5):
                 if use_jit:
@@ -280,7 +287,12 @@ class LocalExecutor:
                 raise ExecutionError("group capacity overflow after retries")
 
             if hints is not None:
-                hints[id(plan)] = (self.group_capacity, self.join_factor)
+                # the plan reference keeps id(plan) stable (no reuse after gc)
+                hints[id(plan)] = (
+                    self.group_capacity, self.join_factor, plan,
+                )
+                for k in list(hints)[:-512]:
+                    hints.pop(k, None)
             return self._materialize(plan, out_lanes, sel, ordered)
         finally:
             if pool is not None:
@@ -575,7 +587,13 @@ class LocalExecutor:
         }
         key = (
             id(plan), self.group_capacity, self.join_factor,
-            tuple(sorted((nid, counts[nid]) for nid in scans)),
+            # scan-cache keys embed the connector data_version, so a write
+            # that keeps row counts constant still recompiles (and refreshes
+            # the dictionary snapshot)
+            tuple(sorted(
+                (nid, counts[nid], self._scan_keys.get(nid))
+                for nid in scans
+            )),
         )
         entry = cache.get(key)
         if entry is None:
@@ -782,13 +800,16 @@ class _TraceCtx:
             ],
             dtype=np.int64,
         )
-        total = int(lengths.sum())
+        eff = np.maximum(lengths, 1) if node.outer else lengths
+        total = int(eff.sum())
         cap = _pad_capacity(max(total, 1))
-        rep = np.repeat(rows, lengths)  # source row per output row
+        rep = np.repeat(rows, eff)  # source row per output row
         elems: list = []
         for c, ok, ln in zip(codes, avalid, lengths):
             if ln:
                 elems.extend(entries[c])
+            elif node.outer:
+                elems.append(None)  # LEFT JOIN UNNEST: NULL element row
         lanes = {}
         for sym, (v, ok) in b.lanes.items():
             if sym == node.array_symbol:
@@ -823,8 +844,9 @@ class _TraceCtx:
             jnp.asarray(pad_to(eo, cap, False)),
         )
         if node.ordinality_symbol:
+            src_lens = eff if node.outer else lengths
             ords = np.concatenate(
-                [np.arange(1, ln + 1, dtype=np.int64) for ln in lengths]
+                [np.arange(1, ln + 1, dtype=np.int64) for ln in src_lens]
             ) if total else np.zeros(0, dtype=np.int64)
             lanes[node.ordinality_symbol] = (
                 jnp.asarray(pad_to(ords, cap)),

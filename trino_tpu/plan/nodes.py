@@ -109,6 +109,8 @@ class Unnest(PlanNode):
     element_symbol: str
     element_type: T.Type
     ordinality_symbol: Optional[str] = None
+    # LEFT JOIN UNNEST: rows with empty/NULL arrays emit one NULL-element row
+    outer: bool = False
 
     @property
     def sources(self):

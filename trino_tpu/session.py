@@ -182,6 +182,13 @@ class Session:
                            identity=None) -> Page:
         if identity is None:
             identity = self.identity
+        if not isinstance(stmt, ast.Query):
+            # any non-query statement may change planning state (functions,
+            # prepared statements, default catalog, tables, session config):
+            # cached plans and compiled fragments are stale
+            self._plan_cache.clear()
+            self._jit_cache.clear()
+            self._capacity_hints.clear()
         if isinstance(stmt, ast.SetSession):
             self.access_control.check_can_set_session(identity, stmt.name)
             self.properties.set(stmt.name, stmt.value)
@@ -438,12 +445,7 @@ class Session:
                     self._plan_cache.pop(k, None)
             plan = cached
         else:
-            # writes/DDL may change data or functions: planning state and
-            # compiled fragments are stale
-            self._plan_cache.clear()
-            self._jit_cache.clear()
-            self._capacity_hints.clear()
-            plan = self._plan_stmt(stmt)
+            plan = self._plan_stmt(stmt)  # caches cleared at dispatch above
         self._check_plan_access(plan, identity)
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):

@@ -212,12 +212,15 @@ class Analyzer:
         )
         if stmt.columns:
             known = {c.name for c in schema.columns}
+            targets = []
             for c in stmt.columns:
                 if c.lower() not in known:
                     raise SemanticError(
                         f"column {c} not in table {schema.name}"
                     )
-            targets = [c.lower() for c in stmt.columns]
+                if c.lower() in targets:
+                    raise SemanticError(f"duplicate insert column {c}")
+                targets.append(c.lower())
         else:
             targets = [c.name for c in schema.columns]
         rp, _ = self.plan_query(stmt.query)
@@ -1434,7 +1437,7 @@ class Analyzer:
         return RelationPlan(node, Scope(fields))
 
     def _plan_unnest(
-        self, left: RelationPlan, u: ast.UnnestRelation
+        self, left: RelationPlan, u: ast.UnnestRelation, outer: bool = False
     ) -> RelationPlan:
         """CROSS JOIN UNNEST(arr): one output row per array element, left
         columns replicated (UnnestNode + UnnestOperator; the reference also
@@ -1459,7 +1462,7 @@ class Analyzer:
         elem_t = arr.type.element
         elem_sym = self.symbols.new("unnest")
         ord_sym = self.symbols.new("ordinality") if u.ordinality else None
-        node = P.Unnest(root, arr_sym, elem_sym, elem_t, ord_sym)
+        node = P.Unnest(root, arr_sym, elem_sym, elem_t, ord_sym, outer)
         cols = list(u.columns) if u.columns else []
         elem_name = (cols[0] if cols else (u.alias or "unnest")).lower()
         fields = list(left.scope.fields)
@@ -1512,8 +1515,14 @@ class Analyzer:
         if isinstance(j.right, ast.UnnestRelation):
             if j.kind not in ("cross", "inner", "left"):
                 raise SemanticError(f"{j.kind} JOIN UNNEST is not supported")
+            if j.kind == "left" and j.condition is not None:
+                c = j.condition
+                if not (isinstance(c, ast.Literal) and c.value is True):
+                    raise SemanticError(
+                        "LEFT JOIN UNNEST supports ON TRUE only"
+                    )
             left = self.plan_relation(j.left)
-            return self._plan_unnest(left, j.right)
+            return self._plan_unnest(left, j.right, outer=(j.kind == "left"))
         left = self.plan_relation(j.left)
         right = self.plan_relation(j.right)
         scope = Scope(left.scope.fields + right.scope.fields)
@@ -2665,7 +2674,10 @@ class MrExprAnalyzer(ExprAnalyzer):
             if not e.args:
                 raise SemanticError(f"{e.name}() requires an argument")
             if nav in ("__mr_prev__", "__mr_next__"):
-                arg = self._an(e.args[0])
+                # PREV(A.price) navigates PHYSICAL rows (the variable
+                # qualifier is irrelevant to PREV/NEXT in the reference too)
+                qual = self._var_ref(e.args[0])
+                arg = qual[0] if qual is not None else self._an(e.args[0])
                 if not isinstance(arg, ir.ColumnRef):
                     raise SemanticError(
                         f"{e.name}() supports column references only"

@@ -803,6 +803,20 @@ class Parser:
             return items[0]
         return ast.PatternTerm("group", items=tuple(items))
 
+    def _sample_clause(self):
+        t2 = self.next()
+        if t2.kind != "ident" or t2.text.lower() not in (
+            "bernoulli", "system",
+        ):
+            raise ParseError("TABLESAMPLE BERNOULLI|SYSTEM (p)")
+        method = t2.text.lower()
+        self.expect_op("(")
+        pct = self.next()
+        if pct.kind != "number":
+            raise ParseError("TABLESAMPLE percentage must be a number")
+        self.expect_op(")")
+        return (method, float(pct.text))
+
     def relation_primary(self) -> ast.Node:
         t = self.peek()
         if (t.kind == "ident" and t.text.lower() == "unnest"
@@ -856,18 +870,7 @@ class Parser:
         name = self.qualified_name()
         sample = None
         if self.accept_soft("tablesample"):
-            t2 = self.next()
-            if t2.kind != "ident" or t2.text.lower() not in (
-                "bernoulli", "system",
-            ):
-                raise ParseError("TABLESAMPLE BERNOULLI|SYSTEM (p)")
-            method = t2.text.lower()
-            self.expect_op("(")
-            pct = self.next()
-            if pct.kind != "number":
-                raise ParseError("TABLESAMPLE percentage must be a number")
-            self.expect_op(")")
-            sample = (method, float(pct.text))
+            sample = self._sample_clause()
         if (self.peek().kind == "ident"
                 and self.peek().text.lower() == "match_recognize"):
             self.next()
@@ -875,8 +878,12 @@ class Parser:
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()
-        elif self.peek().kind == "ident":
+        elif (self.peek().kind == "ident"
+              and self.peek().text.lower() != "tablesample"):
             alias = self.next().text
+        if sample is None and self.accept_soft("tablesample"):
+            # grammar-conformant order: alias before TABLESAMPLE
+            sample = self._sample_clause()
         return ast.Table(name, alias, sample)
 
     def qualified_name(self) -> Tuple[str, ...]:
