@@ -222,6 +222,17 @@ def _use_masked(cap: int) -> bool:
 
 def _seg_sum(v, gid, cap):
     if _use_masked(cap) and v.ndim == 1:
+        import os
+
+        if os.environ.get("TRINO_TPU_PALLAS") == "1" and v.dtype in (
+            jnp.int64, jnp.dtype("int64"),
+        ):
+            # opt-in hand-tiled pallas kernel (ops/pallas_kernels.py):
+            # one streaming pass over the input for ALL groups
+            from .pallas_kernels import HAVE_PALLAS, grouped_sum_i64
+
+            if HAVE_PALLAS:
+                return grouped_sum_i64(v, gid, cap)
         m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
         zero = jnp.zeros((), dtype=v.dtype)
         return jnp.sum(jnp.where(m, v[None, :], zero), axis=1)
