@@ -130,3 +130,30 @@ def test_prev_with_qualified_column(session):
         define d as d.price < prev(d.price)
     ) where sym = 'B'""").to_pylist()
     assert out == [("B", 4)]
+
+
+def test_all_rows_per_match(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures classifier() as cls, match_number() as mno
+        all rows per match
+        pattern (strt down+ up+)
+        define down as price < prev(price), up as price > prev(price)
+    ) where sym = 'A' order by ts""").to_pylist()
+    # columns: sym, ts, price, cls, mno — every mapped row of the match
+    assert [r[3] for r in out] == ["STRT", "DOWN", "DOWN", "UP", "UP"]
+    assert all(r[4] == 1 for r in out)
+    assert [r[1] for r in out] == [1, 2, 3, 4, 5]
+
+
+def test_all_rows_running_measures(session):
+    out = session.execute("""select * from trades match_recognize (
+        partition by sym order by ts
+        measures last(down.price) as last_down
+        all rows per match
+        pattern (strt down+)
+        define down as price < prev(price)
+    ) where sym = 'A' order by ts""").to_pylist()
+    # RUNNING: first row of each match has no DOWN mapped yet -> NULL;
+    # two matches in A: (10,8,7) and (12,11)
+    assert [r[3] for r in out] == [None, 8, 7, None, 11]

@@ -921,12 +921,18 @@ class _TraceCtx:
                 j += 1
             part_idx = order[i:j]
             pcols = {c: [vals[k] for k in part_idx] for c, vals in cols.items()}
+            all_rows = node.rows_per_match == "all"
             for m in find_matches(
                 pcols, len(part_idx), node.pattern, defines, measures,
-                node.after_match,
+                node.after_match, all_rows,
             ):
-                for s, v in zip(node.partition_by, pkey):
-                    m[s] = v
+                if all_rows:
+                    r = m.pop("__row__")
+                    for c in pcols:
+                        m[c] = pcols[c][r]
+                else:
+                    for s, v in zip(node.partition_by, pkey):
+                        m[s] = v
                 out_rows.append(m)
             i = j
         total = len(out_rows)

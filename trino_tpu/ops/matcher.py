@@ -165,10 +165,14 @@ def find_matches(
     defines: Dict[str, ir.Expr],
     measures: Sequence[Tuple[str, ir.Expr]],
     after_match: str = "past_last_row",
+    all_rows: bool = False,
 ) -> List[dict]:
-    """Run the automaton over one partition; returns one dict per match
-    with measure values (ONE ROW PER MATCH semantics: measures evaluated
-    FINAL, on the last mapped row)."""
+    """Run the automaton over one partition.
+
+    ONE ROW PER MATCH (all_rows=False): one dict per match, measures
+    evaluated FINAL on the last mapped row.  ALL ROWS PER MATCH: one dict
+    per MAPPED ROW with measures evaluated at that row (RUNNING semantics)
+    plus '__row__' = the partition-relative source row index."""
     ctx = MatchContext(columns, nrows)
     out: List[dict] = []
     start = 0
@@ -183,11 +187,23 @@ def find_matches(
             start += 1
             continue
         ctx.match_number += 1
-        last_row = ctx.bindings[-1][0] if ctx.bindings else start
-        row = {}
-        for name, expr in measures:
-            row[name] = ctx.eval(expr, last_row)
-        out.append(row)
+        if all_rows:
+            match_rows = [r for r, _ in ctx.bindings]
+            for r in match_rows:
+                row = {"__row__": r}
+                # RUNNING semantics: navigation sees the mapping up to r
+                full = list(ctx.bindings)
+                ctx.bindings = [b for b in full if b[0] <= r]
+                for name, expr in measures:
+                    row[name] = ctx.eval(expr, r)
+                ctx.bindings = full
+                out.append(row)
+        else:
+            last_row = ctx.bindings[-1][0] if ctx.bindings else start
+            row = {}
+            for name, expr in measures:
+                row[name] = ctx.eval(expr, last_row)
+            out.append(row)
         if after_match == "to_next_row":
             start = start + 1
         else:

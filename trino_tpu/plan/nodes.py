@@ -81,17 +81,27 @@ class MatchRecognize(PlanNode):
     defines: Tuple[Tuple[str, ir.Expr], ...]
     measures: Tuple[Tuple[str, ir.Expr, T.Type], ...]  # (symbol, expr, type)
     after_match: str = "past_last_row"
+    # one: partition keys + measures per match; all: every matched input
+    # row (all source columns) + measures evaluated at that row (RUNNING)
+    rows_per_match: str = "one"
 
     @property
     def sources(self):
         return (self.source,)
 
     def output_symbols(self):
+        if self.rows_per_match == "all":
+            return list(self.source.output_symbols()) + [
+                s for s, _, _ in self.measures
+            ]
         return list(self.partition_by) + [s for s, _, _ in self.measures]
 
     def output_types(self):
         src = self.source.output_types()
-        out = {s: src[s] for s in self.partition_by}
+        if self.rows_per_match == "all":
+            out = dict(src)
+        else:
+            out = {s: src[s] for s in self.partition_by}
         for s, _, t in self.measures:
             out[s] = t
         return out

@@ -1422,9 +1422,12 @@ class Analyzer:
             c = mrea.analyze(cond)
             defines.append((var, c))
         measures = []
-        fields = [
-            f for f in inner.scope.fields if f.symbol in part_syms
-        ]
+        if mr.rows_per_match == "all":
+            fields = list(inner.scope.fields)
+        else:
+            fields = [
+                f for f in inner.scope.fields if f.symbol in part_syms
+            ]
         for expr, name in mr.measures:
             e = mrea.analyze(expr)
             sym = self.symbols.new(name)
@@ -1433,6 +1436,7 @@ class Analyzer:
         node = P.MatchRecognize(
             inner.root, tuple(part_syms), tuple(order_keys), mr.pattern,
             tuple(defines), tuple(measures), mr.after_match,
+            mr.rows_per_match,
         )
         return RelationPlan(node, Scope(fields))
 

@@ -261,6 +261,7 @@ class MatchRecognize(Node):
     defines: Tuple[Tuple[str, Node], ...]  # (variable, condition)
     after_match: str = "past_last_row"  # past_last_row | to_next_row
     alias: Optional[str] = None
+    rows_per_match: str = "one"  # one | all
 
 
 @dataclasses.dataclass(frozen=True)

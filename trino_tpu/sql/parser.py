@@ -718,6 +718,7 @@ class Parser:
                 measures.append((e, self.ident()))
                 if not self.accept_op(","):
                     break
+        rows_per = "one"
         if self.accept_soft("one"):
             self.expect_kw("row")
             if not self.accept_soft("per"):
@@ -725,7 +726,12 @@ class Parser:
             if not self.accept_soft("match"):
                 raise ParseError("expected MATCH")
         elif self.accept_kw("all"):
-            raise ParseError("ALL ROWS PER MATCH is not supported yet")
+            self.expect_kw("rows")
+            if not self.accept_soft("per"):
+                raise ParseError("expected PER MATCH")
+            if not self.accept_soft("match"):
+                raise ParseError("expected MATCH")
+            rows_per = "all"
         if self.accept_soft("after"):
             if not self.accept_soft("match"):
                 raise ParseError("expected MATCH after AFTER")
@@ -763,7 +769,7 @@ class Parser:
             alias = self.next().text
         return ast.MatchRecognize(
             rel, tuple(partition), tuple(order), tuple(measures),
-            pattern, tuple(defines), after, alias,
+            pattern, tuple(defines), after, alias, rows_per,
         )
 
     def _pattern_alt(self) -> ast.PatternTerm:
