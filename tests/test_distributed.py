@@ -108,3 +108,49 @@ def test_worker_death_detected_and_query_survives(runner, oracle_conn):
     assert len(nm.alive()) == 2
     # cluster still serves queries
     assert runner.rows("select count(*) from orders") == [(1500,)]
+
+
+def test_graceful_shutdown_drains_and_rejects():
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    from trino_tpu.catalog import CatalogManager
+    from trino_tpu.connectors.tpch import TpchConnectorFactory
+    from trino_tpu.server.worker import WorkerServer
+
+    cm = CatalogManager()
+    cm.register_factory(TpchConnectorFactory())
+    cm.create_catalog("tpch", "tpch", {"tpch.scale-factor": 0.001})
+    w = WorkerServer(cm).start()
+    try:
+        req = urllib.request.Request(
+            f"{w.uri}/v1/info/state",
+            data=json.dumps("SHUTTING_DOWN").encode(),
+            method="PUT",
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.load(resp)["state"] == "SHUTTING_DOWN"
+        # new tasks are rejected with 409 while draining
+        req = urllib.request.Request(
+            f"{w.uri}/v1/task/tq.0.0", data=b"{}", method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5):
+                raise AssertionError("expected 409")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        # the HTTP server shuts down once drained (no active tasks)
+        deadline = time.time() + 10
+        down = False
+        while time.time() < deadline:
+            try:
+                urllib.request.urlopen(f"{w.uri}/v1/status", timeout=0.5)
+                time.sleep(0.1)
+            except Exception:
+                down = True
+                break
+        assert down
+    finally:
+        w.stop()

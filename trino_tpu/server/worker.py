@@ -209,6 +209,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         parts = self.path.strip("/").split("/")
         tm = self.worker.task_manager
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+            if self.worker.state != "ACTIVE":
+                # drain the request body first or the connection wedges
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                self._json(
+                    409, {"error": "worker is shutting down"}
+                )
+                return
             n = int(self.headers.get("Content-Length", 0))
             doc = json.loads(self.rfile.read(n))
             t = tm.create_or_update(parts[2], doc)
@@ -226,6 +233,22 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             return
         self._json(404, {"error": "not found"})
 
+    def do_PUT(self):
+        if self.path == "/v1/info/state":
+            n = int(self.headers.get("Content-Length", 0))
+            want = json.loads(self.rfile.read(n) or b'""')
+            if want != "SHUTTING_DOWN":
+                self._json(400, {"error": f"unsupported state {want}"})
+                return
+            # respond before initiating shutdown: the drain may stop the
+            # HTTP server before this response flushes otherwise
+            self.worker.state = "SHUTTING_DOWN"
+            self._json(200, {"state": self.worker.state})
+            self.wfile.flush()
+            self.worker.start_graceful_shutdown()
+            return
+        self._json(404, {"error": "not found"})
+
     def do_GET(self):
         parts = self.path.strip("/").split("/")
         w = self.worker
@@ -235,6 +258,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 "nodeVersion": {"version": "trino-tpu 0.1"},
                 "environment": "tpu",
                 "coordinator": False,
+                "state": w.state,
                 "uptime": f"{time.time() - w.started:.0f}s",
             })
             return
@@ -334,6 +358,9 @@ class WorkerServer:
         self.announce_interval = announce_interval
         self._stop = threading.Event()
         self.announcer = threading.Thread(target=self._announce_loop, daemon=True)
+        # ACTIVE -> SHUTTING_DOWN (GracefulShutdownHandler analog): stop
+        # announcing, reject new tasks, drain running ones, then stop
+        self.state = "ACTIVE"
 
     @property
     def uri(self) -> str:
@@ -348,6 +375,28 @@ class WorkerServer:
     def stop(self):
         self._stop.set()
         self.httpd.shutdown()
+
+    def start_graceful_shutdown(self):
+        """PUT /v1/info/state SHUTTING_DOWN: drain then stop (the
+        reference's GracefulShutdownHandler)."""
+        self.state = "SHUTTING_DOWN"
+        self._stop.set()  # stop announcing: scheduler drops this node
+
+        def drain():
+            time.sleep(0.2)  # let in-flight responses flush
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                active = sum(
+                    1
+                    for t in self.task_manager.tasks.values()
+                    if t.state in ("PLANNED", "RUNNING", "FLUSHING")
+                )
+                if active == 0:
+                    break
+                time.sleep(0.05)
+            self.httpd.shutdown()
+
+        threading.Thread(target=drain, daemon=True).start()
 
     # ------------------------------------------------------------------
     def _announce_loop(self):
