@@ -189,3 +189,45 @@ def test_tablesample_after_alias():
     ).to_pylist()[0][0]
     total = s.execute("select count(*) from orders").to_pylist()[0][0]
     assert 0 < n < total
+
+
+def test_show_schemas():
+    s = tpch_session(0.001)
+    assert ("default",) in s.execute("show schemas").to_pylist()
+    assert ("default",) in s.execute("show schemas from tpch").to_pylist()
+
+
+def test_http_event_listener():
+    import json
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    received = []
+
+    class Sink(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        from trino_tpu.utils.events import HttpEventListener
+
+        s = tpch_session(0.001)
+        s.events.add(
+            HttpEventListener(f"http://127.0.0.1:{httpd.server_address[1]}")
+        )
+        s.execute("select 1")
+        kinds = [e["event"] for e in received]
+        assert "QueryCreated" in kinds and "QueryCompleted" in kinds
+        done = [e for e in received if e["event"] == "QueryCompleted"][0]
+        assert done["state"] == "FINISHED" and done["outputRows"] == 1
+    finally:
+        httpd.shutdown()

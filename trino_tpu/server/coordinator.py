@@ -290,6 +290,24 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/v1/resourceGroupState":
             self._json(200, co.resource_groups.info())
             return
+        if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+            q = co.queries.get(parts[2])
+            if q is None:
+                self._json(404, {"error": "query not found"})
+                return
+            with q.lock:
+                self._json(200, {
+                    "queryId": q.query_id,
+                    "state": q.state,
+                    "query": q.sql,
+                    "user": q.user,
+                    "error": q.error,
+                    "elapsedMillis": int(
+                        ((q.finished or time.time()) - q.created) * 1000
+                    ),
+                    "outputRows": q.page.count if q.page else None,
+                })
+            return
         if self.path == "/v1/query":
             self._json(200, [
                 {

@@ -323,6 +323,14 @@ class Session:
             return page_from_pydict(
                 [("create_table", T.VARCHAR)], {"create_table": [ddl]}
             )
+        if isinstance(stmt, ast.ShowSchemas):
+            cat = stmt.catalog or self.default_catalog
+            self.catalogs.get(cat)  # raises if unknown
+            # catalogs here are single-schema; expose the flattened layout
+            return page_from_pydict(
+                [("schema", T.VARCHAR)],
+                {"schema": ["default", "information_schema"]},
+            )
         if isinstance(stmt, ast.ShowCatalogs):
             return page_from_pydict(
                 [("catalog", T.VARCHAR)],

@@ -495,6 +495,11 @@ class ShowCatalogs(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class ShowSchemas(Node):
+    catalog: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class Use(Node):
     """USE catalog | USE catalog.schema"""
 

@@ -176,6 +176,12 @@ class Parser:
             if self.accept_soft("catalogs"):
                 self._finish()
                 return ast.ShowCatalogs()
+            if self.accept_soft("schemas"):
+                cat = None
+                if self.accept_kw("from") or self.accept_kw("in"):
+                    cat = self.ident()
+                self._finish()
+                return ast.ShowSchemas(cat)
             if self.accept_soft("stats"):
                 self.expect_kw("for")
                 name = self.qualified_name()

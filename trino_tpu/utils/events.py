@@ -67,3 +67,47 @@ class EventListenerManager:
         )
         for l in self.listeners:
             l.query_completed(ev)
+
+
+class HttpEventListener(EventListener):
+    """POSTs query events as JSON to a remote collector
+    (plugin/trino-http-event-listener analog).  Failures are swallowed:
+    eventing must never fail queries."""
+
+    def __init__(self, uri: str, timeout: float = 2.0):
+        self.uri = uri.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, doc: dict):
+        import json as _json
+        import urllib.request
+
+        try:
+            req = urllib.request.Request(
+                self.uri,
+                data=_json.dumps(doc).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        except Exception:
+            pass
+
+    def query_created(self, event: QueryCreatedEvent):
+        self._post({
+            "event": "QueryCreated",
+            "queryId": event.query_id,
+            "sql": event.sql,
+            "createTime": event.create_time,
+        })
+
+    def query_completed(self, event: QueryCompletedEvent):
+        self._post({
+            "event": "QueryCompleted",
+            "queryId": event.query_id,
+            "sql": event.sql,
+            "state": event.state,
+            "wallMillis": event.wall_ms,
+            "outputRows": event.output_rows,
+            "error": event.error,
+        })
