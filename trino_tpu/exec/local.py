@@ -1200,6 +1200,11 @@ class _TraceCtx:
         pkey = join_ops.composite_key(lkeys, left.sel)
         src = join_ops.build_multi(bkey, right.sel)
         counts, lo = join_ops.probe_counts(src, pkey, left.sel)
+        if node.kind not in ("inner", "left"):
+            raise ExecutionError(
+                f"join kind {node.kind} not supported by the expansion "
+                "kernel (right/full rewrite to left at planning)"
+            )
         outer = node.kind == "left"
         probe_cap = left.sel.shape[0]
         capacity = _pad_capacity(

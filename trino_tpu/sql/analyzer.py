@@ -1540,6 +1540,60 @@ class Analyzer:
         criteria, residual = _extract_equi_criteria(cond, lsyms, rsyms)
         if not criteria:
             raise SemanticError("join requires at least one equi condition")
+        if j.kind == "right":
+            # RIGHT = LEFT with sides swapped; the scope keeps the written
+            # column order (plan side order is independent of it)
+            node: P.PlanNode = P.Join(
+                "left", right.root, left.root,
+                tuple((r, l) for l, r in criteria), residual,
+            )
+            return RelationPlan(node, scope)
+        if j.kind == "full":
+            # FULL = LEFT(L, R) union-all right-only rows null-extended on
+            # the left side (the LookupOuterOperator unmatched-build pass,
+            # expressed as an anti join + projection)
+            if residual is not None:
+                raise SemanticError(
+                    "FULL JOIN supports equi conditions only"
+                )
+            lj = P.Join(
+                "left", left.root, right.root, tuple(criteria), None
+            )
+            mark = self.symbols.new("fullmark")
+            anti = P.Filter(
+                P.SemiJoin(
+                    right.root, left.root,
+                    tuple(r for _, r in criteria),
+                    tuple(l for l, _ in criteria),
+                    mark,
+                ),
+                ir.Not(ir.ColumnRef(T.BOOLEAN, mark)),
+            )
+            lj_syms = lj.output_symbols()
+            lj_types = lj.output_types()
+            in_right = set(right.root.output_symbols())
+            # fresh output symbols: reusing the left-join branch's names
+            # would collide in the executor's dictionary registry
+            assigns = tuple(
+                (
+                    self.symbols.new("fn"),
+                    ir.ColumnRef(lj_types[s], s) if s in in_right
+                    else ir.Constant(lj_types[s], None),
+                )
+                for s in lj_syms
+            )
+            proj = P.Project(anti, assigns)
+            usyms = tuple(self.symbols.new("fo") for _ in lj_syms)
+            union = P.SetOperation(
+                "union", True, (lj, proj), usyms,
+                tuple((u, lj_types[s]) for u, s in zip(usyms, lj_syms)),
+            )
+            remap = dict(zip(lj_syms, usyms))
+            new_fields = [
+                Field(f.qualifier, f.name, remap[f.symbol], f.type)
+                for f in scope.fields
+            ]
+            return RelationPlan(union, Scope(new_fields))
         node = P.Join(j.kind, left.root, right.root, tuple(criteria), residual)
         return RelationPlan(node, scope)
 
