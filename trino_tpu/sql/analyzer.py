@@ -1440,6 +1440,65 @@ class Analyzer:
         )
         return RelationPlan(node, Scope(fields))
 
+    def _plan_using_join(
+        self, j: ast.Join, left: RelationPlan, right: RelationPlan, scope
+    ) -> RelationPlan:
+        """JOIN ... USING (cols): equi-join on same-named columns; each
+        using column appears ONCE in the output, coalesced across sides
+        (outer-join null-extension picks the present side), per the
+        standard and StatementAnalyzer.analyzeJoinUsing."""
+        pairs = []
+        for c in j.using:
+            lc = c.lower()
+            lf = [f for f in left.scope.fields if f.name == lc]
+            rf = [f for f in right.scope.fields if f.name == lc]
+            if len(lf) != 1 or len(rf) != 1:
+                raise SemanticError(
+                    f"USING column {c} must appear exactly once on each side"
+                )
+            _check_comparable(lf[0].type, rf[0].type)
+            pairs.append((lf[0], rf[0]))
+        criteria = [(lf.symbol, rf.symbol) for lf, rf in pairs]
+        planned = self._build_join(
+            j.kind, left, right, criteria, None, scope
+        )
+        # coalesce each using pair into one output field, drop the pair
+        used = {lf.symbol for lf, _ in pairs} | {rf.symbol for _, rf in pairs}
+        assigns = []
+        fields = []
+        for lf, rf in pairs:
+            # after RIGHT/FULL rewrites the scope may remap symbols; find
+            # the current symbols by field identity
+            cur_l = next(
+                f for f in planned.scope.fields
+                if f.name == lf.name and f.qualifier == lf.qualifier
+            )
+            cur_r = next(
+                f for f in planned.scope.fields
+                if f.name == rf.name and f.qualifier == rf.qualifier
+                and f is not cur_l
+            )
+            t = T.common_super_type(cur_l.type, cur_r.type)
+            lref = ir.ColumnRef(cur_l.type, cur_l.symbol)
+            rref = ir.ColumnRef(cur_r.type, cur_r.symbol)
+            e: ir.Expr = ir.Case(
+                t,
+                (ir.WhenClause(ir.IsNull(lref, negate=True), lref),),
+                rref,
+            )
+            sym = self.symbols.new(lf.name)
+            assigns.append((sym, e))
+            fields.append(Field(None, lf.name, sym, t))
+            used.add(cur_l.symbol)
+            used.add(cur_r.symbol)
+        for f in planned.scope.fields:
+            if f.symbol in used:
+                continue
+            assigns.append((f.symbol, ir.ColumnRef(f.type, f.symbol)))
+            fields.append(f)
+        node = P.Project(planned.root, tuple(assigns))
+        return RelationPlan(node, Scope(fields))
+
     def _plan_unnest(
         self, left: RelationPlan, u: ast.UnnestRelation, outer: bool = False
     ) -> RelationPlan:
@@ -1533,6 +1592,8 @@ class Analyzer:
         if j.kind == "cross":
             node = P.Join("cross", left.root, right.root, ())
             return RelationPlan(node, scope)
+        if j.using:
+            return self._plan_using_join(j, left, right, scope)
         ea = ExprAnalyzer(self, RelationPlan(left.root, scope))
         cond = ea.analyze(j.condition)
         lsyms = {f.symbol for f in left.scope.fields}
@@ -1540,7 +1601,12 @@ class Analyzer:
         criteria, residual = _extract_equi_criteria(cond, lsyms, rsyms)
         if not criteria:
             raise SemanticError("join requires at least one equi condition")
-        if j.kind == "right":
+        return self._build_join(
+            j.kind, left, right, criteria, residual, scope
+        )
+
+    def _build_join(self, kind, left, right, criteria, residual, scope):
+        if kind == "right":
             # RIGHT = LEFT with sides swapped; the scope keeps the written
             # column order (plan side order is independent of it)
             node: P.PlanNode = P.Join(
@@ -1548,7 +1614,7 @@ class Analyzer:
                 tuple((r, l) for l, r in criteria), residual,
             )
             return RelationPlan(node, scope)
-        if j.kind == "full":
+        if kind == "full":
             # FULL = LEFT(L, R) union-all right-only rows null-extended on
             # the left side (the LookupOuterOperator unmatched-build pass,
             # expressed as an anti join + projection)
@@ -1594,7 +1660,7 @@ class Analyzer:
                 for f in scope.fields
             ]
             return RelationPlan(union, Scope(new_fields))
-        node = P.Join(j.kind, left.root, right.root, tuple(criteria), residual)
+        node = P.Join(kind, left.root, right.root, tuple(criteria), residual)
         return RelationPlan(node, scope)
 
 

@@ -234,6 +234,7 @@ class Join(Node):
     left: Node
     right: Node
     condition: Optional[Node]  # ON expr (None for cross)
+    using: Tuple[str, ...] = ()  # USING (a, b) join columns
 
 
 @dataclasses.dataclass(frozen=True)

@@ -693,12 +693,16 @@ class Parser:
             self.expect_kw("join")
             right = self.relation_primary()
             if self.accept_kw("on"):
-                cond = self.expr()
-            elif self.at_kw("using"):
-                raise ParseError("USING join not supported yet; use ON")
+                rel = ast.Join(kind, rel, right, self.expr())
+            elif self.accept_kw("using"):
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                rel = ast.Join(kind, rel, right, None, tuple(cols))
             else:
-                raise ParseError("JOIN requires ON")
-            rel = ast.Join(kind, rel, right, cond)
+                raise ParseError("JOIN requires ON or USING")
 
     def _match_recognize(self, rel: ast.Node) -> ast.Node:
         """MATCH_RECOGNIZE clause after a relation (row pattern recognition)."""
