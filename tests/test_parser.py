@@ -223,6 +223,7 @@ def test_interval_requires_unit():
         parse("select interval '3' bogus")
 
 
-def test_using_join_raises_cleanly():
-    with pytest.raises(ParseError):
-        parse("select * from a join b using (x)")
+def test_using_join_parses():
+    q = parse("select * from a join b using (x, y)")
+    join = q.body.relation
+    assert join.using == ("x", "y") and join.condition is None
