@@ -212,3 +212,14 @@ def test_left_join_unnest_preserves_empty():
     assert s.execute(
         "select k, x from t cross join unnest(a) as u(x) order by k, x"
     ).to_pylist() == [(1, 10), (1, 20)]
+
+
+def test_left_join_unnest_ordinality_null_extended():
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (k bigint, a array(bigint))")
+    s.execute("insert into t values (1, array[7]), (2, array[])")
+    assert s.execute(
+        "select k, x, o from t left join unnest(a) with ordinality "
+        "as u(x, o) on true order by k"
+    ).to_pylist() == [(1, 7, 1), (2, None, None)]

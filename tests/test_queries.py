@@ -395,14 +395,6 @@ def test_full_outer_join_counts(session, oracle_conn):
 
 def test_join_using(session, oracle_conn):
     # sqlite supports USING with the same single-column semantics
-    check(
-        session, oracle_conn,
-        "select n_regionkey, count(*) from nation "
-        "join region using (r_regionkey)"
-        if False else
-        "select r_name, n_name from region join nation "
-        "on r_regionkey = n_regionkey where r_regionkey = 1 order by n_name",
-    )
     out = session.execute(
         "select regionkey, r_name, n_name from "
         "(select r_regionkey as regionkey, r_name from region) r join "

@@ -225,6 +225,11 @@ def test_http_event_listener():
             HttpEventListener(f"http://127.0.0.1:{httpd.server_address[1]}")
         )
         s.execute("select 1")
+        import time as _t
+
+        deadline = _t.time() + 5
+        while _t.time() < deadline and len(received) < 2:
+            _t.sleep(0.05)  # posts are async (fire-and-forget)
         kinds = [e["event"] for e in received]
         assert "QueryCreated" in kinds and "QueryCompleted" in kinds
         done = [e for e in received if e["event"] == "QueryCompleted"][0]

@@ -844,13 +844,20 @@ class _TraceCtx:
             jnp.asarray(pad_to(eo, cap, False)),
         )
         if node.ordinality_symbol:
-            src_lens = eff if node.outer else lengths
-            ords = np.concatenate(
-                [np.arange(1, ln + 1, dtype=np.int64) for ln in src_lens]
-            ) if total else np.zeros(0, dtype=np.int64)
+            ovals: list = []
+            ovalid: list = []
+            for ln in lengths:
+                if ln:
+                    ovals.extend(range(1, int(ln) + 1))
+                    ovalid.extend([True] * int(ln))
+                elif node.outer:  # null-extended row: ordinality is NULL
+                    ovals.append(0)
+                    ovalid.append(False)
             lanes[node.ordinality_symbol] = (
-                jnp.asarray(pad_to(ords, cap)),
-                jnp.asarray(pad_to(np.ones(total, bool), cap, False)),
+                jnp.asarray(pad_to(np.array(ovals, dtype=np.int64), cap)),
+                jnp.asarray(
+                    pad_to(np.array(ovalid, dtype=bool), cap, False)
+                ),
             )
         return Batch(lanes, jnp.arange(cap) < total)
 

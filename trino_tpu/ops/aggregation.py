@@ -224,9 +224,9 @@ def _seg_sum(v, gid, cap):
     if _use_masked(cap) and v.ndim == 1:
         import os
 
-        if os.environ.get("TRINO_TPU_PALLAS") == "1" and v.dtype in (
-            jnp.int64, jnp.dtype("int64"),
-        ):
+        if (os.environ.get("TRINO_TPU_PALLAS") == "1"
+                and v.shape[0] <= 4_000_000  # f32-plane exactness bound
+                and v.dtype in (jnp.int64, jnp.dtype("int64"))):
             # opt-in hand-tiled pallas kernel (ops/pallas_kernels.py):
             # one streaming pass over the input for ALL groups
             from .pallas_kernels import HAVE_PALLAS, grouped_sum_i64

@@ -112,8 +112,9 @@ def grouped_sum_i64(
 ) -> jnp.ndarray:
     """Exact int64 segment-sum via 8-bit planes (pallas TPU has no native
     int64): each plane's per-lane f32 accumulator stays below 2^24
-    (255 * rows/128 addends), lanes fold in f64, recombination wraps mod
-    2^64 exactly like int64 addition."""
+    (255 * rows/128 addends — callers must bound rows at ~4M per call, as
+    ops/aggregation._seg_sum does), lanes fold in f64, recombination wraps
+    mod 2^64 exactly like int64 addition."""
     if not HAVE_PALLAS:
         raise RuntimeError("pallas is unavailable")
     v = values.astype(jnp.int64)

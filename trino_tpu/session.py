@@ -182,10 +182,14 @@ class Session:
                            identity=None) -> Page:
         if identity is None:
             identity = self.identity
-        if not isinstance(stmt, ast.Query):
-            # any non-query statement may change planning state (functions,
-            # prepared statements, default catalog, tables, session config):
-            # cached plans and compiled fragments are stale
+        if isinstance(stmt, (
+            ast.Prepare, ast.Deallocate, ast.CreateFunction,
+            ast.DropFunction, ast.CreateTable, ast.DropTable, ast.Use,
+            ast.SetSession,
+        )):
+            # statements that change planning state invalidate cached plans
+            # and compiled fragments; read-only EXECUTE/SHOW/EXPLAIN keep
+            # them (planned DML clears below at planning)
             self._plan_cache.clear()
             self._jit_cache.clear()
             self._capacity_hints.clear()
@@ -453,7 +457,12 @@ class Session:
                     self._plan_cache.pop(k, None)
             plan = cached
         else:
-            plan = self._plan_stmt(stmt)  # caches cleared at dispatch above
+            # writes (INSERT/DELETE/UPDATE/MERGE/CTAS) change data: cached
+            # plans and compiled fragments are stale
+            self._plan_cache.clear()
+            self._jit_cache.clear()
+            self._capacity_hints.clear()
+            plan = self._plan_stmt(stmt)
         self._check_plan_access(plan, identity)
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):

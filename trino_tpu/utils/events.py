@@ -80,18 +80,24 @@ class HttpEventListener(EventListener):
 
     def _post(self, doc: dict):
         import json as _json
+        import threading
         import urllib.request
 
-        try:
-            req = urllib.request.Request(
-                self.uri,
-                data=_json.dumps(doc).encode(),
-                headers={"Content-Type": "application/json"},
-                method="POST",
-            )
-            urllib.request.urlopen(req, timeout=self.timeout).read()
-        except Exception:
-            pass
+        def send():
+            try:
+                req = urllib.request.Request(
+                    self.uri,
+                    data=_json.dumps(doc).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                    r.read()
+            except Exception:
+                pass
+
+        # fire-and-forget: eventing must not add latency to the query path
+        threading.Thread(target=send, daemon=True).start()
 
     def query_created(self, event: QueryCreatedEvent):
         self._post({
