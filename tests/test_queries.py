@@ -406,3 +406,22 @@ def test_join_using(session, oracle_conn):
         "r_regionkey = n_regionkey where r_regionkey = 1 order by n_name"
     ).fetchall()
     assert_rows_match(out, expected)
+
+
+def test_offset_forms(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select n_nationkey from nation order by 1 limit 3 offset 2"
+        if False else
+        "select n_nationkey from nation order by 1 offset 2 limit 3",
+        oracle_sql="select n_nationkey from nation order by 1 limit 3 offset 2",
+    )
+    check(
+        session, oracle_conn,
+        "select n_nationkey from nation order by 1 offset 22",
+        oracle_sql="select n_nationkey from nation order by 1 limit -1 offset 22",
+    )
+    assert session.execute(
+        "select n_nationkey from nation order by 1 "
+        "offset 2 rows fetch next 3 rows only"
+    ).to_pylist() == [(2,), (3,), (4,)]

@@ -758,7 +758,9 @@ class _TraceCtx:
 
     def _visit_limit(self, node: P.Limit) -> Batch:
         b = self.visit(node.source)
-        lanes, sel = sort_ops.limit(b.lanes, b.sel, node.count)
+        lanes, sel = sort_ops.limit(
+            b.lanes, b.sel, node.count, node.offset
+        )
         return Batch(lanes, sel, b.ordered, b.replicated)
 
     def _visit_distinct(self, node: P.Distinct) -> Batch:

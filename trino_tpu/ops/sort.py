@@ -78,12 +78,16 @@ def topn(
 
 
 def limit(
-    lanes: Dict[str, Lane], sel: jnp.ndarray, n: int
+    lanes: Dict[str, Lane], sel: jnp.ndarray, n: int, offset: int = 0
 ) -> Tuple[Dict[str, Lane], jnp.ndarray]:
-    """Keep the first n *selected* rows (order-preserving LimitOperator).
+    """Keep selected rows (offset, offset+n] by running count
+    (order-preserving LimitOperator with OFFSET).
 
-    Static-shape: selection mask is trimmed where the running count of
-    selected rows exceeds n; array capacity is unchanged.
+    Static-shape: selection mask is trimmed outside the window; array
+    capacity is unchanged.
     """
     running = jnp.cumsum(sel.astype(jnp.int64))
-    return lanes, sel & (running <= n)
+    keep = sel & (running <= offset + n)
+    if offset:
+        keep = keep & (running > offset)
+    return lanes, keep

@@ -470,14 +470,21 @@ class _MeshTraceCtx(_TraceCtx):
 
     def _visit_limit(self, node: P.Limit) -> Batch:
         b = self.visit(node.source)
-        lanes, sel = sort_ops.limit(b.lanes, b.sel, node.count)
+        # per-device partial keeps count+offset; the post-gather limit
+        # applies the offset skip
+        lanes, sel = sort_ops.limit(
+            b.lanes, b.sel, node.count + node.offset
+        )
         if not b.replicated:
             b2 = Batch(
                 {s: (_agather(v), _agather(ok)) for s, (v, ok) in lanes.items()},
                 _agather(sel),
             )
-            lanes, sel = sort_ops.limit(b2.lanes, b2.sel, node.count)
+            lanes, sel = sort_ops.limit(
+                b2.lanes, b2.sel, node.count, node.offset
+            )
             return Batch(lanes, sel, replicated=True)
+        lanes, sel = sort_ops.limit(lanes, sel, node.count, node.offset)
         return Batch(lanes, sel, b.ordered, b.replicated)
 
     def _visit_distinct(self, node: P.Distinct) -> Batch:

@@ -170,10 +170,12 @@ class Fragmenter:
     def _do_limit(self, node: P.Limit):
         src, part, keys = self._rewrite(node.source)
         if part == SINGLE:
-            return P.Limit(src, node.count), SINGLE, ()
-        partial = P.Limit(src, node.count)
+            return P.Limit(src, node.count, node.offset), SINGLE, ()
+        # partial keeps count+offset rows per task; only the final single
+        # stage applies the offset skip
+        partial = P.Limit(src, node.count + node.offset)
         rs = self._cut(partial, part, keys, SINGLE)
-        return P.Limit(rs, node.count), SINGLE, ()
+        return P.Limit(rs, node.count, node.offset), SINGLE, ()
 
     def _do_topn(self, node: P.TopN):
         src, part, keys = self._rewrite(node.source)

@@ -531,6 +531,7 @@ class TopN(PlanNode):
 class Limit(PlanNode):
     source: PlanNode
     count: int
+    offset: int = 0  # skip the first `offset` selected rows (OFFSET n)
 
     @property
     def sources(self):

@@ -530,12 +530,13 @@ class Analyzer:
         try:
             if isinstance(q.body, ast.QuerySpec):
                 rp, names = self.plan_query_spec(
-                    q.body, q.order_by, q.limit
+                    q.body, q.order_by, q.limit, q.offset
                 )
             else:
                 rp, names = self.plan_set_op(q.body)
                 rp = self._apply_order_limit(
-                    rp, names, q.order_by, q.limit, post_agg=None
+                    rp, names, q.order_by, q.limit, post_agg=None,
+                    offset=q.offset,
                 )
             return rp, names
         finally:
@@ -661,6 +662,7 @@ class Analyzer:
         spec: ast.QuerySpec,
         order_by: Tuple[ast.SortItem, ...],
         limit: Optional[int],
+        offset: int = 0,
     ) -> Tuple[RelationPlan, List[str]]:
         # FROM
         if spec.relation is None:
@@ -749,6 +751,7 @@ class Analyzer:
             post_agg=proj_analyzer if has_aggs else None,
             pre_projection=rel,
             select_assigns=assigns,
+            offset=offset,
         )
         return out, names
 
@@ -1288,6 +1291,7 @@ class Analyzer:
         post_agg=None,
         pre_projection: Optional[RelationPlan] = None,
         select_assigns=None,
+        offset: int = 0,
     ) -> RelationPlan:
         if order_by:
             keys: List[SortKey] = []
@@ -1307,9 +1311,16 @@ class Analyzer:
                     root.source, tuple(list(root.assignments) + extra_assigns)
                 )
             if limit is not None:
-                node: P.PlanNode = P.TopN(root, tuple(keys), limit)
+                # TopN keeps offset+limit, then Limit skips the offset
+                node: P.PlanNode = P.TopN(
+                    root, tuple(keys), limit + offset
+                )
+                if offset:
+                    node = P.Limit(node, limit, offset)
             else:
                 node = P.Sort(root, tuple(keys))
+                if offset:
+                    node = P.Limit(node, (1 << 62), offset)
             if extra_assigns:
                 # project hidden columns away
                 node = P.Project(
@@ -1321,7 +1332,13 @@ class Analyzer:
                 )
             return RelationPlan(node, out.scope)
         if limit is not None:
-            return RelationPlan(P.Limit(out.root, limit), out.scope)
+            return RelationPlan(
+                P.Limit(out.root, limit, offset), out.scope
+            )
+        if offset:
+            return RelationPlan(
+                P.Limit(out.root, (1 << 62), offset), out.scope
+            )
         return out
 
     def _resolve_sort_expr(

@@ -347,6 +347,7 @@ class Query(Node):
     order_by: Tuple[SortItem, ...] = ()
     limit: Optional[int] = None
     withs: Tuple[With, ...] = ()
+    offset: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -485,6 +485,10 @@ class Parser:
             order.append(self.sort_item())
             while self.accept_op(","):
                 order.append(self.sort_item())
+        offset = 0
+        if self.accept_kw("offset"):
+            offset = self._int_token(self.next(), "OFFSET")
+            self.accept_kw("rows") or self.accept_kw("row")
         if self.accept_kw("limit"):
             t = self.next()
             if t.kind == "kw" and t.text == "all":
@@ -492,11 +496,12 @@ class Parser:
             else:
                 limit = self._int_token(t, "LIMIT")
         elif self.accept_kw("fetch"):
-            self.accept_kw("first") or self.accept_kw("next")
+            (self.accept_kw("first") or self.accept_kw("next")
+             or self.accept_soft("next"))
             limit = self._int_token(self.next(), "FETCH")
             self.accept_kw("rows") or self.accept_kw("row")
             self.expect_kw("only")
-        return ast.Query(body, tuple(order), limit, tuple(withs))
+        return ast.Query(body, tuple(order), limit, tuple(withs), offset)
 
     def sort_item(self) -> ast.SortItem:
         e = self.expr()
