@@ -229,6 +229,10 @@ class LocalExecutor:
                 self.config.get("group_capacity", DEFAULT_GROUP_CAPACITY)
             )
             self.join_factor = 1
+            # join nodes whose build side turned out to hold duplicate (or
+            # hash-colliding) keys: re-traced with the expansion kernel
+            # (HashBuilderOperator never assumes uniqueness; we learn it)
+            self.force_expansion = set()
             # start at the last successful capacities for this plan: the
             # overflow ladder re-runs (and on first touch, re-COMPILES) the
             # whole fragment per rung, so remembering the landing spot makes
@@ -254,7 +258,7 @@ class LocalExecutor:
                     if nid in self._scan_nodes
                 )
             )
-            for attempt in range(5):
+            for attempt in range(7):
                 if use_jit:
                     out_lanes, sel, ordered, checks, dups = self._run_jitted(
                         plan, scans, counts
@@ -269,12 +273,15 @@ class LocalExecutor:
                 dup_vals, check_vals = jax.device_get(
                     ([d for _, d in dups], [ng for ng, _ in checks])
                 )
+                fell_back = False
                 for (join_node, _), dup in zip(dups, dup_vals):
                     if int(dup) > 0:
-                        raise ExecutionError(
-                            "join build side has duplicate keys (many-to-many "
-                            f"join not yet supported): {join_node.criteria}"
-                        )
+                        # duplicate (or colliding) build keys: re-trace with
+                        # the many-to-many expansion kernel for this join
+                        self.force_expansion.add(id(join_node))
+                        fell_back = True
+                if fell_back:
+                    continue
                 overflow = False
                 for ngroups, (_, cap) in zip(check_vals, checks):
                     if int(ngroups) > cap:
@@ -587,6 +594,7 @@ class LocalExecutor:
         }
         key = (
             id(plan), self.group_capacity, self.join_factor,
+            frozenset(getattr(self, "force_expansion", ())),
             # scan-cache keys embed the connector data_version, so a write
             # that keeps row counts constant still recompiles (and refreshes
             # the dictionary snapshot)
@@ -1166,7 +1174,9 @@ class _TraceCtx:
     def _join_batches(self, node: P.Join, left: Batch, right: Batch) -> Batch:
         if node.kind == "cross":
             return self._cross_join(node, left, right)
-        if node.expansion:
+        if node.expansion or id(node) in getattr(
+            self.ex, "force_expansion", ()
+        ):
             return self._expansion_join(node, left, right)
         # unique-keyed build on right, probe on left
         lkeys = [left.lanes[l] for l, _ in node.criteria]
@@ -1177,6 +1187,10 @@ class _TraceCtx:
         src = join_ops.build_unique(bkey, right.sel)
         self.dup_checks.append((node, src.dup_count))
         row, matched = join_ops.probe(src, pkey, left.sel)
+        if len(node.criteria) > 1:
+            # exact equality on the real key columns: a 64-bit locator
+            # collision must reject the candidate, not return a wrong row
+            matched = matched & join_ops.verify_rows(rkeys, lkeys, row)
         build_cols = join_ops.gather_build(right.lanes, row, matched)
         lanes = dict(left.lanes)
         lanes.update(build_cols)
@@ -1201,7 +1215,13 @@ class _TraceCtx:
 
     def _expansion_join(self, node: P.Join, left: Batch, right: Batch) -> Batch:
         """General (duplicate-build-key) join with static output capacity +
-        host retry (vectorized LookupJoinOperator page building)."""
+        host retry (vectorized LookupJoinOperator page building).
+
+        Candidates come from the 64-bit locator ranges; `verify_rows` then
+        enforces exact multi-column equality, and for outer joins the
+        null-extended row is emitted per probe row only when *no* candidate
+        survives key verification + residual filter (segment any-match),
+        matching LookupJoinOperator.java:36 probe semantics exactly."""
         lkeys = [left.lanes[l] for l, _ in node.criteria]
         rkeys = [right.lanes[r] for _, r in node.criteria]
         self._check_join_dicts(node)
@@ -1219,33 +1239,43 @@ class _TraceCtx:
         capacity = _pad_capacity(
             int(probe_cap * getattr(self.ex, "join_factor", 1))
         )
-        probe_row, build_row, matched, total = join_ops.expand_join(
+        probe_row, build_row, matched, total, k = join_ops.expand_join_slots(
             src, counts, lo, capacity, outer=outer
         )
         # expand_join's internal eff uses max(counts,1) for outer including
         # unselected rows; mask them below via probe sel gather
         self._note_capacity(total, capacity)
         psel = left.sel[probe_row]
+        if len(node.criteria) > 1:
+            matched = matched & join_ops.verify_rows(
+                rkeys, lkeys, build_row, probe_row
+            )
         lanes = {}
         for s, (v, ok) in left.lanes.items():
             lanes[s] = (v[probe_row], ok[probe_row])
         for s, (v, ok) in right.lanes.items():
             lanes[s] = (v[build_row], ok[build_row] & matched)
-        within = jnp.arange(capacity) < total
-        if node.kind == "inner":
-            sel = within & matched & psel
-        else:
-            sel = within & psel
+        surviving = matched & psel  # matched is already within-capacity
         if node.filter is not None:
             f = compile_expr(node.filter, self.lowering)
             v, ok = f(lanes)
-            if node.kind == "inner":
-                sel = sel & v & ok
-            else:
-                keep = matched & v & ok
-                for s in right.lanes:
-                    bv, bok = lanes[s]
-                    lanes[s] = (bv, bok & keep)
+            surviving = surviving & v & ok
+        if node.kind == "inner":
+            sel = surviving
+        else:
+            any_match = (
+                jax.ops.segment_sum(
+                    surviving.astype(jnp.int32), probe_row,
+                    num_segments=probe_cap,
+                )
+                > 0
+            )
+            within = jnp.arange(capacity) < total
+            outer_emit = within & (k == 0) & psel & ~any_match[probe_row]
+            sel = surviving | outer_emit
+            for s in right.lanes:
+                bv, bok = lanes[s]
+                lanes[s] = (bv, bok & surviving)
         return Batch(lanes, sel)
 
     def _check_join_dicts(self, node: P.Join):
@@ -1288,57 +1318,53 @@ class _TraceCtx:
 
     def _semi_hit(self, node: P.SemiJoin, src: Batch, filt: Batch):
         """Membership mark; duplicates in the filtering side are fine
-        (sorted search, any match counts)."""
-        if node.filter is not None:
-            return self._semi_hit_filtered(node, src, filt)
-        fv, fok = join_ops.composite_key(
-            [filt.lanes[k] for k in node.filtering_keys], filt.sel
-        )
+        (sorted search, any match counts).  Single-column keys compare the
+        real value directly (collision-free); multi-column keys and residual
+        predicates go through the expansion path with exact verification."""
+        if node.filter is not None or len(node.source_keys) > 1:
+            return self._semi_hit_expanded(node, src, filt)
+        fv, fok = filt.lanes[node.filtering_keys[0]]
         live = filt.sel & fok
         kv = jnp.where(live, fv.astype(jnp.int64), join_ops.I64_MAX)
         sorted_keys = jax.lax.sort(kv)
-        pv, pok = join_ops.composite_key(
-            [src.lanes[k] for k in node.source_keys], src.sel
-        )
+        pv, pok = src.lanes[node.source_keys[0]]
         idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
         safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
         return (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
 
-    def _semi_hit_filtered(self, node: P.SemiJoin, src: Batch, filt: Batch):
-        """Mark join with a residual pair predicate: expand candidate
-        (source, filtering) pairs on the equi keys, evaluate the residual,
-        reduce any-match per source row (EXISTS with non-equality
-        correlation, e.g. TPC-H Q21)."""
-        bkey = join_ops.composite_key(
-            [filt.lanes[k] for k in node.filtering_keys], filt.sel
-        )
-        pkey = join_ops.composite_key(
-            [src.lanes[k] for k in node.source_keys], src.sel
-        )
+    def _semi_hit_expanded(self, node: P.SemiJoin, src: Batch, filt: Batch):
+        """Mark join via candidate expansion: expand (source, filtering)
+        pairs on the equi-key locator ranges, verify exact key equality,
+        evaluate the residual if any, reduce any-match per source row
+        (EXISTS with non-equality correlation, e.g. TPC-H Q21)."""
+        fkeys = [filt.lanes[k] for k in node.filtering_keys]
+        skeys = [src.lanes[k] for k in node.source_keys]
+        bkey = join_ops.composite_key(fkeys, filt.sel)
+        pkey = join_ops.composite_key(skeys, src.sel)
         build = join_ops.build_multi(bkey, filt.sel)
         counts, lo = join_ops.probe_counts(build, pkey, src.sel)
         n_src = src.sel.shape[0]
         capacity = _pad_capacity(
             int(n_src * getattr(self.ex, "join_factor", 1))
         )
-        probe_row, build_row, matched, total = join_ops.expand_join(
+        probe_row, build_row, matched, total, _ = join_ops.expand_join_slots(
             build, counts, lo, capacity
         )
         self._note_capacity(total, capacity)
-        lanes = {}
-        for s, (v, ok) in src.lanes.items():
-            lanes[s] = (v[probe_row], ok[probe_row])
-        for s, (v, ok) in filt.lanes.items():
-            lanes[s] = (v[build_row], ok[build_row] & matched)
-        f = compile_expr(node.filter, self.lowering)
-        fv, fok = f(lanes)
-        pair_ok = (
-            matched
-            & (jnp.arange(capacity) < total)
-            & fv
-            & fok
-            & src.sel[probe_row]
-        )
+        if len(skeys) > 1:
+            matched = matched & join_ops.verify_rows(
+                fkeys, skeys, build_row, probe_row
+            )
+        pair_ok = matched & src.sel[probe_row]
+        if node.filter is not None:
+            lanes = {}
+            for s, (v, ok) in src.lanes.items():
+                lanes[s] = (v[probe_row], ok[probe_row])
+            for s, (v, ok) in filt.lanes.items():
+                lanes[s] = (v[build_row], ok[build_row] & matched)
+            f = compile_expr(node.filter, self.lowering)
+            fv, fok = f(lanes)
+            pair_ok = pair_ok & fv & fok
         marks = jax.ops.segment_sum(
             pair_ok.astype(jnp.int32), probe_row, num_segments=n_src
         )

@@ -11,14 +11,18 @@ sorted table of SURVEY §7), and the probe is a vectorized binary search
 gather of build-side payload rows.  The reference's 64-bit synthetic row
 address (SyntheticAddress.java:22) maps to the permutation index.
 
-Round-1 scope: unique build keys (FK/dimension joins — every TPC-H join
-except self-joins on lineitem).  Duplicate keys are detected at build time
-and surfaced via `dup_count` so the planner can fall back / fail loudly;
-the many-to-many expansion (two-pass counting) is the next increment.
+Exactness: multi-column keys are packed into a 64-bit mix only to *locate*
+candidate build rows; every candidate is then verified against the real key
+columns (`verify_rows`), the analog of the generated PagesHashStrategy
+positionEqualsRow (JoinCompiler.java:104) running after the hash-bucket
+probe.  A hash collision therefore costs an extra candidate, never a wrong
+row.  Duplicate build keys (or colliding ones) route to the expansion
+kernel (`expand_join`), the vectorized LookupJoinOperator page-building
+loop with two-pass counting.
 
 Join types: inner, left (probe-outer), semi, anti — all mask-based with
-static shapes.  Right/full-outer need the unmatched-build pass
-(LookupOuterOperator analog) — future work.
+static shapes.  Right/full-outer are planned to left + union of the
+null-extended anti side at analysis time (sql/analyzer.py _build_join).
 """
 from __future__ import annotations
 
@@ -143,21 +147,70 @@ def expand_join(
     return probe_row, build_row, matched, total
 
 
-def composite_key(key_lanes, sel) -> Lane:
-    """Combine a multi-column equi-join key into one int64 lane.
+def expand_join_slots(
+    source: MultiLookupSource,
+    counts: jnp.ndarray,
+    lo: jnp.ndarray,
+    capacity: int,
+    outer: bool = False,
+):
+    """expand_join + the slot offset `k` within each probe row's candidate
+    range (k==0 identifies the one row per probe row that carries the
+    null-extended output when an outer probe row has no surviving match)."""
+    eff = jnp.maximum(counts, 1) if outer else counts
+    offsets = jnp.cumsum(eff)
+    total = offsets[-1]
+    j = jnp.arange(capacity, dtype=jnp.int64)
+    probe_row = jnp.searchsorted(offsets, j, side="right")
+    probe_row = jnp.clip(probe_row, 0, counts.shape[0] - 1)
+    start = offsets[probe_row] - eff[probe_row]
+    k = j - start
+    slot = jnp.clip(lo[probe_row] + k, 0, source.sorted_keys.shape[0] - 1)
+    build_row = source.perm[slot]
+    within = j < total
+    matched = within & (k < counts[probe_row])
+    return probe_row, build_row, matched, total, k
 
-    Uses a collision-free pack when domains are known small, else a 64-bit
-    mix (splitmix-style) — collision probability ~n^2/2^64; exactness for
-    multi-key joins comes with the sort-merge join (future work).
+
+def verify_rows(
+    build_keys, probe_keys, build_row: jnp.ndarray,
+    probe_row: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Exact key equality of candidate pairs — the PagesHashStrategy
+    positionEqualsRow analog (JoinCompiler.java:104).  Compares every real
+    key column; NULL keys never match (SQL equi-join semantics)."""
+    eq = None
+    for (bv, bok), (pv, pok) in zip(build_keys, probe_keys):
+        b, bo = bv[build_row], bok[build_row]
+        p = pv if probe_row is None else pv[probe_row]
+        po = pok if probe_row is None else pok[probe_row]
+        e = (b == p) & bo & po
+        eq = e if eq is None else (eq & e)
+    return eq
+
+
+def _mix(h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
+    """One splitmix-style mixing round.  Module-level so adversarial tests
+    can patch in a deliberately weak hash and prove the exact-verification
+    path (verify_rows) absorbs collisions."""
+    h = h * jnp.uint64(0x9E3779B97F4A7C15) + x + jnp.uint64(0x632BE59BD9B4E019)
+    return h ^ (h >> jnp.uint64(31))
+
+
+def composite_key(key_lanes, sel) -> Lane:
+    """Combine a multi-column equi-join key into one int64 *locator* lane.
+
+    Single-column keys pass through (value == locator, collision-free).
+    Multi-column keys get a 64-bit mix used only to find candidate rows;
+    callers MUST filter candidates with `verify_rows` on the real columns —
+    a collision then only costs an extra (rejected) candidate.
     """
     if len(key_lanes) == 1:
         return key_lanes[0]
     h = jnp.zeros_like(key_lanes[0][0], dtype=jnp.uint64)
     allok = None
     for v, ok in key_lanes:
-        x = v.astype(jnp.uint64)
-        h = h * jnp.uint64(0x9E3779B97F4A7C15) + x + jnp.uint64(0x632BE59BD9B4E019)
-        h = h ^ (h >> jnp.uint64(31))
+        h = _mix(h, v.astype(jnp.uint64))
         allok = ok if allok is None else (allok & ok)
     # keep below the invalid sentinel region of build_unique
     h = (h % jnp.uint64(2**62)).astype(jnp.int64)

@@ -104,8 +104,9 @@ class MeshExecutor(LocalExecutor):
         self.dicts = dicts
         self.group_capacity = int(self.config.get("group_capacity", 4096))
         self.join_factor = 1
+        self.force_expansion = set()
 
-        for attempt in range(5):
+        for attempt in range(7):
             ctx = _MeshTraceCtx(self, None, None)
 
             def fragment(scans, counts):
@@ -132,12 +133,15 @@ class MeshExecutor(LocalExecutor):
             out_lanes, sel, checks, dups = jax.jit(shard_fn)(
                 scan_args, counts_args
             )
-            for d in dups:
+            fell_back = False
+            for (join_node, _), d in zip(ctx.dup_checks, dups):
                 if int(d) > 0:
-                    raise ExecutionError(
-                        "join build side has duplicate keys "
-                        "(many-to-many join not yet supported)"
-                    )
+                    # duplicate/colliding build keys: re-trace this join
+                    # with the many-to-many expansion kernel
+                    self.force_expansion.add(id(join_node))
+                    fell_back = True
+            if fell_back:
+                continue
             overflow = any(
                 int(n) > cap
                 for n, cap in zip(checks, ctx.capacity_limits)
