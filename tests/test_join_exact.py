@@ -148,6 +148,34 @@ def test_single_key_duplicate_build_fallback(session):
     assert got == [(10, 1), (10, 2), (30, 3)]
 
 
+def test_sentinel_region_keys(session):
+    # BIGINT keys at/near 2^62 and int64 max must behave like any other
+    # value: dead build slots are excluded by the live-first sort order,
+    # not by reserving part of the key domain
+    big = 2**62
+    rows(session, "create table l (a bigint, lv bigint)")
+    rows(session, "create table r (a bigint, rv bigint)")
+    rows(
+        session,
+        f"insert into l values ({big}, 1), ({big - 1}, 2), (3, 3)",
+    )
+    rows(
+        session,
+        f"insert into r values ({big}, 10), (null, 99), (3, 30)",
+    )
+    got = rows(
+        session,
+        "select l.lv, r.rv from l join r on l.a = r.a order by l.lv",
+    )
+    assert got == [(1, 10), (3, 30)]
+    # semi join: 2^62 present, 2^62-1 absent, NULL build row is no match
+    got = rows(
+        session,
+        "select lv from l where a in (select a from r) order by lv",
+    )
+    assert got == [(1,), (3,)]
+
+
 def test_null_keys_never_match(session, weak_hash):
     rows(session, "create table l (a bigint, b bigint, lv bigint)")
     rows(session, "create table r (a bigint, b bigint, rv bigint)")

@@ -240,7 +240,8 @@ class LocalExecutor:
             hints = self.config.get("capacity_hints")
             hint = hints.get(id(plan)) if hints is not None else None
             if hint is not None:
-                self.group_capacity, self.join_factor, _ = hint
+                self.group_capacity, self.join_factor, forced, _ = hint
+                self.force_expansion = set(forced)
             else:
                 est = self._estimate_group_capacity(plan, counts)
                 if est is not None:
@@ -296,7 +297,8 @@ class LocalExecutor:
             if hints is not None:
                 # the plan reference keeps id(plan) stable (no reuse after gc)
                 hints[id(plan)] = (
-                    self.group_capacity, self.join_factor, plan,
+                    self.group_capacity, self.join_factor,
+                    frozenset(self.force_expansion), plan,
                 )
                 for k in list(hints)[:-512]:
                     hints.pop(k, None)
@@ -1242,8 +1244,8 @@ class _TraceCtx:
         probe_row, build_row, matched, total, k = join_ops.expand_join_slots(
             src, counts, lo, capacity, outer=outer
         )
-        # expand_join's internal eff uses max(counts,1) for outer including
-        # unselected rows; mask them below via probe sel gather
+        # the internal eff uses max(counts,1) for outer including unselected
+        # rows; mask them below via probe sel gather
         self._note_capacity(total, capacity)
         psel = left.sel[probe_row]
         if len(node.criteria) > 1:
@@ -1323,14 +1325,13 @@ class _TraceCtx:
         predicates go through the expansion path with exact verification."""
         if node.filter is not None or len(node.source_keys) > 1:
             return self._semi_hit_expanded(node, src, filt)
-        fv, fok = filt.lanes[node.filtering_keys[0]]
-        live = filt.sel & fok
-        kv = jnp.where(live, fv.astype(jnp.int64), join_ops.I64_MAX)
-        sorted_keys = jax.lax.sort(kv)
-        pv, pok = src.lanes[node.source_keys[0]]
-        idx = jnp.searchsorted(sorted_keys, pv.astype(jnp.int64))
-        safe = jnp.clip(idx, 0, sorted_keys.shape[0] - 1)
-        return (sorted_keys[safe] == pv.astype(jnp.int64)) & pok
+        build = join_ops.build_multi(
+            filt.lanes[node.filtering_keys[0]], filt.sel
+        )
+        counts, _ = join_ops.probe_counts(
+            build, src.lanes[node.source_keys[0]], src.sel
+        )
+        return counts > 0
 
     def _semi_hit_expanded(self, node: P.SemiJoin, src: Batch, filt: Batch):
         """Mark join via candidate expansion: expand (source, filtering)

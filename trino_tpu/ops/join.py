@@ -17,8 +17,8 @@ columns (`verify_rows`), the analog of the generated PagesHashStrategy
 positionEqualsRow (JoinCompiler.java:104) running after the hash-bucket
 probe.  A hash collision therefore costs an extra candidate, never a wrong
 row.  Duplicate build keys (or colliding ones) route to the expansion
-kernel (`expand_join`), the vectorized LookupJoinOperator page-building
-loop with two-pass counting.
+kernel (`expand_join_slots`), the vectorized LookupJoinOperator
+page-building loop with two-pass counting.
 
 Join types: inner, left (probe-outer), semi, anti — all mask-based with
 static shapes.  Right/full-outer are planned to left + union of the
@@ -33,13 +33,25 @@ import jax.numpy as jnp
 
 from ..expr.lower import Lane
 
-I64_MAX = jnp.int64(2**62)
+# dead (unselected/NULL-key) build rows sort to the very end: their key is
+# pinned to int64 max AND a live-before-dead flag breaks the tie, so the
+# first `nvalid` sorted slots are exactly the live rows even when a real
+# key equals int64 max — no value is stolen from the key domain
+_SENTINEL = jnp.int64(2**63 - 1)
+
+
+def _sort_live_first(kv, live, n):
+    dead = (~live).astype(jnp.int32)
+    sorted_keys, _, perm = jax.lax.sort(
+        (kv, dead, jnp.arange(n, dtype=jnp.int64)), num_keys=2
+    )
+    return sorted_keys, perm
 
 
 class LookupSource(NamedTuple):
     """The lent lookup source (PartitionedLookupSourceFactory analog)."""
 
-    sorted_keys: jnp.ndarray  # [n] int64, invalid rows pushed to +inf region
+    sorted_keys: jnp.ndarray  # [n] int64, dead rows pushed to the end
     perm: jnp.ndarray  # [n] original row index per sorted slot
     nvalid: jnp.ndarray  # scalar: number of valid build rows
     dup_count: jnp.ndarray  # scalar: number of duplicate keys (0 required)
@@ -50,13 +62,12 @@ def build_unique(key: Lane, sel: jnp.ndarray) -> LookupSource:
     v, ok = key
     n = v.shape[0]
     live = sel & ok
-    kv = jnp.where(live, v.astype(jnp.int64), I64_MAX)
-    sorted_keys, perm = jax.lax.sort(
-        (kv, jnp.arange(n, dtype=jnp.int64)), num_keys=1
-    )
+    kv = jnp.where(live, v.astype(jnp.int64), _SENTINEL)
+    sorted_keys, perm = _sort_live_first(kv, live, n)
     nvalid = live.sum()
     dup = jnp.sum(
-        (sorted_keys[1:] == sorted_keys[:-1]) & (sorted_keys[1:] < I64_MAX)
+        (sorted_keys[1:] == sorted_keys[:-1])
+        & (jnp.arange(1, n) < nvalid)
     )
     return LookupSource(sorted_keys, perm, nvalid, dup)
 
@@ -67,9 +78,9 @@ def probe(
     """Vectorized lookup: returns (build_row_index, matched mask)."""
     v, ok = key
     pk = v.astype(jnp.int64)
-    idx = jnp.searchsorted(source.sorted_keys, pk)
+    idx = jnp.searchsorted(source.sorted_keys, pk, side="left")
     safe = jnp.clip(idx, 0, source.sorted_keys.shape[0] - 1)
-    hit = (source.sorted_keys[safe] == pk) & (pk < I64_MAX)
+    hit = (source.sorted_keys[safe] == pk) & (safe < source.nvalid)
     matched = sel & ok & hit
     build_row = source.perm[safe]
     return build_row, matched
@@ -97,54 +108,24 @@ def build_multi(key: Lane, sel: jnp.ndarray) -> MultiLookupSource:
     v, ok = key
     n = v.shape[0]
     live = sel & ok
-    kv = jnp.where(live, v.astype(jnp.int64), I64_MAX)
-    sorted_keys, perm = jax.lax.sort(
-        (kv, jnp.arange(n, dtype=jnp.int64)), num_keys=1
-    )
+    kv = jnp.where(live, v.astype(jnp.int64), _SENTINEL)
+    sorted_keys, perm = _sort_live_first(kv, live, n)
     return MultiLookupSource(sorted_keys, perm, live.sum())
 
 
 def probe_counts(
     source: MultiLookupSource, key: Lane, sel: jnp.ndarray
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Per-probe-row match count and first-match slot ([lo,hi) range)."""
+    """Per-probe-row match count and first-match slot ([lo,hi) range);
+    dead build slots (beyond nvalid) and dead probe rows count zero."""
     v, ok = key
-    pk = jnp.where(sel & ok, v.astype(jnp.int64), I64_MAX - 1)
+    pk = v.astype(jnp.int64)
     lo = jnp.searchsorted(source.sorted_keys, pk, side="left")
     hi = jnp.searchsorted(source.sorted_keys, pk, side="right")
-    return (hi - lo).astype(jnp.int64), lo
-
-
-def expand_join(
-    source: MultiLookupSource,
-    counts: jnp.ndarray,
-    lo: jnp.ndarray,
-    capacity: int,
-    outer: bool = False,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Expand probe rows by their match multiplicity into a static-capacity
-    output (the LookupJoinOperator page-building loop, vectorized).
-
-    Returns (probe_row, build_row, matched, total):
-      probe_row[j] : index of the probe row producing output j
-      build_row[j] : build-side row index (garbage where not matched)
-      matched[j]   : output j is a real (joined) row; for outer=True,
-                     unmatched probe rows emit one row with matched=False
-      total        : true output size (host checks vs capacity and retries)
-    """
-    eff = jnp.maximum(counts, 1) if outer else counts
-    offsets = jnp.cumsum(eff)
-    total = offsets[-1]
-    j = jnp.arange(capacity, dtype=jnp.int64)
-    probe_row = jnp.searchsorted(offsets, j, side="right")
-    probe_row = jnp.clip(probe_row, 0, counts.shape[0] - 1)
-    start = offsets[probe_row] - eff[probe_row]
-    k = j - start
-    slot = jnp.clip(lo[probe_row] + k, 0, source.sorted_keys.shape[0] - 1)
-    build_row = source.perm[slot]
-    within = j < total
-    matched = within & (k < counts[probe_row])
-    return probe_row, build_row, matched, total
+    lo = jnp.minimum(lo, source.nvalid)
+    hi = jnp.minimum(hi, source.nvalid)
+    counts = jnp.where(sel & ok, hi - lo, 0).astype(jnp.int64)
+    return counts, lo
 
 
 def expand_join_slots(
@@ -154,9 +135,19 @@ def expand_join_slots(
     capacity: int,
     outer: bool = False,
 ):
-    """expand_join + the slot offset `k` within each probe row's candidate
-    range (k==0 identifies the one row per probe row that carries the
-    null-extended output when an outer probe row has no surviving match)."""
+    """Expand probe rows by their match multiplicity into a static-capacity
+    output (the LookupJoinOperator page-building loop, vectorized).
+
+    Returns (probe_row, build_row, matched, total, k):
+      probe_row[j] : index of the probe row producing output j
+      build_row[j] : build-side row index (garbage where not matched)
+      matched[j]   : output j is a real (candidate) joined row
+      total        : true output size (host checks vs capacity and retries)
+      k            : slot offset within the probe row's candidate range;
+                     k==0 identifies the one row per probe row that carries
+                     the null-extended output when an outer probe row has
+                     no surviving match
+    """
     eff = jnp.maximum(counts, 1) if outer else counts
     offsets = jnp.cumsum(eff)
     total = offsets[-1]
@@ -212,6 +203,7 @@ def composite_key(key_lanes, sel) -> Lane:
     for v, ok in key_lanes:
         h = _mix(h, v.astype(jnp.uint64))
         allok = ok if allok is None else (allok & ok)
-    # keep below the invalid sentinel region of build_unique
+    # fold into the non-negative int64 range (dead rows are handled by the
+    # live-first sort, not by a reserved value region)
     h = (h % jnp.uint64(2**62)).astype(jnp.int64)
     return (h, allok)
