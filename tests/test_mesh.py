@@ -204,3 +204,59 @@ def test_grouping_sets_distributed(session, mesh_exec):
         "group by grouping sets ((o_orderpriority), (o_orderstatus), ()) "
         "order by 3, 1, 2",
     )
+
+
+def test_many_to_many_join_mesh(session, mesh_exec):
+    # lineitem self-joins / fact-fact shapes: duplicate build keys must run
+    # on the mesh via the expansion fallback (previously raised)
+    run_both(
+        session, mesh_exec,
+        "select l.l_orderkey, count(*) from lineitem l "
+        "join orders o on l.l_orderkey = o.o_orderkey "
+        "join lineitem l2 on l2.l_orderkey = o.o_orderkey "
+        "group by l.l_orderkey order by l.l_orderkey limit 20",
+    )
+
+
+def test_left_outer_join_mesh(session, mesh_exec):
+    run_both(
+        session, mesh_exec,
+        "select c.c_custkey, o.o_orderkey from customer c "
+        "left join orders o on o.o_custkey = c.c_custkey "
+        "order by c.c_custkey, o.o_orderkey limit 30",
+    )
+
+
+def test_multikey_join_mesh(session, mesh_exec):
+    run_both(
+        session, mesh_exec,
+        "select count(*), sum(ps.ps_availqty) from lineitem l "
+        "join partsupp ps on l.l_partkey = ps.ps_partkey "
+        "and l.l_suppkey = ps.ps_suppkey",
+    )
+
+
+def test_mesh_divergent_split_dictionaries(tmp_path):
+    # hive files with disjoint string dictionaries on different devices:
+    # codes must be remapped into one union dictionary, not raise
+    from trino_tpu.connectors.hive import write_parquet_table
+    from trino_tpu.page import page_from_pydict
+    from trino_tpu.session import Session
+    from trino_tpu import types as T
+
+    wh = str(tmp_path)
+    page = page_from_pydict(
+        [("s", T.VARCHAR), ("x", T.BIGINT)],
+        {"s": ["aa", "bb", "cc", "dd", "ee", "ff", "gg", "hh"],
+         "x": [1, 2, 3, 4, 5, 6, 7, 8]},
+    )
+    write_parquet_table(wh, "t", page, rows_per_group=2)
+    s = Session()
+    s.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
+    plan = s.plan("select s, x from t where s <> 'aa' order by x")
+    me = MeshExecutor(s.catalogs, default_mesh(8))
+    got = me.execute(plan).to_pylist()
+    assert got == [
+        ("bb", 2), ("cc", 3), ("dd", 4), ("ee", 5),
+        ("ff", 6), ("gg", 7), ("hh", 8),
+    ]

@@ -36,6 +36,7 @@ from ..exec.local import (
     Batch,
     ExecutionError,
     LocalExecutor,
+    merge_pages_to_arrays,
     _pad_capacity,
     _TraceCtx,
 )
@@ -43,9 +44,8 @@ from ..expr.lower import compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
-from ..page import Page
+from ..page import Column, Page
 from ..plan import nodes as P
-from ..spi import Split
 
 AXIS = "workers"
 
@@ -166,53 +166,64 @@ class MeshExecutor(LocalExecutor):
                 conn = self.catalogs.get(node.catalog)
                 cols = [c for _, c in node.assignments]
                 provider = conn.page_source_provider()
-                per_dev: List[Dict[str, np.ndarray]] = []
+                sym_of = {c: self._sym_for(node, c) for c in cols}
+                symbols = [sym_of[c] for c in cols]
+                tmap = dict(node.types)
+                types = [(s, tmap[s]) for s in symbols]
+                # real connector splits (hive files/row groups, tpch shards)
+                # round-robin over devices — the NodeScheduler split
+                # placement, with devices standing in for worker nodes
+                splits = conn.split_manager().get_splits(
+                    node.table, ndev, node.constraint
+                )
+                per_dev: List[Dict[str, tuple]] = []
+                per_dev_dicts: List[Dict[str, np.ndarray]] = []
                 dev_counts: List[int] = []
                 for d in range(ndev):
-                    sp = Split(node.table, d, ndev)
-                    src = provider.create_page_source(sp, cols)
-                    vals: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-                    oks: Dict[str, List[np.ndarray]] = {c: [] for c in cols}
-                    total = 0
-                    for page in src.pages():
-                        for c, col in zip(page.names, page.columns):
-                            vals[c].append(
-                                np.asarray(col.values)[: page.count]
+                    pages = []
+                    for sp in splits[d::ndev]:
+                        src = provider.create_page_source(sp, cols)
+                        for page in src.pages():
+                            src_dicts = src.dictionaries()
+                            new_cols = [
+                                Column(
+                                    col.type, col.values, col.validity,
+                                    col.dictionary
+                                    if col.dictionary is not None
+                                    else src_dicts.get(c),
+                                )
+                                for c, col in zip(page.names, page.columns)
+                            ]
+                            pages.append(
+                                Page(new_cols, page.count,
+                                     [sym_of[c] for c in page.names])
                             )
-                            oks[c].append(
-                                np.ones(page.count, dtype=bool)
-                                if col.validity is None
-                                else np.asarray(col.validity)[: page.count]
-                            )
-                        total += page.count
-                    for c, dct in src.dictionaries().items():
-                        sym = self._sym_for(node, c)
-                        prev = dicts.get(sym)
-                        if (
-                            prev is not None
-                            and prev is not dct
-                            and not np.array_equal(prev, dct)
-                        ):
-                            raise ExecutionError(
-                                f"per-split dictionaries diverge for {c}"
-                            )
-                        dicts[sym] = dct
-                    per_dev.append(
-                        {c: (np.concatenate(v), np.concatenate(oks[c]))
-                         for c, v in vals.items()}
+                    ddicts: Dict[str, np.ndarray] = {}
+                    merged_d, total = merge_pages_to_arrays(
+                        pages, symbols, types, ddicts
                     )
+                    per_dev.append(merged_d)
+                    per_dev_dicts.append(ddicts)
                     dev_counts.append(total)
+                self._merge_split_dicts(per_dev, per_dev_dicts, dicts)
+                for s, t in types:
+                    if t.is_dictionary and s not in dicts:
+                        dicts[s] = np.array([], dtype=object)
                 cap = _pad_capacity(max(max(dev_counts), 1))
                 merged: Dict[str, np.ndarray] = {}
                 for c in cols:
-                    sym = self._sym_for(node, c)
+                    sym = sym_of[c]
                     stacked = np.zeros(
-                        (ndev, cap), dtype=per_dev[0][c][0].dtype
+                        (ndev, cap), dtype=per_dev[0][sym][0].dtype
                     )
                     okstack = np.zeros((ndev, cap), dtype=bool)
                     for d in range(ndev):
-                        stacked[d, : dev_counts[d]] = per_dev[d][c][0]
-                        okstack[d, : dev_counts[d]] = per_dev[d][c][1]
+                        v, ok = per_dev[d][sym]
+                        stacked[d, : dev_counts[d]] = v
+                        okstack[d, : dev_counts[d]] = (
+                            np.ones(dev_counts[d], dtype=bool)
+                            if ok is None else ok
+                        )
                     merged[sym] = stacked
                     merged[sym + "$ok"] = okstack
                 scans[str(id(node))] = merged
@@ -223,6 +234,45 @@ class MeshExecutor(LocalExecutor):
 
         walk(plan)
         return scans, counts, dicts
+
+    def _merge_split_dicts(self, per_dev, per_dev_dicts, dicts):
+        """Unify per-device varchar dictionaries across the mesh: build one
+        union dictionary per symbol and remap each device's codes into it
+        (the cross-task DictionaryBlock unification that
+        exec/local.py merge_pages_to_arrays performs within one task —
+        real hive tables carry per-file dictionaries, so devices holding
+        different files legitimately diverge)."""
+        all_syms = set()
+        for dd in per_dev_dicts:
+            all_syms.update(dd)
+        for sym in all_syms:
+            present = [dd.get(sym) for dd in per_dev_dicts]
+            base = next((d for d in present if d is not None), None)
+            if all(
+                d is None or d is base or np.array_equal(d, base)
+                for d in present
+            ):
+                dicts[sym] = base
+                continue
+            index: Dict[str, int] = {}
+            entries: List[str] = []
+            for dev, d in enumerate(present):
+                if d is None:
+                    continue
+                remap = np.empty(len(d), dtype=np.int32)
+                for i, s in enumerate(d):
+                    s = str(s)
+                    if s not in index:
+                        index[s] = len(entries)
+                        entries.append(s)
+                    remap[i] = index[s]
+                codes, ok = per_dev[dev][sym]
+                safe = np.clip(codes, 0, max(len(d) - 1, 0))
+                per_dev[dev][sym] = (
+                    np.where(codes >= 0, remap[safe], -1).astype(codes.dtype),
+                    ok,
+                )
+            dicts[sym] = np.array(entries, dtype=object)
 
 
 class _MeshTraceCtx(_TraceCtx):
