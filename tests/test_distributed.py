@@ -154,3 +154,26 @@ def test_graceful_shutdown_drains_and_rejects():
         assert down
     finally:
         w.stop()
+
+
+def test_partitioned_join_distributed(oracle_conn):
+    # HASH-HASH join fragments: both inputs repartition on the join key
+    # over the task exchange (AddExchanges PARTITIONED distribution)
+    r = DistributedQueryRunner(
+        workers=2,
+        catalogs=(("tpch", "tpch", {"tpch.scale-factor": SF}),),
+        properties={"join_distribution_type": "partitioned"},
+    )
+    try:
+        for sql in [
+            "select count(*), sum(l_extendedprice) from lineitem l "
+            "join orders o on l.l_orderkey = o.o_orderkey",
+            "select c.c_mktsegment, count(*) from customer c "
+            "join orders o on o.o_custkey = c.c_custkey "
+            "group by c.c_mktsegment order by c.c_mktsegment",
+        ]:
+            actual = r.rows(sql)
+            expected = oracle_conn.execute(sql).fetchall()
+            assert_rows_match(actual, expected, tol=1e-2, ordered=True)
+    finally:
+        r.stop()

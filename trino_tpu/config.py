@@ -35,6 +35,20 @@ def _retry_policy(s: str) -> str:
     return v
 
 
+def _join_distribution(s: str) -> str:
+    v = str(s).strip().lower()
+    if v not in ("automatic", "broadcast", "partitioned"):
+        raise ValueError(
+            "join_distribution_type must be "
+            f"automatic|broadcast|partitioned, got: {s}"
+        )
+    return v
+
+
+# single source of truth for the automatic join-distribution threshold
+# (total build-side rows across all tasks/devices)
+BROADCAST_JOIN_THRESHOLD_ROWS = 1 << 20
+
 SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
     p.name: p
     for p in [
@@ -72,6 +86,19 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "join_build_side",
             "build-side selection: auto | right (disable stats swap)",
             str, "auto",
+        ),
+        PropertyMetadata(
+            "join_distribution_type",
+            "automatic | broadcast | partitioned "
+            "(DetermineJoinDistributionType analog)",
+            _join_distribution, "automatic",
+        ),
+        PropertyMetadata(
+            "broadcast_join_threshold_rows",
+            "automatic mode: build sides with more estimated rows are "
+            "hash-partitioned instead of replicated (join-max-broadcast-"
+            "table-size analog, in rows)",
+            int, BROADCAST_JOIN_THRESHOLD_ROWS,
         ),
         PropertyMetadata(
             "split_count",

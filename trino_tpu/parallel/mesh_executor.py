@@ -44,6 +44,7 @@ from ..expr.lower import compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
+from . import shuffle
 from ..page import Column, Page
 from ..plan import nodes as P
 
@@ -58,6 +59,13 @@ def default_mesh(n: Optional[int] = None) -> Mesh:
 
 def _agather(x: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
+
+
+def _shuffle_chunk(cap: int, ndev: int, factor: int) -> int:
+    """Per-destination chunk capacity for a hash repartition: expected
+    cap/ndev rows per bucket with 2x skew slack, grown by the retry-ladder
+    factor on overflow."""
+    return _pad_capacity(max(128, (2 * cap * factor) // ndev))
 
 
 def _decode_direct_keys(domains, cap):
@@ -441,11 +449,73 @@ class _MeshTraceCtx(_TraceCtx):
     def _visit_join(self, node: P.Join) -> Batch:
         left = self.visit(node.left)
         right = self.visit(node.right)
+        if self._use_partitioned(node, left, right):
+            return self._partitioned_join(node, left, right)
         if not right.replicated:
             # broadcast exchange: replicate build side to all workers
             right = _gather_batch(right)
         out = self._join_batches(node, left, right)
         out.replicated = left.replicated
+        return out
+
+    def _use_partitioned(self, node: P.Join, left: Batch, right: Batch):
+        """The DetermineJoinDistributionType decision at execution time:
+        honor the optimizer's choice when present, else fall back to a
+        capacity heuristic (broadcasting a build side bigger than the
+        threshold would replicate it into every device's HBM)."""
+        if (
+            node.kind not in ("inner", "left")
+            or not node.criteria
+            or left.replicated
+            or right.replicated
+        ):
+            return False
+        if node.distribution == "partitioned":
+            return True
+        if node.distribution == "broadcast":
+            return False
+        from ..config import BROADCAST_JOIN_THRESHOLD_ROWS
+
+        threshold = int(
+            self.ex.config.get(
+                "broadcast_join_threshold_rows",
+                BROADCAST_JOIN_THRESHOLD_ROWS,
+            )
+        )
+        # shape[0] is the per-device shard capacity; the threshold is total
+        # build rows, so broadcasting replicates ndev * shape[0] rows
+        return right.sel.shape[0] * self._ndev() >= threshold
+
+    def _partitioned_join(self, node: P.Join, left: Batch, right: Batch):
+        """HASH-HASH distribution: all-to-all both sides on the join keys,
+        then join locally — each device owns one hash range of the key
+        space (PartitionedLookupSourceFactory / FIXED_HASH exchange pair).
+        NULL-key probe rows of an outer join are retained (routed by the
+        garbage hash, they match nothing but must still emit)."""
+        ndev = self._ndev()
+        factor = getattr(self.ex, "join_factor", 1)
+        lkeys = [left.lanes[l] for l, _ in node.criteria]
+        rkeys = [right.lanes[r] for _, r in node.criteria]
+        lbuck, lok = shuffle.bucket_of(lkeys, left.sel, ndev)
+        rbuck, rok = shuffle.bucket_of(rkeys, right.sel, ndev)
+        lkeep = left.sel & (lok | (node.kind == "left"))
+        rkeep = right.sel & rok
+        lchunk = _shuffle_chunk(left.sel.shape[0], ndev, factor)
+        rchunk = _shuffle_chunk(right.sel.shape[0], ndev, factor)
+        llanes, lsel, lmax = shuffle.repartition(
+            left.lanes, left.sel, lbuck, lkeep, ndev, lchunk, AXIS
+        )
+        rlanes, rsel, rmax = shuffle.repartition(
+            right.lanes, right.sel, rbuck, rkeep, ndev, rchunk, AXIS
+        )
+        self._note_capacity(lmax, lchunk)
+        self._note_capacity(rmax, rchunk)
+        out = self._join_batches(
+            node,
+            Batch(llanes, lsel, replicated=False),
+            Batch(rlanes, rsel, replicated=False),
+        )
+        out.replicated = False
         return out
 
     def _visit_semijoin(self, node: P.SemiJoin) -> Batch:

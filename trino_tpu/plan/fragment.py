@@ -269,15 +269,33 @@ class Fragmenter:
         probe_single = lpart == SINGLE
         if probe_single and rpart == SINGLE:
             return (
-                P.Join(node.kind, left, right, node.criteria, node.filter,
-                       node.expansion),
+                dataclasses.replace(node, left=left, right=right),
                 SINGLE,
                 (),
             )
+        if (
+            node.distribution == "partitioned"
+            and not probe_single
+            and rpart != SINGLE
+            and node.criteria
+        ):
+            # HASH-HASH distribution (AddExchanges PARTITIONED join): both
+            # inputs repartition on their join keys; the join stage is one
+            # task per hash range, with probe AND build streams routed by
+            # the same key hash (partitioner.hash_rows on each child's
+            # output keys — equal key values land on the same task)
+            lsyms = tuple(l for l, _ in node.criteria)
+            rsyms = tuple(r for _, r in node.criteria)
+            lrs = self._cut(left, lpart, lkeys, HASH, lsyms)
+            rrs = self._cut(right, rpart, rkeys, HASH, rsyms)
+            return (
+                dataclasses.replace(node, left=lrs, right=rrs),
+                HASH,
+                lsyms,
+            )
         rs = self._broadcast(right, rpart, rkeys, probe_single)
         return (
-            P.Join(node.kind, left, rs, node.criteria, node.filter,
-                   node.expansion),
+            dataclasses.replace(node, left=left, right=rs),
             lpart,
             lkeys,
         )

@@ -380,6 +380,13 @@ class Join(PlanNode):
     # (vectorized LookupJoinOperator page building); set by the optimizer
     # from connector uniqueness statistics
     expansion: bool = False
+    # exchange placement for the distributed paths, chosen by the optimizer
+    # from stats + session join_distribution_type (the
+    # DetermineJoinDistributionType / AddExchanges.java:138 decision):
+    # "broadcast" replicates the build side (all-gather), "partitioned"
+    # hash-repartitions BOTH sides on the join keys (all-to-all); None means
+    # executors use their own capacity heuristic
+    distribution: Optional[str] = None
 
     @property
     def sources(self):

@@ -27,7 +27,11 @@ from ..expr import ir
 from . import nodes as P
 
 
-def optimize(plan: P.PlanNode, metadata: Optional[Metadata] = None) -> P.PlanNode:
+def optimize(
+    plan: P.PlanNode,
+    metadata: Optional[Metadata] = None,
+    properties=None,
+) -> P.PlanNode:
     prev = None
     cur = plan
     for _ in range(20):
@@ -38,6 +42,7 @@ def optimize(plan: P.PlanNode, metadata: Optional[Metadata] = None) -> P.PlanNod
         cur = _merge_filters(cur)
     if metadata is not None:
         cur = _choose_build_sides(cur, metadata)
+        cur = _choose_join_distribution(cur, metadata, properties)
     cur = _prune_columns(cur)
     cur = _derive_scan_constraints(cur)
     return cur
@@ -436,6 +441,41 @@ def _choose_build_sides(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
             expansion=not l_unique,
         )
     return dataclasses.replace(node, expansion=not r_unique)
+
+
+def _choose_join_distribution(
+    node: P.PlanNode, metadata: Metadata, properties
+) -> P.PlanNode:
+    """DetermineJoinDistributionType + the AddExchanges.java:138 CBO
+    decision: REPLICATED (broadcast the build side) when it is small,
+    PARTITIONED (hash-hash exchange on both sides) when replicating it
+    would blow past the broadcast threshold.  Session property
+    join_distribution_type forces either mode."""
+    import dataclasses
+
+    from ..config import BROADCAST_JOIN_THRESHOLD_ROWS
+
+    mode = "automatic"
+    threshold = BROADCAST_JOIN_THRESHOLD_ROWS
+    if properties is not None:
+        mode = properties.get("join_distribution_type")
+        threshold = properties.get("broadcast_join_threshold_rows")
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        n = _rewrite_sources(n, tuple(walk(s) for s in n.sources))
+        if not (
+            isinstance(n, P.Join)
+            and n.criteria
+            and n.kind in ("inner", "left")
+        ):
+            return n
+        if mode in ("broadcast", "partitioned"):
+            return dataclasses.replace(n, distribution=mode)
+        rrows = _estimate_rows(n.right, metadata)
+        dist = "partitioned" if rrows > threshold else "broadcast"
+        return dataclasses.replace(n, distribution=dist)
+
+    return walk(node)
 
 
 # --- column pruning ----------------------------------------------------

@@ -115,6 +115,9 @@ class Session:
         exec_config["jit_fragments"] = bool(
             self.properties.get("jit_fragments")
         )
+        exec_config["broadcast_join_threshold_rows"] = self.properties.get(
+            "broadcast_join_threshold_rows"
+        )
         exec_config["jit_cache"] = self._jit_cache
         exec_config["capacity_hints"] = self._capacity_hints
         if self.properties.get("distributed"):
@@ -133,7 +136,7 @@ class Session:
                             self.sql_functions)
         plan = analyzer.plan_statement(stmt)
         if optimized:
-            plan = optimize(plan, self.metadata)
+            plan = optimize(plan, self.metadata, self.properties)
         return plan
 
     def explain(self, sql: str) -> str:
@@ -530,7 +533,7 @@ class Session:
                             self.sql_functions)
             plan = analyzer.plan_statement(stmt)
         with self.tracer.span("optimize"):
-            plan = optimize(plan, self.metadata)
+            plan = optimize(plan, self.metadata, self.properties)
         return plan
 
 
