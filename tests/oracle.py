@@ -18,6 +18,10 @@ from trino_tpu.page import Column, Page
 
 
 def load_tpch(conn: sqlite3.Connection, sf: float, tables: Iterable[str]):
+    # SQL-spec (and Trino) LIKE is case-sensitive; sqlite defaults to
+    # case-insensitive ASCII matching, which diverges on patterns like
+    # Q16's '%Customer%Complaints%'
+    conn.execute("PRAGMA case_sensitive_like = ON")
     for table in tables:
         schema = tpch.SCHEMAS[table]
         cols = ", ".join(c for c, _ in schema)

@@ -252,7 +252,10 @@ def generate(
                 dicts[c] = _VOCABS[c]
     elif table == "store_sales":
         ndates = 1827  # 5-year sales window within date_dim
-        date_lo = 36890  # d_date_sk-ish offset: 2000-ish window start index
+        # dsdgen draws store_sales dates from [1998-01-02, 2003-01-02]
+        # (d_date_sk 2450816..2452643) — the window the benchmark queries'
+        # d_year predicates (1998..2002, e.g. Q7's d_year = 2000) target
+        date_lo = 2450816 - DATE_SK_BASE
         for c in cols:
             if c == "ss_sold_date_sk":
                 v = DATE_SK_BASE + date_lo + (

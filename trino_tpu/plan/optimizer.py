@@ -41,6 +41,16 @@ def optimize(
         cur = _push_predicates(cur)
         cur = _merge_filters(cur)
     if metadata is not None:
+        cur = _reorder_joins(cur, metadata)
+        # the reorder re-applies residual predicates above the new join
+        # tree; sink them back down before physical decisions
+        prev = None
+        for _ in range(20):
+            if cur == prev:
+                break
+            prev = cur
+            cur = _push_predicates(cur)
+            cur = _merge_filters(cur)
         cur = _choose_build_sides(cur, metadata)
         cur = _choose_join_distribution(cur, metadata, properties)
     cur = _prune_columns(cur)
@@ -155,6 +165,28 @@ def _conjuncts(e: ir.Expr) -> List[ir.Expr]:
     return [e]
 
 
+def _extract_common_or_conjuncts(e: ir.Expr) -> List[ir.Expr]:
+    """or(and(A, B1), and(A, B2)) -> [A, or(B1, B2)] — the
+    ExtractCommonPredicatesExpressionRewriter analog.  Pulling predicates
+    common to every OR branch above the disjunction lets equi-join keys
+    buried in an OR (TPC-H Q19's p_partkey = l_partkey) reach the join as
+    criteria instead of leaving a cross product."""
+    if not (isinstance(e, ir.Logical) and e.op == "or" and len(e.terms) > 1):
+        return [e]
+    branch_conjs = [_conjuncts(t) for t in e.terms]
+    common = [c for c in branch_conjs[0] if all(c in bc for bc in branch_conjs[1:])]
+    if not common:
+        return [e]
+    reduced = []
+    for bc in branch_conjs:
+        rest = [c for c in bc if c not in common]
+        if not rest:
+            # one branch reduces to TRUE: the disjunction adds nothing
+            return common
+        reduced.append(_combine(rest))
+    return common + [ir.Logical("or", tuple(reduced))]
+
+
 def _combine(conj: List[ir.Expr]) -> Optional[ir.Expr]:
     if not conj:
         return None
@@ -193,7 +225,9 @@ def _push_predicates(node: P.PlanNode) -> P.PlanNode:
     if not isinstance(node, P.Filter):
         return node
     src = node.source
-    conj = _conjuncts(node.predicate)
+    conj = []
+    for c in _conjuncts(node.predicate):
+        conj.extend(_extract_common_or_conjuncts(c))
 
     if isinstance(src, P.Filter):
         return _push_predicates(
@@ -342,6 +376,100 @@ def _merge_filters(node: P.PlanNode) -> P.PlanNode:
             _combine(_conjuncts(node.predicate) + _conjuncts(node.source.predicate)),
         )
     return node
+
+
+# --- join reordering ---------------------------------------------------
+
+
+def _reorder_joins(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    """EliminateCrossJoins + greedy ReorderJoins (iterative/rule/
+    ReorderJoins.java:97, EliminateCrossJoins):
+    flatten each maximal region of inner/cross joins into a join graph
+    (leaves + equi edges), then rebuild left-deep so every added relation
+    connects to the prefix through an equi edge when one exists — a
+    disconnected FROM list degrades to at most one final cross join instead
+    of materializing giant intermediate cross products.  Among connectable
+    relations the one with the smallest estimated row count joins first
+    (dimension tables early), the largest relation anchors as the streaming
+    probe base."""
+    node = _rewrite_sources(
+        node, tuple(_reorder_joins(s, metadata) for s in node.sources)
+    )
+    if not (
+        isinstance(node, P.Join) and node.kind in ("inner", "cross")
+    ):
+        return node
+
+    leaves: List[P.PlanNode] = []
+    criteria: List[Tuple[str, str]] = []
+    residuals: List[ir.Expr] = []
+
+    def flatten(n: P.PlanNode):
+        if isinstance(n, P.Join) and n.kind in ("inner", "cross"):
+            flatten(n.left)
+            flatten(n.right)
+            criteria.extend(n.criteria)
+            if n.filter is not None:
+                residuals.extend(_conjuncts(n.filter))
+        else:
+            leaves.append(n)
+
+    flatten(node)
+    if len(leaves) <= 2:
+        return node
+
+    sym_of = [set(l.output_symbols()) for l in leaves]
+    est = [_estimate_rows(l, metadata) for l in leaves]
+    # anchor on the largest relation (the fact table stays the probe side)
+    start = max(range(len(leaves)), key=lambda i: est[i])
+    placed = {start}
+    cur_syms = set(sym_of[start])
+    result = leaves[start]
+    unused = list(criteria)
+
+    def edges_to(i: int) -> List[Tuple[str, str]]:
+        out = []
+        for a, b in unused:
+            if (a in cur_syms and b in sym_of[i]) or (
+                b in cur_syms and a in sym_of[i]
+            ):
+                out.append((a, b))
+        return out
+
+    while len(placed) < len(leaves):
+        open_idx = [i for i in range(len(leaves)) if i not in placed]
+        connectable = [i for i in open_idx if edges_to(i)]
+        pick_from = connectable or open_idx
+        nxt = min(pick_from, key=lambda i: est[i])
+        edges = edges_to(nxt)
+        oriented = tuple(
+            (a, b) if a in cur_syms else (b, a) for a, b in edges
+        )
+        for e in edges:
+            unused.remove(e)
+        result = P.Join(
+            "inner" if oriented else "cross",
+            result,
+            leaves[nxt],
+            oriented,
+        )
+        placed.add(nxt)
+        cur_syms |= sym_of[nxt]
+    # residual join filters (non-equi conjuncts) re-apply above; the next
+    # pushdown round sinks them back to the lowest join that covers them
+    types = node.output_types()
+    rest = _combine(
+        residuals
+        + [
+            ir.Comparison(
+                "=",
+                ir.ColumnRef(types[a], a),
+                ir.ColumnRef(types[b], b),
+            )
+            for a, b in unused
+        ]
+    )
+    return P.Filter(result, rest) if rest else result
 
 
 # --- build-side selection ---------------------------------------------

@@ -470,6 +470,8 @@ class Session:
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):
             page = executor.execute(plan)
+        # input working-set size of the last query (bench + stats surface)
+        self.last_scan_bytes = getattr(executor, "scan_bytes", 0)
         return page
 
     def _explain_analyze(self, query, query_id: str) -> Page:
