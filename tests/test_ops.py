@@ -93,7 +93,7 @@ def test_sort_based_grouping_multi_key():
     k1 = lane([3, 1, 3, 1, 3], dtype=jnp.int64)
     k2 = lane([0, 1, 0, 1, 1], dtype=jnp.int64)
     sel = allsel(5)
-    perm, gid, ngroups = agg.sort_group_ids([k1, k2], sel, 8)
+    perm, gid, ngroups, coll = agg.sort_group_ids([k1, k2], sel, 8)
     assert int(ngroups) == 3
     # aggregate x by groups through the permutation
     x = jnp.asarray([10.0, 20.0, 30.0, 40.0, 50.0])
@@ -208,7 +208,7 @@ def test_sort_multi_key_desc_nulls():
 
 def test_topn():
     lanes = {"x": lane([5, 3, 9, 1, 7])}
-    out, sel = S.topn([S.SortKey("x", False)], lanes, allsel(5), 2)
+    out, sel, _ = S.topn([S.SortKey("x", False)], lanes, allsel(5), 2)
     v, _ = out["x"]
     assert list(np.asarray(v)) == [9, 7]
     assert v.shape == (2,)
@@ -235,3 +235,59 @@ def test_jit_compatibility():
 
     r = pipeline(jnp.arange(8, dtype=jnp.int64), jnp.arange(8, dtype=jnp.int64) % 3)
     assert int(np.asarray(r)[0]) == 0 + 3 + 6
+
+
+def test_group_hash_collision_retry(monkeypatch):
+    """A grouping locator collision must be detected and retried with a
+    fresh salt, never silently merging distinct groups."""
+    import jax.numpy as jnp
+
+    from trino_tpu.ops import aggregation as agg_ops
+    from trino_tpu.session import Session
+
+    real = agg_ops._group_hash
+
+    def weak_then_real(key_lanes, salt):
+        if salt == 0:  # force every key into 2 buckets on the first try
+            h = real(key_lanes, salt)
+            return h % jnp.int64(2)
+        return real(key_lanes, salt)
+
+    monkeypatch.setattr(agg_ops, "_group_hash", weak_then_real)
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (k bigint, v bigint)")
+    s.execute("insert into t values (1,1),(2,2),(3,3),(4,4),(1,5)")
+    got = s.execute(
+        "select k, count(*), sum(v) from t group by k order by k"
+    ).to_pylist()
+    assert got == [(1, 2, 6), (2, 1, 2), (3, 1, 3), (4, 1, 4)]
+
+
+def test_f64_order_bits_matches_ieee():
+    """The arithmetic f64 encoder must equal the radix-sortable transform
+    of the true IEEE bit pattern (injective + order preserving), modulo
+    XLA's DAZ semantics (subnormals/-0 == +0)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.ops.aggregation import f64_order_bits
+
+    rng = np.random.default_rng(5)
+    vals = np.concatenate([
+        rng.standard_normal(20000) * 10.0 ** rng.integers(-300, 300, 20000),
+        np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 1.0, 2.0, 4.0, 0.5,
+                  np.nextafter(1.0, 2.0), np.nextafter(2.0, 1.0),
+                  2.2250738585072014e-308,
+                  1.7976931348623157e308, -1.7976931348623157e308]),
+        10.0 ** rng.uniform(-300, 308, 20000) * rng.choice([-1., 1.], 20000),
+    ])
+    got = np.asarray(f64_order_bits(jnp.asarray(vals)))
+    bits = vals.view(np.uint64).copy()
+    bits[np.isnan(vals)] = 0x7FF8000000000000
+    # canonicalize what XLA cannot distinguish: -0 -> +0, subnormal -> 0
+    tiny = np.abs(vals) < 2.2250738585072014e-308
+    bits[tiny & ~np.isnan(vals)] = 0
+    neg = (bits >> 63 == 1) & ~np.isnan(vals) & ~tiny
+    exp = np.where(neg, ~bits, bits | np.uint64(1 << 63)).astype(np.uint64)
+    assert np.array_equal(got, exp)

@@ -533,19 +533,26 @@ def _format_lazy(spec, schema_type) -> Tuple[np.ndarray, np.ndarray]:
         nw = np.uint64(len(P_NAME_WORDS))
         # 5 hash-chosen words per part (dbgen draws 5 distinct; hash draws
         # may rarely repeat a word within one name — selectivity of word
-        # predicates is preserved to ~0.1%)
+        # predicates is preserved to ~0.1%).  Names can collide across
+        # parts (dbgen's do too), so the dictionary is DEDUPED and codes
+        # remapped — code equality must equal string equality.
         picks = [
             (h64(f"p_name_{slot}", keys) % nw).astype(np.int64)
             for slot in range(5)
         ]
         W = P_NAME_WORDS
-        d = np.array(
-            [
-                " ".join((W[a], W[b], W[c], W[e], W[f]))
-                for a, b, c, e, f in zip(*picks)
-            ],
-            dtype=object,
-        )
+        index: dict = {}
+        entries: list = []
+        codes = np.empty(len(keys), dtype=np.int32)
+        for i, (a, b, c, e, f) in enumerate(zip(*picks)):
+            s = " ".join((W[a], W[b], W[c], W[e], W[f]))
+            code = index.get(s)
+            if code is None:
+                code = len(entries)
+                index[s] = code
+                entries.append(s)
+            codes[i] = code
+        return codes, np.array(entries, dtype=object)
     elif spec[0] == "phone":
         _, cc, hh = spec
         n1 = (hh >> np.uint64(10)) % np.uint64(900) + np.uint64(100)

@@ -233,6 +233,8 @@ class LocalExecutor:
             # hash-colliding) keys: re-traced with the expansion kernel
             # (HashBuilderOperator never assumes uniqueness; we learn it)
             self.force_expansion = set()
+            self.group_salt = 0
+            self.topn_factor = 1
             # start at the last successful capacities for this plan: the
             # overflow ladder re-runs (and on first touch, re-COMPILES) the
             # whole fragment per rung, so remembering the landing spot makes
@@ -240,7 +242,8 @@ class LocalExecutor:
             hints = self.config.get("capacity_hints")
             hint = hints.get(id(plan)) if hints is not None else None
             if hint is not None:
-                self.group_capacity, self.join_factor, forced, _ = hint
+                (self.group_capacity, self.join_factor, self.topn_factor,
+                 forced, _) = hint
                 self.force_expansion = set(forced)
             else:
                 est = self._estimate_group_capacity(plan, counts)
@@ -261,18 +264,19 @@ class LocalExecutor:
             )
             for attempt in range(7):
                 if use_jit:
-                    out_lanes, sel, ordered, checks, dups = self._run_jitted(
-                        plan, scans, counts
-                    )
+                    (out_lanes, sel, ordered, checks, dups,
+                     colls) = self._run_jitted(plan, scans, counts)
                 else:
                     ctx = self.trace_ctx_cls(self, scans, counts)
                     out_lanes, sel, ordered, checks = self._run(plan, ctx)
                     dups = ctx.dup_checks
+                    colls = ctx.collision_checks
                 # one round trip for all control scalars (the accelerator
                 # may sit behind a high-latency tunnel: per-scalar int()
                 # costs one RTT each)
-                dup_vals, check_vals = jax.device_get(
-                    ([d for _, d in dups], [ng for ng, _ in checks])
+                dup_vals, check_vals, coll_vals = jax.device_get(
+                    ([d for _, d in dups], [ng for ng, _, _ in checks],
+                     list(colls))
                 )
                 fell_back = False
                 for (join_node, _), dup in zip(dups, dup_vals):
@@ -281,16 +285,26 @@ class LocalExecutor:
                         # the many-to-many expansion kernel for this join
                         self.force_expansion.add(id(join_node))
                         fell_back = True
+                for cv in coll_vals:
+                    if int(cv) > 0:
+                        # locator hash collision in grouping: re-run
+                        # the fragment under a fresh salt (exactness)
+                        self.group_salt += 1
+                        fell_back = True
                 if fell_back:
                     continue
-                overflow = False
-                for ngroups, (_, cap) in zip(check_vals, checks):
+                over_kinds = set()
+                for ngroups, (_, cap, kind) in zip(check_vals, checks):
                     if int(ngroups) > cap:
-                        overflow = True
-                if not overflow:
+                        over_kinds.add(kind)
+                if not over_kinds:
                     break
-                self.group_capacity *= 8
-                self.join_factor *= 8
+                if "group" in over_kinds:
+                    self.group_capacity *= 8
+                if "join" in over_kinds:
+                    self.join_factor *= 8
+                if "topn" in over_kinds:
+                    self.topn_factor *= 8
             else:
                 raise ExecutionError("group capacity overflow after retries")
 
@@ -298,6 +312,7 @@ class LocalExecutor:
                 # the plan reference keeps id(plan) stable (no reuse after gc)
                 hints[id(plan)] = (
                     self.group_capacity, self.join_factor,
+                    self.topn_factor,
                     frozenset(self.force_expansion), plan,
                 )
                 for k in list(hints)[:-512]:
@@ -596,6 +611,8 @@ class LocalExecutor:
         }
         key = (
             id(plan), self.group_capacity, self.join_factor,
+            getattr(self, "topn_factor", 1),
+            getattr(self, "group_salt", 0),
             frozenset(getattr(self, "force_expansion", ())),
             # scan-cache keys embed the connector data_version, so a write
             # that keeps row counts constant still recompiles (and refreshes
@@ -614,13 +631,14 @@ class LocalExecutor:
                 ctx.prepared = True
                 out_lanes, sel, ordered, checks = self._run(plan, ctx)
                 cell["ordered"] = ordered
-                cell["caps"] = [c for _, c in checks]
+                cell["caps"] = [(c, k) for _, c, k in checks]
                 cell["dup_nodes"] = [n for n, _ in ctx.dup_checks]
                 return (
                     out_lanes,
                     sel,
-                    tuple(ng for ng, _ in checks),
+                    tuple(ng for ng, _, _ in checks),
                     tuple(d for _, d in ctx.dup_checks),
+                    tuple(ctx.collision_checks),
                 )
 
             fn = jax.jit(raw)
@@ -632,10 +650,13 @@ class LocalExecutor:
             cell = entry["cell"]
             self.dicts.update(cell["dicts"])
             out = entry["fn"](prep)
-        out_lanes, sel, ngroups, dup_vals = out
-        checks = list(zip(ngroups, cell["caps"]))
+        out_lanes, sel, ngroups, dup_vals, colls = out
+        checks = [
+            (ng, cap, kind)
+            for ng, (cap, kind) in zip(ngroups, cell["caps"])
+        ]
         dups = list(zip(cell["dup_nodes"], dup_vals))
-        return out_lanes, sel, cell["ordered"], checks, dups
+        return out_lanes, sel, cell["ordered"], checks, dups, colls
 
     # ------------------------------------------------------------------
     def _run(self, plan: P.Output, ctx: "_TraceCtx"):
@@ -673,6 +694,7 @@ class _TraceCtx:
         self.counts = counts
         self.capacity_checks: List[Tuple[jnp.ndarray, int]] = []
         self.dup_checks: List[Tuple[P.PlanNode, jnp.ndarray]] = []
+        self.collision_checks: List[jnp.ndarray] = []
         self.lowering = LoweringContext(ex.dicts)
 
     # -- dispatch -------------------------------------------------------
@@ -778,7 +800,7 @@ class _TraceCtx:
         syms = node.output_symbols()
         key_lanes = [b.lanes[s] for s in syms]
         cap = b.sel.shape[0]
-        perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
+        perm, gid, ngroups = self._group_sort(key_lanes, b.sel, cap)
         sel_sorted = b.sel[perm]
         boundary = jnp.concatenate(
             [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
@@ -1056,7 +1078,7 @@ class _TraceCtx:
             keys_out = agg_ops.group_keys_output(key_lanes, gid, b.sel, cap)
         else:
             cap = min(self.ex.group_capacity, b.sel.shape[0])
-            perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
+            perm, gid, ngroups = self._group_sort(key_lanes, b.sel, cap)
             self._note_capacity(ngroups, cap)
             sel_sorted = b.sel[perm]
             sorted_lanes = {
@@ -1165,8 +1187,25 @@ class _TraceCtx:
         return domains if prod <= 4096 else None
 
     # -- joins -----------------------------------------------------------
-    def _note_capacity(self, ngroups, cap):
-        self.capacity_checks.append((ngroups, cap))
+    def _note_capacity(self, ngroups, cap, kind="group"):
+        # kind selects which knob the retry ladder grows on overflow:
+        # group -> group_capacity, join -> join_factor (expansion /
+        # shuffle buffers), topn -> topn_factor (candidate sets) —
+        # uncoupled so a TopN tie burst cannot 8x every join buffer
+        self.capacity_checks.append((ngroups, cap, kind))
+
+    def _note_collision(self, coll):
+        self.collision_checks.append(coll)
+
+    def _group_sort(self, key_lanes, sel, cap):
+        """Salted hash-sort grouping with exact verification; a
+        detected locator collision re-runs the fragment under a fresh
+        salt (executor retry ladder), so grouping is always exact."""
+        perm, gid, ngroups, coll = agg_ops.sort_group_ids(
+            key_lanes, sel, cap, getattr(self.ex, 'group_salt', 0)
+        )
+        self._note_collision(coll)
+        return perm, gid, ngroups
 
     def _visit_join(self, node: P.Join) -> Batch:
         left = self.visit(node.left)
@@ -1246,7 +1285,7 @@ class _TraceCtx:
         )
         # the internal eff uses max(counts,1) for outer including unselected
         # rows; mask them below via probe sel gather
-        self._note_capacity(total, capacity)
+        self._note_capacity(total, capacity, "join")
         psel = left.sel[probe_row]
         if len(node.criteria) > 1:
             matched = matched & join_ops.verify_rows(
@@ -1351,7 +1390,7 @@ class _TraceCtx:
         probe_row, build_row, matched, total, _ = join_ops.expand_join_slots(
             build, counts, lo, capacity
         )
-        self._note_capacity(total, capacity)
+        self._note_capacity(total, capacity, "join")
         if len(skeys) > 1:
             matched = matched & join_ops.verify_rows(
                 fkeys, skeys, build_row, probe_row
@@ -1398,7 +1437,12 @@ class _TraceCtx:
     def _visit_topn(self, node: P.TopN) -> Batch:
         b = self.visit(node.source)
         keys = self._rank_sort_keys(node.keys, b)
-        lanes, sel = sort_ops.topn(keys, b.lanes, b.sel, node.count)
+        lanes, sel, check = sort_ops.topn(
+            keys, b.lanes, b.sel, node.count,
+            getattr(self.ex, 'topn_factor', 1),
+        )
+        if check is not None:
+            self._note_capacity(check[0], check[1], "topn")
         return Batch(lanes, sel, ordered=True, replicated=b.replicated)
 
     def _rank_sort_keys(self, keys, b: Batch):
@@ -1522,7 +1566,7 @@ class _TraceCtx:
             # UNION DISTINCT via the Distinct path
             key_lanes = [lanes[s] for s in node.symbols]
             cap = sel.shape[0]
-            perm, gid, _ = agg_ops.sort_group_ids(key_lanes, sel, cap)
+            perm, gid, _ = self._group_sort(key_lanes, sel, cap)
             boundary = jnp.concatenate(
                 [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
             )
@@ -1584,7 +1628,7 @@ class _TraceCtx:
         ])
         cap = sel.shape[0]
         key_lanes = [lanes0[s] for s in node.symbols]
-        perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, sel, cap)
+        perm, gid, ngroups = self._group_sort(key_lanes, sel, cap)
         self._note_capacity(ngroups, cap)
         sel_sorted = sel[perm]
         tag_sorted = tag[perm]

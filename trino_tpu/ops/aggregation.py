@@ -149,37 +149,145 @@ def direct_group_ids(
     return gid, cap
 
 
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+_SALT_C = jnp.uint64(0x632BE59BD9B4E019)
+
+
+def _exp2i_pair(e: jnp.ndarray):
+    """Exact 2^e for integer |e| <= 1046, as TWO f64 factors (apply
+    sequentially to stay in range).  Built by binary factorization from
+    exact power-of-two constants — no ldexp/exp2 primitive is trusted,
+    since XLA:TPU's x64 rewrite lacks ldexp/frexp/64-bit bitcasts and
+    library exp2 makes no exactness promise."""
+    half = e // 2
+    rest = e - half
+
+    def pow_part(k):
+        r = jnp.ones(k.shape, dtype=jnp.float64)
+        a = jnp.abs(k)
+        for j in range(10):  # covers |k| <= 1023
+            c = jnp.where(
+                k >= 0, jnp.float64(2.0 ** (1 << j)),
+                jnp.float64(2.0 ** -(1 << j)),
+            )
+            r = r * jnp.where((a >> j) & 1 == 1, c, jnp.float64(1.0))
+        return r
+
+    return pow_part(half), pow_part(rest)
+
+
+def f64_order_bits(v: jnp.ndarray) -> jnp.ndarray:
+    """IEEE-754-equivalent uint64 for doubles, built ARITHMETICALLY
+    because bitcast f64<->u64 (and frexp/ldexp) are unimplemented in
+    XLA:TPU's x64 rewrite.  Exponent comes from a log2 estimate corrected
+    by exact comparisons; the mantissa is extracted with exact
+    power-of-two scaling, so the result EQUALS the IEEE bit pattern:
+    injective (collision-verify soundness) and order-preserving, with NaN
+    above +inf (Trino's NaN-largest rule).  The result is the classic
+    radix-sortable float transform of that pattern."""
+    v = v.astype(jnp.float64)
+    av = jnp.abs(v)
+    # normal path: av = m * 2^e0 with m in [1, 2)
+    e0 = jnp.clip(
+        jnp.floor(jnp.log2(jnp.where(av > 0, av, 1.0))), -1022.0, 1023.0
+    ).astype(jnp.int32)
+    s1, s2 = _exp2i_pair(-e0)
+    m = av * s1 * s2
+    for _ in range(2):  # log2 may misbin by one near boundaries
+        big = m >= 2.0
+        m = jnp.where(big, m * 0.5, m)
+        e0 = e0 + big.astype(jnp.int32)
+        small = (m < 1.0) & (m > 0)
+        m = jnp.where(small, m * 2.0, m)
+        e0 = e0 - small.astype(jnp.int32)
+    safe_m = jnp.clip(m, 1.0, 2.0 - 2.0**-52)
+    m_int = ((safe_m - 1.0) * jnp.float64(2.0**52)).astype(jnp.uint64)
+    E = jnp.clip(e0 + 1023, 1, 2046).astype(jnp.uint64)
+    bits = (E << jnp.uint64(52)) | m_int
+    # subnormals, -0 and +0 all encode as 0: XLA arithmetic/comparisons
+    # flush subnormals (DAZ) — verified: (5e-324 == 0.0) is True in-engine
+    # — so one shared encoding is exactly consistent with the comparison
+    # semantics the sort/verify kernels use
+    tiny = av < jnp.float64(2.2250738585072014e-308)
+    bits = jnp.where(tiny, jnp.uint64(0), bits)
+    bits = jnp.where(jnp.isinf(av), jnp.uint64(0x7FF0000000000000), bits)
+    bits = jnp.where(jnp.isnan(v), jnp.uint64(0x7FF8000000000000), bits)
+    neg = (v < 0) & ~jnp.isnan(v)
+    pattern = bits | (neg.astype(jnp.uint64) << jnp.uint64(63))
+    # total order: flip all bits for negatives, set the sign bit for
+    # non-negatives (the classic radix-sortable float transform)
+    return jnp.where(neg, ~pattern, pattern | jnp.uint64(1 << 63))
+
+
+def _key_bits(v: jnp.ndarray) -> jnp.ndarray:
+    """Key column as uint64 bit material: floats get an injective
+    order-preserving arithmetic encoding (no f64 bitcast on TPU), so
+    distinct values never merge before hashing and NaN has a stable
+    identity for both hashing and exact verification."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return f64_order_bits(v)
+    return v.astype(jnp.uint64)
+
+
+def _group_hash(key_lanes: Sequence[Lane], salt: int) -> jnp.ndarray:
+    """Salted 64-bit key-tuple locator.  The NULL flag is mixed as its own
+    round (not as a sentinel value), so `NULL` and any real value can never
+    permanently collide — a salt change re-randomizes every collision."""
+    n = key_lanes[0][0].shape[0]
+    h = jnp.full(n, jnp.uint64(salt * 2 + 1) * _GOLDEN, dtype=jnp.uint64)
+    for v, ok in key_lanes:
+        h = h * _GOLDEN + ok.astype(jnp.uint64) + _SALT_C
+        h = h ^ (h >> jnp.uint64(31))
+        h = h * _GOLDEN + jnp.where(ok, _key_bits(v), jnp.uint64(0))
+        h = h ^ (h >> jnp.uint64(29))
+    return (h % jnp.uint64(2**61)).astype(jnp.int64)
+
+
 def sort_group_ids(
-    key_lanes: Sequence[Lane], sel: jnp.ndarray, capacity: int
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Sort-based grouping: returns (perm, gid_sorted, ngroups).
+    key_lanes: Sequence[Lane],
+    sel: jnp.ndarray,
+    capacity: int,
+    salt: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Hash-sort grouping: returns (perm, gid_sorted, ngroups, collisions).
 
     perm reorders rows so equal keys are adjacent (unselected rows last);
     gid_sorted[i] is the group id of sorted row i (unselected rows get
     capacity-1 but are excluded by weight later).
-    """
+
+    TPU-first design note: a lexicographic multi-key `lax.sort` compiles a
+    (1+2k)-operand comparator whose XLA:TPU compile time explodes with k
+    (~190s for k=3 at 8M rows vs ~50s for one key).  Instead rows sort by
+    ONE salted 64-bit locator hash of the key tuple, and adjacent rows in
+    the same hash run are verified equal on the real key columns — the
+    `collisions` output counts mismatches (probability ~n²/2⁻⁶⁴) and the
+    executor re-runs with a fresh salt when it is ever nonzero, so results
+    are exact, never probabilistic (same protocol as the join locators)."""
     n = key_lanes[0][0].shape[0]
-    operands = [jnp.logical_not(sel)]
-    for v, ok in key_lanes:
-        operands.append(jnp.logical_not(ok))
-        # NULL keys form ONE group whatever the masked value holds
-        # (GROUPING SETS masks keys without zeroing the value lane)
-        operands.append(jnp.where(ok, v, jnp.zeros((), v.dtype)))
-    operands.append(jnp.arange(n, dtype=jnp.int64))
-    num_keys = len(operands) - 1
-    sorted_ops = jax.lax.sort(tuple(operands), num_keys=num_keys)
-    perm = sorted_ops[-1]
-    sel_sorted = jnp.logical_not(sorted_ops[0])
-    # boundary: first selected row of a distinct key tuple
-    diff = jnp.zeros(n, dtype=bool).at[0].set(True)
-    for k in range(1, num_keys):
-        col = sorted_ops[k]
-        diff = diff | jnp.concatenate([jnp.ones(1, bool), col[1:] != col[:-1]])
+    hk = _group_hash(key_lanes, salt)
+    key = jnp.where(sel, hk, jnp.int64(2**61))  # dead rows sort last
+    sorted_key, perm = jax.lax.sort(
+        (key, jnp.arange(n, dtype=jnp.int64)), num_keys=1
+    )
+    sel_sorted = sorted_key < jnp.int64(2**61)
+    diff = jnp.concatenate(
+        [jnp.ones(1, bool), sorted_key[1:] != sorted_key[:-1]]
+    )
     boundary = diff & sel_sorted
+    # exact adjacent verification (PagesHashStrategy positionEquals analog)
+    prev = jnp.concatenate([perm[:1], perm[:-1]])
+    same_run = (~diff) & sel_sorted
+    all_eq = jnp.ones(n, dtype=bool)
+    for v, ok in key_lanes:
+        okp, okq = ok[perm], ok[prev]
+        bits = _key_bits(v)
+        lane_eq = (okp == okq) & (~okp | (bits[perm] == bits[prev]))
+        all_eq = all_eq & lane_eq
+    collisions = jnp.sum(same_run & ~all_eq)
     gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
     ngroups = boundary.sum()
     gid = jnp.where(sel_sorted, jnp.clip(gid, 0, capacity - 1), capacity - 1)
-    return perm, gid, ngroups
+    return perm, gid, ngroups, collisions
 
 
 def distinct_count(

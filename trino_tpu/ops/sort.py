@@ -64,17 +64,71 @@ def apply_perm(
     return out, sel[perm]
 
 
+_SIGN = jnp.uint64(1 << 63)
+
+
+def _order_encode(v, ok, sel, key: SortKey) -> jnp.ndarray:
+    """Rank-preserving uint64 for one sort key where LARGER = earlier in
+    the output; unselected rows are strictly worst.  The low bit is
+    sacrificed for the selection flag, so distinct values may tie — safe,
+    because phase 2 re-sorts candidates on the exact keys and the
+    completeness check counts encoded ties."""
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        from .aggregation import f64_order_bits
+
+        # arithmetic IEEE reconstruction — bitcast f64<->u64 is
+        # unimplemented in XLA:TPU's x64 rewrite
+        enc = f64_order_bits(v)
+    elif v.dtype.kind == "b":
+        enc = v.astype(jnp.uint64)
+    else:
+        enc = v.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN
+    if key.ascending:
+        enc = ~enc  # top_k picks largest; ascending wants smallest first
+    enc = jnp.where(ok, enc, jnp.uint64(0) if not key.nulls_first else ~jnp.uint64(0))
+    enc = (enc >> jnp.uint64(1)) | (sel.astype(jnp.uint64) << jnp.uint64(63))
+    # top_k wants a signed operand; u64->i64 after flipping the sign bit is
+    # the monotone modular wrap (no 64-bit bitcast on TPU)
+    return (enc ^ _SIGN).astype(jnp.int64)
+
+
 def topn(
     keys: Sequence[SortKey],
     lanes: Dict[str, Lane],
     sel: jnp.ndarray,
     n: int,
-) -> Tuple[Dict[str, Lane], jnp.ndarray]:
-    """Sorted first-n rows (static slice; result capacity = n)."""
-    perm = sort_perm(keys, lanes, sel)
-    out, s = apply_perm(lanes, perm, sel)
-    out = {name: (v[:n], ok[:n]) for name, (v, ok) in out.items()}
-    return out, s[:n]
+    factor: int = 1,
+) -> Tuple[Dict[str, Lane], jnp.ndarray, Tuple[jnp.ndarray, int] | None]:
+    """Sorted first-n rows (static slice; result capacity = n).
+
+    TPU-first: for small n over large inputs, a full multi-operand
+    lexicographic sort compiles slowly on XLA:TPU, so phase 1 runs
+    `lax.top_k` on a rank-preserving encoding of the FIRST key only,
+    keeping 4n candidates, and phase 2 sorts just those candidates on all
+    keys.  Exactness: any row excluded by phase 1 is strictly worse on the
+    first key than the n-th candidate, so it cannot reach the top n; ties
+    on the encoded key are counted and returned as a (count, capacity)
+    check — the executor's retry ladder re-runs with a larger candidate
+    set if ties ever exceed it (TopNOperator semantics, never heuristic).
+    """
+    total = sel.shape[0]
+    kprime = max(64, 1 << (max(n, 1) * 4 * factor - 1).bit_length())
+    if not keys or kprime >= total:
+        perm = sort_perm(keys, lanes, sel)
+        out, s = apply_perm(lanes, perm, sel)
+        out = {name: (v[:n], ok[:n]) for name, (v, ok) in out.items()}
+        return out, s[:n], None
+    v, ok = lanes[keys[0].column]
+    enc = _order_encode(v, ok, sel, keys[0])
+    top_enc, idx = jax.lax.top_k(enc, kprime)
+    kth = top_enc[n - 1]
+    ties = jnp.sum((enc >= kth) & sel)
+    cand = {name: (vv[idx], oo[idx]) for name, (vv, oo) in lanes.items()}
+    cand_sel = sel[idx]
+    perm = sort_perm(keys, cand, cand_sel)
+    out, s = apply_perm(cand, perm, cand_sel)
+    out = {name: (v2[:n], ok2[:n]) for name, (v2, ok2) in out.items()}
+    return out, s[:n], (ties, kprime)
 
 
 def limit(

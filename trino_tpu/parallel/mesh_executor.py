@@ -113,6 +113,8 @@ class MeshExecutor(LocalExecutor):
         self.group_capacity = int(self.config.get("group_capacity", 4096))
         self.join_factor = 1
         self.force_expansion = set()
+        self.group_salt = 0
+        self.topn_factor = 1
 
         for attempt in range(7):
             ctx = _MeshTraceCtx(self, None, None)
@@ -129,6 +131,7 @@ class MeshExecutor(LocalExecutor):
                     batch.sel,
                     tuple(ctx.capacity_checks),
                     tuple(d for _, d in ctx.dup_checks),
+                    tuple(ctx.collision_checks),
                 )
 
             shard_fn = jax.shard_map(
@@ -138,7 +141,7 @@ class MeshExecutor(LocalExecutor):
                 out_specs=P_(),
                 check_vma=False,
             )
-            out_lanes, sel, checks, dups = jax.jit(shard_fn)(
+            out_lanes, sel, checks, dups, colls = jax.jit(shard_fn)(
                 scan_args, counts_args
             )
             fell_back = False
@@ -148,16 +151,24 @@ class MeshExecutor(LocalExecutor):
                     # with the many-to-many expansion kernel
                     self.force_expansion.add(id(join_node))
                     fell_back = True
+            for cv in colls:
+                if int(cv) > 0:
+                    self.group_salt += 1
+                    fell_back = True
             if fell_back:
                 continue
-            overflow = any(
-                int(n) > cap
-                for n, cap in zip(checks, ctx.capacity_limits)
-            )
-            if not overflow:
+            over_kinds = set()
+            for n, (cap, kind) in zip(checks, ctx.capacity_limits):
+                if int(n) > cap:
+                    over_kinds.add(kind)
+            if not over_kinds:
                 break
-            self.group_capacity *= 8
-            self.join_factor *= 8
+            if "group" in over_kinds:
+                self.group_capacity *= 8
+            if "join" in over_kinds:
+                self.join_factor *= 8
+            if "topn" in over_kinds:
+                self.topn_factor *= 8
         else:
             raise ExecutionError("group capacity overflow after retries")
 
@@ -291,10 +302,13 @@ class _MeshTraceCtx(_TraceCtx):
         self.capacity_limits: List[int] = []
         self.ordered_out = False
 
-    def _note_capacity(self, ngroups, cap):
+    def _note_capacity(self, ngroups, cap, kind="group"):
         # replicate the check value so it can cross the out_specs=P() boundary
         self.capacity_checks.append(jax.lax.pmax(ngroups, AXIS))
-        self.capacity_limits.append(cap)
+        self.capacity_limits.append((cap, kind))
+
+    def _note_collision(self, coll):
+        self.collision_checks.append(jax.lax.pmax(coll, AXIS))
 
     # -- leaves ---------------------------------------------------------
     def _visit_tablescan(self, node: P.TableScan) -> Batch:
@@ -375,7 +389,7 @@ class _MeshTraceCtx(_TraceCtx):
             # partial aggregate locally; gathering exchange of partial
             # group state; re-merge (PARTIAL -> exchange -> FINAL)
             cap = min(self.ex.group_capacity, b.sel.shape[0])
-            perm, gid, ngroups = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
+            perm, gid, ngroups = self._group_sort(key_lanes, b.sel, cap)
             self._note_capacity(ngroups, cap)
             sel_sorted = b.sel[perm]
             sorted_lanes = {
@@ -393,7 +407,7 @@ class _MeshTraceCtx(_TraceCtx):
             key_lanes_g = [(_agather(v), _agather(ok)) for v, ok in keys_local]
             present_g = _agather(present_local)
             fcap = min(self.ex.group_capacity, present_g.shape[0])
-            perm2, gid2, ngroups2 = agg_ops.sort_group_ids(
+            perm2, gid2, ngroups2 = self._group_sort(
                 key_lanes_g, present_g, fcap
             )
             self._note_capacity(ngroups2, fcap)
@@ -508,8 +522,8 @@ class _MeshTraceCtx(_TraceCtx):
         rlanes, rsel, rmax = shuffle.repartition(
             right.lanes, right.sel, rbuck, rkeep, ndev, rchunk, AXIS
         )
-        self._note_capacity(lmax, lchunk)
-        self._note_capacity(rmax, rchunk)
+        self._note_capacity(lmax, lchunk, "join")
+        self._note_capacity(rmax, rchunk, "join")
         out = self._join_batches(
             node,
             Batch(llanes, lsel, replicated=False),
@@ -580,7 +594,12 @@ class _MeshTraceCtx(_TraceCtx):
     def _visit_topn(self, node: P.TopN) -> Batch:
         b = self.visit(node.source)
         keys = self._rank_sort_keys(node.keys, b)
-        lanes, sel = sort_ops.topn(keys, b.lanes, b.sel, node.count)
+        lanes, sel, check = sort_ops.topn(
+            keys, b.lanes, b.sel, node.count,
+            getattr(self.ex, 'topn_factor', 1),
+        )
+        if check is not None:
+            self._note_capacity(check[0], check[1], "topn")
         if not b.replicated:
             # local top-n -> gather candidates -> global top-n (MergeOperator)
             b2 = Batch(
@@ -588,7 +607,12 @@ class _MeshTraceCtx(_TraceCtx):
                 _agather(sel),
             )
             keys2 = self._rank_sort_keys(node.keys, b2)
-            lanes, sel = sort_ops.topn(keys2, b2.lanes, b2.sel, node.count)
+            lanes, sel, check2 = sort_ops.topn(
+                keys2, b2.lanes, b2.sel, node.count,
+                getattr(self.ex, 'topn_factor', 1),
+            )
+            if check2 is not None:
+                self._note_capacity(check2[0], check2[1], "topn")
         self.ordered_out = True
         return Batch(lanes, sel, ordered=True, replicated=True)
 
@@ -622,7 +646,7 @@ class _MeshTraceCtx(_TraceCtx):
     def _local_distinct(self, syms, b: Batch) -> Batch:
         key_lanes = [b.lanes[s] for s in syms]
         cap = b.sel.shape[0]
-        perm, gid, _ = agg_ops.sort_group_ids(key_lanes, b.sel, cap)
+        perm, gid, _ = self._group_sort(key_lanes, b.sel, cap)
         boundary = jnp.concatenate(
             [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
         )
