@@ -113,6 +113,15 @@ def _backend() -> str:
         return jax.devices()[0].platform
 
 
+def _safe(fn):
+    """One config failing (tunnel crash, OOM) must not kill the whole
+    bench: record the error and keep measuring the rest."""
+    try:
+        return fn()
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+
+
 def _time_config(session, sql, rows, iters):
     """cold (first, incl. compile+upload) + steady (best warm) timings."""
     import jax
@@ -205,34 +214,40 @@ def main():
     from trino_tpu.session import tpch_session, tpcds_session
 
     configs = {}
+    # keep every session (and its device-resident scan cache) alive for
+    # the whole run: the axon tunnel has a free/invalidation race where
+    # async buffer frees from a dropped session can poison later
+    # transfers (reproduced: tiny-session Q6 x3, drop, SF1 warm repeat
+    # fails INVALID_ARGUMENT at device_get)
+    keep = []
 
     # 1. TPC-H tiny Q6 (TpchQueryRunner-equivalent smoke config)
     s = tpch_session(0.01)
-    configs["q6_tiny_sf0.01"] = _time_config(
-        s, Q6, _table_rows(s, "lineitem"), iters
+    keep.append(s)
+    configs["q6_tiny_sf0.01"] = _safe(
+        lambda: _time_config(s, Q6, _table_rows(s, "lineitem"), iters)
     )
 
     # headline: Q6 at SF1 through the engine
     s = tpch_session(1.0)
+    keep.append(s)
     lrows = _table_rows(s, "lineitem")
-    configs["q6_sf1"] = _time_config(s, Q6, lrows, iters)
+    configs["q6_sf1"] = _safe(lambda: _time_config(s, Q6, lrows, iters))
 
     # 2. SF1 Q1 (multi-key group-by)
-    configs["q1_sf1"] = _time_config(s, Q1, lrows, iters)
+    configs["q1_sf1"] = _safe(lambda: _time_config(s, Q1, lrows, iters))
 
-    # 3. Q3 (3-way join + order-by) at SF10 on TPU
-    s3 = tpch_session(q3_sf)
-    configs[f"q3_sf{q3_sf:g}"] = _time_config(
-        s3, Q3, _table_rows(s3, "lineitem"), iters
-    )
-    del s3
 
     # 4. TPC-DS Q3/Q7 (star joins + group-by)
     ds = tpcds_session(ds_sf)
+    keep.append(ds)
     ss_rows = _table_rows(ds, "store_sales")
-    configs[f"tpcds_q3_sf{ds_sf:g}"] = _time_config(ds, DS_Q3, ss_rows, iters)
-    configs[f"tpcds_q7_sf{ds_sf:g}"] = _time_config(ds, DS_Q7, ss_rows, iters)
-    del ds
+    configs[f"tpcds_q3_sf{ds_sf:g}"] = _safe(
+        lambda: _time_config(ds, DS_Q3, ss_rows, iters)
+    )
+    configs[f"tpcds_q7_sf{ds_sf:g}"] = _safe(
+        lambda: _time_config(ds, DS_Q7, ss_rows, iters)
+    )
 
     # 5. Hive/Parquet scan -> HBM
     from trino_tpu.connectors.hive import write_parquet_table
@@ -240,33 +255,37 @@ def main():
 
     with tempfile.TemporaryDirectory() as wh:
         gen = tpch_session(hive_sf)
+        keep.append(gen)
         page = gen.execute(
             "select l_orderkey, l_quantity, l_extendedprice, l_discount, "
             "l_shipdate from lineitem"
         )
         write_parquet_table(wh, "lineitem", page, rows_per_group=1 << 20)
-        del gen
         hs = Session()
+        keep.append(hs)
         hs.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
-        configs[f"hive_parquet_scan_sf{hive_sf:g}"] = _time_config(
-            hs, HIVE_SCAN, page.count, iters
+        configs[f"hive_parquet_scan_sf{hive_sf:g}"] = _safe(
+            lambda: _time_config(hs, HIVE_SCAN, page.count, iters)
         )
-        del hs
+
+    # 3. Q3 (3-way join + order-by) at SF10 — LAST: the largest
+    # working set; if it crashes the tunnel worker, every earlier
+    # config has already been recorded
+    s3 = tpch_session(q3_sf)
+    keep.append(s3)
+    configs[f"q3_sf{q3_sf:g}"] = _safe(
+        lambda: _time_config(s3, Q3, _table_rows(s3, "lineitem"), iters)
+    )
 
     headline = configs["q6_sf1"]
-    cpu_rows_per_sec = (
-        _cpu_probe(iters) if on_tpu else headline["rows_per_sec"]
-    )
-    vs = (
-        headline["rows_per_sec"] / cpu_rows_per_sec
-        if cpu_rows_per_sec
-        else 0.0
-    )
+    hrps = headline.get("rows_per_sec", 0.0)
+    cpu_rows_per_sec = _cpu_probe(iters) if on_tpu else hrps
+    vs = hrps / cpu_rows_per_sec if cpu_rows_per_sec else 0.0
     print(
         json.dumps(
             {
                 "metric": "tpch_q6_sf1_engine_rows_per_sec",
-                "value": headline["rows_per_sec"],
+                "value": hrps,
                 "unit": "rows/s",
                 "vs_baseline": round(vs, 2),
                 "backend": backend,

@@ -1,0 +1,130 @@
+"""Optimizer plan-shape tests.
+
+Reference parity: sql/planner/TestLogicalPlanner + assertPlan pattern
+matching (sql/planner/assertions/) — EXPLAIN-level assertions that the
+join reorder (ReorderJoins/EliminateCrossJoins analogs) and TupleDomain
+derivation (range + discrete ValueSet) produce the intended shapes.
+"""
+import pytest
+
+from tpch_sql import QUERIES
+from trino_tpu.plan import nodes as P
+from trino_tpu.session import tpch_session
+
+
+@pytest.fixture(scope="module")
+def session():
+    return tpch_session(0.01)
+
+
+def _joins(plan):
+    out = []
+
+    def walk(n):
+        if isinstance(n, P.Join):
+            out.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    return out
+
+
+@pytest.mark.parametrize("qnum", [2, 5, 7, 8, 9])
+def test_no_cross_joins_in_multi_table_queries(session, qnum):
+    # FROM-list queries joining 5-8 tables: every join must carry equi
+    # criteria after reordering — a cross product at SF>=1 is fatal
+    plan = session.plan(QUERIES[qnum][0])
+    for j in _joins(plan):
+        assert j.kind != "cross" or not j.criteria, (qnum, j.kind)
+        if j.kind in ("inner", "left"):
+            assert j.criteria, f"q{qnum}: join without criteria (cross)"
+
+
+def test_q9_reorder_anchors_fact_table(session):
+    # the largest relation (lineitem) anchors as the streaming probe side:
+    # the deepest left leaf of the join tree is the lineitem scan
+    plan = session.plan(QUERIES[9][0])
+    joins = _joins(plan)
+    assert joins, "q9 must contain joins"
+    n = joins[-1]
+    while isinstance(n, P.Join):
+        n = n.left
+    while not isinstance(n, P.TableScan):
+        n = n.sources[0]
+    assert n.table == "lineitem"
+
+
+def test_in_list_constraint_derivation(session):
+    plan = session.plan("select count(*) from part where p_size in (1, 5, 9)")
+    scans = []
+
+    def walk(n):
+        if isinstance(n, P.TableScan):
+            scans.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    (scan,) = scans
+    (entry,) = scan.constraint
+    assert entry[0] == "p_size"
+    assert entry[1] == 1.0 and entry[2] == 9.0
+    assert tuple(entry[3]) == (1.0, 5.0, 9.0)
+
+
+def test_or_equality_chain_derives_value_set(session):
+    plan = session.plan(
+        "select count(*) from part where p_size = 3 or p_size = 7"
+    )
+
+    def find(n):
+        if isinstance(n, P.TableScan):
+            return n
+        for s in n.sources:
+            r = find(s)
+            if r is not None:
+                return r
+        return None
+
+    scan = find(plan)
+    entries = {e[0]: e for e in scan.constraint}
+    assert "p_size" in entries
+    assert tuple(entries["p_size"][3]) == (3.0, 7.0)
+
+
+def test_join_distribution_annotation(session):
+    plan = session.plan(QUERIES[3][0])
+    for j in _joins(plan):
+        if j.kind in ("inner", "left") and j.criteria:
+            assert j.distribution in ("broadcast", "partitioned")
+
+
+def test_hive_in_list_row_group_pruning(tmp_path):
+    # sparse discrete values prune a row group whose [min,max] straddles
+    # the range but contains none of the values
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.connectors.hive import write_parquet_table
+    from trino_tpu.page import page_from_pydict
+    from trino_tpu.session import Session
+
+    wh = str(tmp_path)
+    xs = list(range(1, 31))  # row groups of 10: [1..10], [11..20], [21..30]
+    page = page_from_pydict([("x", T.BIGINT)], {"x": xs})
+    write_parquet_table(wh, "t", page, rows_per_group=10)
+    s = Session()
+    s.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
+    conn = s.catalogs.get("hive")
+    sm = conn.split_manager()
+    all_splits = sm.get_splits("t", 4)
+    # values 10 and 21: the middle group [11..20] holds neither, but the
+    # plain [10, 21] range intersects it — discrete pruning wins
+    in_splits = sm.get_splits("t", 4, (("x", 10.0, 21.0, (10.0, 21.0)),))
+    range_splits = sm.get_splits("t", 4, (("x", 10.0, 21.0),))
+    assert len(all_splits) == 3 and len(range_splits) == 3
+    assert len(in_splits) == 2
+    # correctness end-to-end
+    got = s.execute("select count(*) from t where x in (10, 21)").to_pylist()
+    assert got == [(2,)]

@@ -218,7 +218,11 @@ class HiveSplitManager(SplitManager):
                 Split(table, i, len(files), {"path": p, "row_group": -1})
                 for i, p in enumerate(files)
             ]
-        ranges = {c: (lo, hi) for c, lo, hi in (constraint or ())}
+        ranges = {}
+        for entry in constraint or ():
+            c, lo, hi = entry[0], entry[1], entry[2]
+            values = entry[3] if len(entry) > 3 else None
+            ranges[c] = (lo, hi, values)
         work: List[Tuple[str, int]] = []
         for path in files:
             md = pq.ParquetFile(path).metadata
@@ -241,11 +245,18 @@ class HiveSplitManager(SplitManager):
             st = col.statistics
             if st is None or not st.has_min_max:
                 continue
-            lo, hi = r
+            lo, hi, values = r
             try:
                 smin, smax = float(st.min), float(st.max)
             except (TypeError, ValueError):
                 continue  # non-numeric stats: cannot prune safely
+            if values is not None and not any(
+                smin <= v <= smax for v in values
+            ):
+                # discrete ValueSet: no admissible value intersects the
+                # row group's [min, max] (IN-list pruning beats the plain
+                # range when values are sparse)
+                return True
             if (lo is not None and smax < lo) or (
                 hi is not None and smin > hi
             ):

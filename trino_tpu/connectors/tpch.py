@@ -527,7 +527,10 @@ class _Gen:
 
 def _format_lazy(spec, schema_type) -> Tuple[np.ndarray, np.ndarray]:
     """Materialize a lazily-specified high-cardinality string column as
-    (codes, dictionary).  Codes are arange since values are distinct."""
+    (codes, dictionary).  Formatted-key specs (Supplier#N, phone) are
+    distinct so codes are arange; pname DEDUPES its dictionary and
+    remaps codes (names can repeat, and code equality must equal
+    string equality)."""
     if spec[0] == "pname":
         _, keys = spec
         nw = np.uint64(len(P_NAME_WORDS))

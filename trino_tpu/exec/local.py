@@ -235,6 +235,7 @@ class LocalExecutor:
             self.force_expansion = set()
             self.group_salt = 0
             self.topn_factor = 1
+            self.force_wide_mul = False
             # start at the last successful capacities for this plan: the
             # overflow ladder re-runs (and on first touch, re-COMPILES) the
             # whole fragment per rung, so remembering the landing spot makes
@@ -243,7 +244,7 @@ class LocalExecutor:
             hint = hints.get(id(plan)) if hints is not None else None
             if hint is not None:
                 (self.group_capacity, self.join_factor, self.topn_factor,
-                 forced, _) = hint
+                 self.force_wide_mul, forced, _) = hint
                 self.force_expansion = set(forced)
             else:
                 est = self._estimate_group_capacity(plan, counts)
@@ -264,20 +265,46 @@ class LocalExecutor:
             )
             for attempt in range(7):
                 if use_jit:
-                    (out_lanes, sel, ordered, checks, dups,
-                     colls) = self._run_jitted(plan, scans, counts)
+                    (out_lanes, sel, ordered, checks, dups, colls,
+                     wides) = self._run_jitted(plan, scans, counts)
                 else:
                     ctx = self.trace_ctx_cls(self, scans, counts)
                     out_lanes, sel, ordered, checks = self._run(plan, ctx)
                     dups = ctx.dup_checks
                     colls = ctx.collision_checks
-                # one round trip for all control scalars (the accelerator
-                # may sit behind a high-latency tunnel: per-scalar int()
-                # costs one RTT each)
-                dup_vals, check_vals, coll_vals = jax.device_get(
-                    ([d for _, d in dups], [ng for ng, _, _ in checks],
-                     list(colls))
-                )
+                    wides = ctx.lowering.overflow_flags
+                # ONE round trip for all control scalars AND the output
+                # lanes (the accelerator may sit behind a high-latency
+                # tunnel: each device_get costs an RTT; on the rare
+                # retry the prefetched outputs are simply discarded)
+                try:
+                    (dup_vals, check_vals, coll_vals, wide_vals,
+                     host_lanes, sel_np) = jax.device_get(
+                        ([d for _, d in dups],
+                         [ng for ng, _, _ in checks],
+                         list(colls), list(wides),
+                         {s: out_lanes[s] for s in plan.symbols}, sel)
+                    )
+                except jax.errors.JaxRuntimeError as e:
+                    # axon tunnel executable-reuse fault: drop the
+                    # cached executable and recompile the same trace.
+                    # ONLY for INVALID_ARGUMENT (the observed fault
+                    # signature), at most twice — OOM/crashes
+                    # (RESOURCE_EXHAUSTED/UNAVAILABLE) must surface
+                    # with their real message, not burn the ladder
+                    jc = self.config.get("jit_cache")
+                    retries = getattr(self, "_jit_fault_retries", 0)
+                    if (
+                        use_jit
+                        and jc
+                        and retries < 2
+                        and "INVALID_ARGUMENT" in str(e)
+                        and getattr(self, "_last_jit_key", None) in jc
+                    ):
+                        self._jit_fault_retries = retries + 1
+                        del jc[self._last_jit_key]
+                        continue
+                    raise
                 fell_back = False
                 for (join_node, _), dup in zip(dups, dup_vals):
                     if int(dup) > 0:
@@ -290,6 +317,12 @@ class LocalExecutor:
                         # locator hash collision in grouping: re-run
                         # the fragment under a fresh salt (exactness)
                         self.group_salt += 1
+                        fell_back = True
+                for wv in wide_vals:
+                    if int(wv) > 0 and not self.force_wide_mul:
+                        # decimal product/quotient near int64 range:
+                        # re-trace with the 128-bit kernels
+                        self.force_wide_mul = True
                         fell_back = True
                 if fell_back:
                     continue
@@ -312,12 +345,12 @@ class LocalExecutor:
                 # the plan reference keeps id(plan) stable (no reuse after gc)
                 hints[id(plan)] = (
                     self.group_capacity, self.join_factor,
-                    self.topn_factor,
+                    self.topn_factor, self.force_wide_mul,
                     frozenset(self.force_expansion), plan,
                 )
                 for k in list(hints)[:-512]:
                     hints.pop(k, None)
-            return self._materialize(plan, out_lanes, sel, ordered)
+            return self._materialize_host(plan, host_lanes, sel_np)
         finally:
             if pool is not None:
                 pool.free(self.query_id, self.scan_bytes)
@@ -613,6 +646,7 @@ class LocalExecutor:
             id(plan), self.group_capacity, self.join_factor,
             getattr(self, "topn_factor", 1),
             getattr(self, "group_salt", 0),
+            getattr(self, "force_wide_mul", False),
             frozenset(getattr(self, "force_expansion", ())),
             # scan-cache keys embed the connector data_version, so a write
             # that keeps row counts constant still recompiles (and refreshes
@@ -622,6 +656,7 @@ class LocalExecutor:
                 for nid in scans
             )),
         )
+        self._last_jit_key = key
         entry = cache.get(key)
         if entry is None:
             cell: Dict[str, object] = {}
@@ -639,6 +674,7 @@ class LocalExecutor:
                     tuple(ng for ng, _, _ in checks),
                     tuple(d for _, d in ctx.dup_checks),
                     tuple(ctx.collision_checks),
+                    tuple(ctx.lowering.overflow_flags),
                 )
 
             fn = jax.jit(raw)
@@ -649,14 +685,23 @@ class LocalExecutor:
         else:
             cell = entry["cell"]
             self.dicts.update(cell["dicts"])
-            out = entry["fn"](prep)
-        out_lanes, sel, ngroups, dup_vals, colls = out
+            try:
+                out = entry["fn"](prep)
+                jax.block_until_ready(out)
+            except jax.errors.JaxRuntimeError:
+                # the axon tunnel can fail re-dispatch of a cached
+                # executable (observed with 128-bit kernels after a
+                # different-shape sibling compiled); recompiling the
+                # same trace is always safe — drop and rebuild
+                del cache[key]
+                return self._run_jitted(plan, scans, counts)
+        out_lanes, sel, ngroups, dup_vals, colls, wides = out
         checks = [
             (ng, cap, kind)
             for ng, (cap, kind) in zip(ngroups, cell["caps"])
         ]
         dups = list(zip(cell["dup_nodes"], dup_vals))
-        return out_lanes, sel, cell["ordered"], checks, dups, colls
+        return out_lanes, sel, cell["ordered"], checks, dups, colls, wides
 
     # ------------------------------------------------------------------
     def _run(self, plan: P.Output, ctx: "_TraceCtx"):
@@ -671,6 +716,9 @@ class LocalExecutor:
         host_lanes, sel_np = jax.device_get(
             ({s: lanes[s] for s in plan.symbols}, sel)
         )
+        return self._materialize_host(plan, host_lanes, sel_np)
+
+    def _materialize_host(self, plan: P.Output, host_lanes, sel_np) -> Page:
         types = plan.source.output_types()
         cols = []
         idx = np.nonzero(sel_np)[0]
@@ -696,6 +744,7 @@ class _TraceCtx:
         self.dup_checks: List[Tuple[P.PlanNode, jnp.ndarray]] = []
         self.collision_checks: List[jnp.ndarray] = []
         self.lowering = LoweringContext(ex.dicts)
+        self.lowering.force_wide_mul = getattr(ex, 'force_wide_mul', False)
 
     # -- dispatch -------------------------------------------------------
     def visit(self, node: P.PlanNode) -> Batch:

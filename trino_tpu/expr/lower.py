@@ -67,6 +67,14 @@ class LoweringContext:
         # dictionaries of *derived* string expressions (substring(col,..)
         # etc.), keyed by the (hashable, frozen) IR node that produced them
         self.expr_dicts: Dict[object, np.ndarray] = {}
+        # decimal multiplies whose declared precision exceeds 18 digits run
+        # the cheap int64 kernel first and flag potential overflow here
+        # (traced scalars); the executor retries with the 128-bit kernel
+        # only when a flag fires (DecimalOperators would use Int128 always;
+        # real data almost never needs it and the wide kernel is costly)
+        self.overflow_flags: list = []
+        # set by the executor's retry ladder after a flagged overflow
+        self.force_wide_mul: bool = False
 
     def dict_for_expr(self, e) -> np.ndarray | None:
         """Dictionary of a varchar-typed expression: source column's, or a

@@ -205,8 +205,9 @@ def f64_order_bits(v: jnp.ndarray) -> jnp.ndarray:
     E = jnp.clip(e0 + 1023, 1, 2046).astype(jnp.uint64)
     bits = (E << jnp.uint64(52)) | m_int
     # subnormals, -0 and +0 all encode as 0: XLA arithmetic/comparisons
-    # flush subnormals (DAZ) — verified: (5e-324 == 0.0) is True in-engine
-    # — so one shared encoding is exactly consistent with the comparison
+    # flush subnormals (DAZ) — verified on BOTH the TPU and CPU backends
+    # ((5e-324 == 0.0) is True, (5e-324 != 0) is False in-engine) — so
+    # one shared encoding is exactly consistent with the comparison
     # semantics the sort/verify kernels use
     tiny = av < jnp.float64(2.2250738585072014e-308)
     bits = jnp.where(tiny, jnp.uint64(0), bits)

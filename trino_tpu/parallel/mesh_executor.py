@@ -115,6 +115,7 @@ class MeshExecutor(LocalExecutor):
         self.force_expansion = set()
         self.group_salt = 0
         self.topn_factor = 1
+        self.force_wide_mul = False
 
         for attempt in range(7):
             ctx = _MeshTraceCtx(self, None, None)
@@ -132,6 +133,10 @@ class MeshExecutor(LocalExecutor):
                     tuple(ctx.capacity_checks),
                     tuple(d for _, d in ctx.dup_checks),
                     tuple(ctx.collision_checks),
+                    tuple(
+                        jax.lax.psum(w, AXIS)
+                        for w in ctx.lowering.overflow_flags
+                    ),
                 )
 
             shard_fn = jax.shard_map(
@@ -141,7 +146,7 @@ class MeshExecutor(LocalExecutor):
                 out_specs=P_(),
                 check_vma=False,
             )
-            out_lanes, sel, checks, dups, colls = jax.jit(shard_fn)(
+            out_lanes, sel, checks, dups, colls, wides = jax.jit(shard_fn)(
                 scan_args, counts_args
             )
             fell_back = False
@@ -154,6 +159,10 @@ class MeshExecutor(LocalExecutor):
             for cv in colls:
                 if int(cv) > 0:
                     self.group_salt += 1
+                    fell_back = True
+            for wv in wides:
+                if int(wv) > 0 and not self.force_wide_mul:
+                    self.force_wide_mul = True
                     fell_back = True
             if fell_back:
                 continue

@@ -37,12 +37,15 @@ class TableScan(PlanNode):
     # symbol -> source column name
     assignments: Tuple[Tuple[str, str], ...]
     types: Tuple[Tuple[str, T.Type], ...]
-    # advisory per-source-column value ranges derived from the query filter
-    # (TupleDomain pushed into the connector — spi/predicate/TupleDomain via
-    # ConnectorMetadata/SplitManager constraint): (column, lo, hi) inclusive,
-    # None = unbounded.  Connectors may prune splits/row-groups; the engine
-    # keeps the Filter, so pruning is safe-if-conservative.
-    constraint: Tuple[Tuple[str, Optional[float], Optional[float]], ...] = ()
+    # advisory per-source-column domains derived from the query filter
+    # (TupleDomain pushed into the connector — spi/predicate/TupleDomain
+    # with both range and DISCRETE ValueSet forms, via
+    # ConnectorMetadata/SplitManager constraint): entries are
+    # (column, lo, hi) inclusive ranges or (column, lo, hi, values) where
+    # `values` is a sorted tuple of the exact admissible values (IN-list
+    # pushdown); None = unbounded.  Connectors may prune splits/row-groups;
+    # the engine keeps the Filter, so pruning is safe-if-conservative.
+    constraint: Tuple[Tuple, ...] = ()
 
     def output_symbols(self):
         return [s for s, _ in self.assignments]

@@ -127,6 +127,47 @@ def _range_of(conj: "ir.Expr", scan: P.TableScan):
     return None
 
 
+def _values_of(conj: "ir.Expr", scan: P.TableScan):
+    """(source_column, sorted distinct values) for a discrete-domain
+    conjunct — `col IN (c1, .., ck)` or an OR of `col = ci` — over an
+    integral/date scan column (spi/predicate/ValueSet discrete form)."""
+    pairs = None
+    if (
+        isinstance(conj, ir.In)
+        and not conj.negate
+        and isinstance(conj.value, ir.ColumnRef)
+    ):
+        pairs = [(conj.value, it) for it in conj.items]
+    elif isinstance(conj, ir.Logical) and conj.op == "or":
+        pairs = []
+        for t in conj.terms:
+            if not (isinstance(t, ir.Comparison) and t.op == "="):
+                return None
+            if isinstance(t.left, ir.ColumnRef):
+                pairs.append((t.left, t.right))
+            elif isinstance(t.right, ir.ColumnRef):
+                pairs.append((t.right, t.left))
+            else:
+                return None
+    if not pairs:
+        return None
+    col = None
+    vals = []
+    for symref, const in pairs:
+        r = _range_of(ir.Comparison("=", symref, const), scan)
+        if r is None:
+            return None
+        c, lo, hi = r
+        if lo != hi:  # fractional literal: no discrete integral value
+            return None
+        if col is None:
+            col = c
+        elif col != c:
+            return None
+        vals.append(lo)
+    return col, tuple(sorted(set(vals)))
+
+
 def _derive_scan_constraints(node: P.PlanNode) -> P.PlanNode:
     node = _rewrite_sources(
         node, tuple(_derive_scan_constraints(s) for s in node.sources)
@@ -135,8 +176,21 @@ def _derive_scan_constraints(node: P.PlanNode) -> P.PlanNode:
         return node
     scan = node.source
     ranges = {}
+    value_sets = {}
     for c in _conjuncts(node.predicate):
-        r = _range_of(c, scan)
+        vs = _values_of(c, scan)
+        if vs is not None:
+            col, vals = vs
+            prev = value_sets.get(col)
+            value_sets[col] = (
+                vals if prev is None
+                else tuple(sorted(set(prev) & set(vals)))
+            )
+            # discrete set implies a [min, max] range too (_values_of
+            # never returns an empty tuple)
+            r = (col, vals[0], vals[-1])
+        else:
+            r = _range_of(c, scan)
         if r is None:
             continue
         col, lo, hi = r
@@ -148,7 +202,11 @@ def _derive_scan_constraints(node: P.PlanNode) -> P.PlanNode:
         return node
     new_scan = P.TableScan(
         scan.catalog, scan.table, scan.assignments, scan.types,
-        tuple((c, lo, hi) for c, (lo, hi) in sorted(ranges.items())),
+        tuple(
+            (c, lo, hi) if c not in value_sets
+            else (c, lo, hi, value_sets[c])
+            for c, (lo, hi) in sorted(ranges.items())
+        ),
     )
     return P.Filter(new_scan, node.predicate)
 

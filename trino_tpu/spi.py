@@ -94,7 +94,10 @@ class SplitManager:
     def get_splits(
         self, table: str, desired: int, constraint=None
     ) -> List[Split]:
-        """constraint: optional ((column, lo, hi), ...) inclusive ranges
+        """constraint: optional per-column domains — entries are
+        (column, lo, hi) inclusive ranges OR (column, lo, hi, values)
+        where `values` is a sorted tuple of exactly-admissible values
+        (discrete ValueSet / IN-list pushdown); unpack defensively
         (TupleDomain pushdown) — connectors MAY prune splits with it."""
         raise NotImplementedError
 
