@@ -222,60 +222,66 @@ def main():
     keep = []
 
     # 1. TPC-H tiny Q6 (TpchQueryRunner-equivalent smoke config)
-    s = tpch_session(0.01)
-    keep.append(s)
-    configs["q6_tiny_sf0.01"] = _safe(
-        lambda: _time_config(s, Q6, _table_rows(s, "lineitem"), iters)
-    )
+    def _cfg_q6_tiny():
+        s = tpch_session(0.01)
+        keep.append(s)
+        return _time_config(s, Q6, _table_rows(s, "lineitem"), iters)
 
-    # headline: Q6 at SF1 through the engine
-    s = tpch_session(1.0)
-    keep.append(s)
-    lrows = _table_rows(s, "lineitem")
-    configs["q6_sf1"] = _safe(lambda: _time_config(s, Q6, lrows, iters))
+    configs["q6_tiny_sf0.01"] = _safe(_cfg_q6_tiny)
 
-    # 2. SF1 Q1 (multi-key group-by)
-    configs["q1_sf1"] = _safe(lambda: _time_config(s, Q1, lrows, iters))
+    # headline: Q6 at SF1 through the engine; 2. SF1 Q1 (group-by)
+    def _cfg_sf1(sql):
+        def run():
+            s = tpch_session(1.0)
+            keep.append(s)
+            return _time_config(s, sql, _table_rows(s, "lineitem"), iters)
+        return run
+
+    configs["q6_sf1"] = _safe(_cfg_sf1(Q6))
+    configs["q1_sf1"] = _safe(_cfg_sf1(Q1))
 
 
     # 4. TPC-DS Q3/Q7 (star joins + group-by)
-    ds = tpcds_session(ds_sf)
-    keep.append(ds)
-    ss_rows = _table_rows(ds, "store_sales")
-    configs[f"tpcds_q3_sf{ds_sf:g}"] = _safe(
-        lambda: _time_config(ds, DS_Q3, ss_rows, iters)
-    )
-    configs[f"tpcds_q7_sf{ds_sf:g}"] = _safe(
-        lambda: _time_config(ds, DS_Q7, ss_rows, iters)
-    )
+    def _cfg_ds(sql):
+        def run():
+            ds = tpcds_session(ds_sf)
+            keep.append(ds)
+            return _time_config(ds, sql, _table_rows(ds, "store_sales"), iters)
+        return run
+
+    configs[f"tpcds_q3_sf{ds_sf:g}"] = _safe(_cfg_ds(DS_Q3))
+    configs[f"tpcds_q7_sf{ds_sf:g}"] = _safe(_cfg_ds(DS_Q7))
 
     # 5. Hive/Parquet scan -> HBM
     from trino_tpu.connectors.hive import write_parquet_table
     from trino_tpu.session import Session
 
     with tempfile.TemporaryDirectory() as wh:
-        gen = tpch_session(hive_sf)
-        keep.append(gen)
-        page = gen.execute(
-            "select l_orderkey, l_quantity, l_extendedprice, l_discount, "
-            "l_shipdate from lineitem"
-        )
-        write_parquet_table(wh, "lineitem", page, rows_per_group=1 << 20)
-        hs = Session()
-        keep.append(hs)
-        hs.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
-        configs[f"hive_parquet_scan_sf{hive_sf:g}"] = _safe(
-            lambda: _time_config(hs, HIVE_SCAN, page.count, iters)
-        )
+
+        def _cfg_hive():
+            gen = tpch_session(hive_sf)
+            keep.append(gen)
+            page = gen.execute(
+                "select l_orderkey, l_quantity, l_extendedprice, "
+                "l_discount, l_shipdate from lineitem"
+            )
+            write_parquet_table(wh, "lineitem", page, rows_per_group=1 << 20)
+            hs = Session()
+            keep.append(hs)
+            hs.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
+            return _time_config(hs, HIVE_SCAN, page.count, iters)
+
+        configs[f"hive_parquet_scan_sf{hive_sf:g}"] = _safe(_cfg_hive)
 
     # 3. Q3 (3-way join + order-by) at SF10 — LAST: the largest
     # working set; if it crashes the tunnel worker, every earlier
     # config has already been recorded
-    s3 = tpch_session(q3_sf)
-    keep.append(s3)
-    configs[f"q3_sf{q3_sf:g}"] = _safe(
-        lambda: _time_config(s3, Q3, _table_rows(s3, "lineitem"), iters)
-    )
+    def _cfg_q3():
+        s3 = tpch_session(q3_sf)
+        keep.append(s3)
+        return _time_config(s3, Q3, _table_rows(s3, "lineitem"), iters)
+
+    configs[f"q3_sf{q3_sf:g}"] = _safe(_cfg_q3)
 
     headline = configs["q6_sf1"]
     hrps = headline.get("rows_per_sec", 0.0)

@@ -326,3 +326,59 @@ def test_min_by_varchar_key(session, oracle_conn):
         "select min_by(n_nationkey, n_name), max_by(n_nationkey, n_name) "
         "from nation",
     ) == [(lo, hi)]
+
+
+def test_sketched_partial_final_distributed(session, oracle_conn):
+    """Grouped approx_distinct / approx_percentile must run with a real
+    PARTIAL/FINAL split (mergeable HLL + k-min-hash sample sketches) in
+    the distributed runner, within their declared error bounds."""
+    from trino_tpu.testing import DistributedQueryRunner
+
+    r = DistributedQueryRunner(
+        workers=2,
+        catalogs=(("tpch", "tpch", {"tpch.scale-factor": SF}),),
+    )
+    try:
+        got = dict(
+            (k, v)
+            for k, v in r.rows(
+                "select o_orderpriority, approx_distinct(o_custkey) "
+                "from orders group by o_orderpriority"
+            )
+        )
+        exact = dict(
+            oracle_conn.execute(
+                "select o_orderpriority, count(distinct o_custkey) "
+                "from orders group by o_orderpriority"
+            ).fetchall()
+        )
+        # HLL m=512: 4.6% std error; allow 4 sigma
+        oracle_dicts = exact  # same keys via dictionary
+        assert set(got) == set(oracle_dicts)
+        for k, est in got.items():
+            assert abs(est - exact[k]) <= max(0.20 * exact[k], 4), (
+                k, est, exact[k],
+            )
+
+        pgot = dict(
+            r.rows(
+                "select o_orderpriority, approx_percentile(o_totalprice, 0.5) "
+                "from orders group by o_orderpriority"
+            )
+        )
+        import numpy as np
+
+        vals = {}
+        for k, v in oracle_conn.execute(
+            "select o_orderpriority, o_totalprice from orders"
+        ):
+            vals.setdefault(k, []).append(v)
+        for k, est in pgot.items():
+            arr = np.sort(np.array(vals[k]))
+            # k=256 sample: ~6% rank error; accept the value at any rank
+            # within +-15% of the true median rank
+            lo = arr[int(0.35 * (len(arr) - 1))]
+            hi = arr[int(0.65 * (len(arr) - 1))]
+            assert lo <= est <= hi, (k, est, lo, hi)
+    finally:
+        r.stop()

@@ -291,3 +291,20 @@ def test_partitioned_join_mesh():
                 assert d == pytest.approx(l, rel=1e-9) if isinstance(
                     d, float
                 ) else d == l, (sql, dr, lr)
+
+
+def test_sketched_aggs_grouped_mesh(session, mesh_exec):
+    # keyed approx aggregates on the mesh use the mergeable sketch
+    # partial/final path — assert within declared error of the exact local
+    local = dict(session.execute(
+        "select o_orderpriority, approx_distinct(o_custkey) from orders "
+        "group by o_orderpriority"
+    ).to_pylist())
+    plan = session.plan(
+        "select o_orderpriority, approx_distinct(o_custkey) from orders "
+        "group by o_orderpriority"
+    )
+    dist = dict(mesh_exec.execute(plan).to_pylist())
+    assert set(dist) == set(local)
+    for k, est in dist.items():
+        assert abs(est - local[k]) <= max(0.2 * local[k], 4), (k, est, local[k])

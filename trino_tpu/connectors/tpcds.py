@@ -330,10 +330,34 @@ class TpcdsMetadata(ConnectorMetadata):
             "store": "s_store_sk", "promotion": "p_promo_sk",
             "customer_demographics": "cd_demo_sk",
         }.get(table)
+        # NDVs of the generator's bounded-domain columns (TpchMetadata-style
+        # statistics): missing ndv makes the CBO assume ndv = row_count,
+        # which balloons group-by capacities to the scan size
+        ndv = {
+            "d_year": 201, "d_moy": 12, "d_dom": 31, "d_qoy": 4,
+            "i_brand_id": 1000, "i_brand": len(BRANDS),
+            "i_manufact_id": 1000, "i_manager_id": 100,
+            "i_category_id": 10, "i_category": 10,
+            "i_class_id": 16, "i_class": 16, "i_current_price": 9900,
+            "cd_gender": 2, "cd_marital_status": 5,
+            "cd_education_status": 7,
+            "p_channel_email": 2, "p_channel_event": 2,
+            "ss_quantity": 100, "ss_store_sk": counts["store"],
+            "ss_item_sk": counts["item"],
+            "ss_promo_sk": counts["promotion"],
+            "ss_cdemo_sk": counts["customer_demographics"],
+            "s_store_name": counts["store"],
+            "s_store_id": counts["store"],
+            "i_item_id": counts["item"],
+        }
         cols = {}
         for c, t in SCHEMAS[table]:
             if c == pk:
                 cols[c] = ColumnStatistics(distinct_count=float(n))
+            elif c in ndv:
+                cols[c] = ColumnStatistics(
+                    distinct_count=float(min(ndv[c], n))
+                )
         return TableStatistics(float(n), cols)
 
 

@@ -624,7 +624,12 @@ class LocalExecutor:
         walk2(plan)
         if best is None or best <= DEFAULT_GROUP_CAPACITY:
             return None
-        return _pad_capacity(min(best * 2, max_rows))
+        # NDV products wildly overestimate for correlated keys (brand_id
+        # determines brand; orderkey determines orderdate), and every
+        # segment op pays O(capacity).  Cap the first try; the overflow
+        # ladder (x8 per rung) covers genuinely huge group counts with one
+        # recompile instead of every query paying worst-case capacity.
+        return _pad_capacity(min(best * 2, max_rows, 1 << 18))
 
     # ------------------------------------------------------------------
     def _run_jitted(self, plan: P.Output, scans, counts):
@@ -685,16 +690,10 @@ class LocalExecutor:
         else:
             cell = entry["cell"]
             self.dicts.update(cell["dicts"])
-            try:
-                out = entry["fn"](prep)
-                jax.block_until_ready(out)
-            except jax.errors.JaxRuntimeError:
-                # the axon tunnel can fail re-dispatch of a cached
-                # executable (observed with 128-bit kernels after a
-                # different-shape sibling compiled); recompiling the
-                # same trace is always safe — drop and rebuild
-                del cache[key]
-                return self._run_jitted(plan, scans, counts)
+            # dispatch is async: a tunnel re-dispatch fault surfaces at the
+            # execute() loop's device_get, whose handler retries only
+            # INVALID_ARGUMENT (never OOM) with a bounded recompile count
+            out = entry["fn"](prep)
         out_lanes, sel, ngroups, dup_vals, colls, wides = out
         checks = [
             (ng, cap, kind)
@@ -1092,7 +1091,10 @@ class _TraceCtx:
                     n: lanes[n] for s in specs for n in s.accumulator_names
                 }
                 return agg_ops.merge_accumulators(specs, acc_in, gid, sel, cap)
-            return agg_ops.accumulate(specs, lanes, gid, sel, cap)
+            return agg_ops.accumulate(
+                specs, lanes, gid, sel, cap,
+                step="partial" if partial else "single",
+            )
 
         def out_lanes(accs):
             if partial:

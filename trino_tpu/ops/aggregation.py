@@ -68,8 +68,13 @@ BINARY_MOMENT_KINDS = (
 )
 BITWISE_KINDS = ("bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg")
 # kinds that cannot be split into PARTIAL/FINAL (computed at SINGLE step
-# from raw rows; the planner must not push them through exchanges)
-NON_DECOMPOSABLE = ("approx_distinct", "approx_percentile")
+# from raw rows; the planner must not push them through exchanges).
+# approx_distinct / approx_percentile left this list in round 2: at
+# SINGLE step they stay exact, but PARTIAL/FINAL ship mergeable sketch
+# state (ops/sketches.py: HLL registers / k-min-hash samples), the
+# reference's HyperLogLog + digest accumulator design.
+NON_DECOMPOSABLE = ()
+SKETCHED_KINDS = ("approx_distinct", "approx_percentile")
 
 TWO_ARG_KINDS = ("min_by", "max_by") + BINARY_MOMENT_KINDS
 
@@ -102,8 +107,21 @@ class AggSpec:
         if self.kind in BINARY_MOMENT_KINDS:
             return [f"{o}$sy", f"{o}$sx", f"{o}$sxy", f"{o}$sxx",
                     f"{o}$syy", f"{o}$n"]
-        if self.kind in ("bool_and", "bool_or", "checksum", "arbitrary",
-                         "approx_percentile") or self.kind in BITWISE_KINDS:
+        if self.kind == "approx_distinct":
+            from . import sketches
+
+            return [f"{o}$hll{i}" for i in range(sketches.HLL_LANES)]
+        if self.kind == "approx_percentile":
+            from . import sketches
+
+            K = sketches.KMV_K
+            return (
+                [f"{o}$pv{i}" for i in range(K)]
+                + [f"{o}$ph{i}" for i in range(K)]
+                + [f"{o}$pmin", f"{o}$pmax"]
+            )
+        if self.kind in ("bool_and", "bool_or", "checksum",
+                         "arbitrary") or self.kind in BITWISE_KINDS:
             return [f"{o}$val", f"{o}$valid"]
         if self.kind in ("min_by", "max_by"):
             return [f"{o}$val", f"{o}$key", f"{o}$valid", f"{o}$has"]
@@ -126,7 +144,9 @@ class AggSpec:
             if not (name.endswith("$valid") or name.endswith("$has")
                     or name.endswith("$count")):
                 return None
-        if self.kind in NON_DECOMPOSABLE:
+        if self.kind in SKETCHED_KINDS:
+            # packed registers / sample slots need unpack-style merges
+            # (gather path), not a single collective
             return None
         return "sum"
 
@@ -502,8 +522,13 @@ def accumulate(
     gid: jnp.ndarray,
     sel: jnp.ndarray,
     capacity: int,
+    step: str = "single",
 ) -> Dict[str, jnp.ndarray]:
-    """Compute accumulator arrays (shape [capacity]) per spec."""
+    """Compute accumulator arrays (shape [capacity]) per spec.
+
+    step='single' keeps approx_* exact (sort-based); step='partial'
+    emits mergeable sketch state instead (ops/sketches.py), the
+    decomposable PARTIAL/FINAL form shipped across exchanges."""
     out: Dict[str, jnp.ndarray] = {}
     cap = capacity
     for s in specs:
@@ -524,7 +549,16 @@ def accumulate(
             hit = live & (v.astype(bool))
             out[f"{o}$count"] = _seg_sum(hit.astype(jnp.int64), gid, cap)
         elif s.kind == "approx_distinct":
-            out[f"{o}$count"] = distinct_count(gid, (v, ok), sel, cap)
+            if step == "single":
+                out[f"{o}$count"] = distinct_count(gid, (v, ok), sel, cap)
+            else:
+                from . import sketches
+
+                packed = sketches.hll_accumulate(
+                    _key_bits(v), live, gid, cap
+                )
+                for i, arr in packed.items():
+                    out[f"{o}$hll{i}"] = arr
         elif s.kind in ("sum", "avg"):
             if v.dtype.kind == "f":
                 vv = jnp.where(live, v, 0.0)
@@ -610,9 +644,30 @@ def accumulate(
             out[f"{o}$valid"] = xvalid.astype(jnp.int64)
             out[f"{o}$has"] = has.astype(jnp.int64)
         elif s.kind == "approx_percentile":
-            val, valid = _percentile((v, ok), sel, gid, cap, float(s.param))
-            out[f"{o}$val"] = val
-            out[f"{o}$valid"] = valid.astype(jnp.int64)
+            if step == "single":
+                val, valid = _percentile(
+                    (v, ok), sel, gid, cap, float(s.param)
+                )
+                out[f"{o}$val"] = val
+                out[f"{o}$valid"] = valid.astype(jnp.int64)
+            else:
+                from . import sketches
+
+                K = sketches.KMV_K
+                vals, hs = sketches.kmv_accumulate(v, live, gid, cap)
+                vals2 = vals.reshape(cap, K)
+                hs2 = hs.reshape(cap, K)
+                for i in range(K):
+                    out[f"{o}$pv{i}"] = vals2[:, i]
+                    out[f"{o}$ph{i}"] = hs2[:, i]
+                if v.dtype.kind == "f":
+                    lo = jnp.where(live, v, jnp.inf)
+                    hi = jnp.where(live, v, -jnp.inf)
+                else:
+                    lo = jnp.where(live, v.astype(jnp.int64), I64_MAX)
+                    hi = jnp.where(live, v.astype(jnp.int64), -I64_MAX)
+                out[f"{o}$pmin"] = _seg_min(lo, gid, cap)
+                out[f"{o}$pmax"] = _seg_max(hi, gid, cap)
         else:
             raise NotImplementedError(s.kind)
     return out
@@ -637,7 +692,45 @@ def merge_accumulators(
 
     for s in specs:
         o = s.output
-        if s.kind in ("count", "count_star", "count_if", "approx_distinct"):
+        if s.kind == "approx_distinct":
+            from . import sketches
+
+            packed = sketches.hll_merge(
+                {i: acc_lanes[f"{o}$hll{i}"][0]
+                 for i in range(sketches.HLL_LANES)},
+                w, gid, cap,
+            )
+            for i, arr in packed.items():
+                out[f"{o}$hll{i}"] = arr
+        elif s.kind == "approx_percentile":
+            from . import sketches
+
+            K = sketches.KMV_K
+            n = gid.shape[0]
+            vals = jnp.stack(
+                [acc_lanes[f"{o}$pv{i}"][0] for i in range(K)], axis=1
+            )
+            hs = jnp.stack(
+                [acc_lanes[f"{o}$ph{i}"][0] for i in range(K)], axis=1
+            )
+            hs = jnp.where(w[:, None], hs, sketches._H_EMPTY)
+            mv, mh = sketches.kmv_merge(vals, hs, w, gid, cap)
+            mv2 = mv.reshape(cap, K)
+            mh2 = mh.reshape(cap, K)
+            for i in range(K):
+                out[f"{o}$pv{i}"] = mv2[:, i]
+                out[f"{o}$ph{i}"] = mh2[:, i]
+            lo, _ = acc_lanes[f"{o}$pmin"]
+            hi, _ = acc_lanes[f"{o}$pmax"]
+            if lo.dtype.kind == "f":
+                lo = jnp.where(w, lo, jnp.inf)
+                hi = jnp.where(w, hi, -jnp.inf)
+            else:
+                lo = jnp.where(w, lo, I64_MAX)
+                hi = jnp.where(w, hi, -I64_MAX)
+            out[f"{o}$pmin"] = _seg_min(lo, gid, cap)
+            out[f"{o}$pmax"] = _seg_max(hi, gid, cap)
+        elif s.kind in ("count", "count_star", "count_if"):
             msum(f"{o}$count")
         elif s.kind == "avg":
             msum(f"{o}$sum")
@@ -726,7 +819,40 @@ def finalize(
     out: Dict[str, Lane] = {}
     for s in specs:
         o = s.output
-        if s.kind in ("count", "count_star", "count_if", "approx_distinct"):
+        if s.kind == "approx_distinct" and f"{o}$count" not in accs:
+            # sketched (PARTIAL/FINAL) form: HLL estimator
+            from . import sketches
+
+            lanes = {i: accs[f"{o}$hll{i}"]
+                     for i in range(sketches.HLL_LANES)}
+            cap = lanes[0].shape[0]
+            c = sketches.hll_cardinality(lanes, cap)
+            out[o] = (c, jnp.ones(c.shape, bool))
+        elif s.kind == "approx_percentile" and f"{o}$val" not in accs:
+            from . import sketches
+
+            K = sketches.KMV_K
+            cap = accs[f"{o}$pmin"].shape[0]
+            vals = jnp.stack(
+                [accs[f"{o}$pv{i}"] for i in range(K)], axis=1
+            ).reshape(-1)
+            hs = jnp.stack(
+                [accs[f"{o}$ph{i}"] for i in range(K)], axis=1
+            ).reshape(-1)
+            q = float(s.param)
+            v, has = sketches.kmv_quantile(vals, hs, cap, q)
+            lo = accs[f"{o}$pmin"]
+            hi = accs[f"{o}$pmax"]
+            # p=0 / p=1 exact; interior estimates clamp into range
+            if q <= 0.0:
+                v = lo
+            elif q >= 1.0:
+                v = hi
+            else:
+                v = jnp.clip(v, lo, hi)
+            out[o] = (v, has)
+        elif s.kind in ("count", "count_star", "count_if",
+                        "approx_distinct"):
             c = accs[f"{o}$count"]
             out[o] = (c, jnp.ones(c.shape, bool))
         elif s.kind == "sum":

@@ -1,0 +1,279 @@
+"""Mergeable approximate-aggregation sketches.
+
+Reference parity: operator/aggregation/ HyperLogLog state
+(ApproximateCountDistinctAggregations over airlift HLL) and the qdigest /
+tdigest percentile families — the states that make approx_distinct /
+approx_percentile DECOMPOSABLE so they split PARTIAL/FINAL across
+exchanges instead of gathering raw rows.
+
+TPU-first redesign:
+  - HLL: m=512 8-bit registers per group, packed 8-per-int64 into 64
+    accumulator lanes.  Register updates are ONE flat segment_max over
+    [cap*m] slots (no per-register passes); rank (leading-zero count)
+    is computed arithmetically — no clz/bitcast primitives on TPU.
+  - percentile: a k-minimum-hash UNIFORM ROW SAMPLE (k=256) per group —
+    keep the k rows with smallest per-row hash; merging unions candidate
+    sets and re-keeps the k smallest, which is exactly a uniform sample
+    of the union.  Quantiles come from the sample (rank error
+    ~1/sqrt(k) ≈ 6%), with exact min/max carried alongside so p=0 / p=1
+    stay exact and estimates clamp into range.
+
+Both sketches bound device memory by cap * (m or k) transient slots; a
+2^30-slot guard (~2M HLL groups / ~4M sample groups) fails loudly rather
+than estimate from truncated state.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+HLL_M = 512               # registers: std error 1.04/sqrt(512) ~= 4.6%
+HLL_REG_PER_LANE = 8      # 8-bit registers packed into int64 lanes
+HLL_LANES = HLL_M // HLL_REG_PER_LANE
+_HLL_ALPHA = 0.7213 / (1 + 1.079 / HLL_M)
+
+KMV_K = 256               # sample size: quantile rank error ~1/sqrt(256)
+
+_GOLD = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def _mix64(x: jnp.ndarray) -> jnp.ndarray:
+    x = (x.astype(jnp.uint64) + _GOLD)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def _bitlength(w: jnp.ndarray) -> jnp.ndarray:
+    """Exact bit length of uint64 values < 2^56, arithmetically: a float
+    log2 estimate corrected by exact shifts (f64 rounds above 2^53)."""
+    wf = jnp.maximum(w.astype(jnp.float64), 1.0)
+    b = jnp.floor(jnp.log2(wf)).astype(jnp.int32)
+    b = jnp.clip(b, 0, 56)
+    bu = b.astype(jnp.uint64)
+    b = b + ((w >> (bu + jnp.uint64(1))) > 0)
+    b = b - jnp.where((w >> b.astype(jnp.uint64)) == 0, 1, 0)
+    return jnp.where(w == 0, 0, b + 1)
+
+
+def _guard_cap(cap: int, width: int):
+    # 2^30 int32 slots = 4 GB transient state: above the executor's
+    # capacity ladder ceiling for realistic group counts (~2M groups),
+    # below HBM.  Clear loud failure beyond it — silent estimates from
+    # truncated state would be worse than an error.
+    if cap * width > (1 << 30):
+        raise ValueError(
+            f"approximate-aggregation sketch state ({cap} groups x "
+            f"{width} slots) exceeds the 2^30-slot device guard; "
+            "reduce the group count or use exact count(distinct)"
+        )
+
+
+# --- HyperLogLog ------------------------------------------------------
+
+
+def hll_accumulate(
+    bits: jnp.ndarray, live: jnp.ndarray, gid: jnp.ndarray, cap: int
+) -> Dict[int, jnp.ndarray]:
+    """Per-group packed HLL registers from value bit material.
+
+    Returns {lane_index: [cap] int64 packed registers}."""
+    _guard_cap(cap, HLL_M)
+    h = _mix64(bits)
+    reg = (h & jnp.uint64(HLL_M - 1)).astype(jnp.int64)
+    w = h >> jnp.uint64(9)  # 55-bit window
+    rank = jnp.where(live, (56 - _bitlength(w)).astype(jnp.int32), 0)
+    seg = jnp.where(live, gid * HLL_M + reg, 0)
+    flat = jax.ops.segment_max(
+        jnp.where(live, rank, -1), seg, num_segments=cap * HLL_M
+    )
+    flat = jnp.maximum(flat, 0)
+    return _pack(flat.reshape(cap, HLL_M))
+
+
+def _pack(regs: jnp.ndarray) -> Dict[int, jnp.ndarray]:
+    cap = regs.shape[0]
+    r = regs.astype(jnp.uint64).reshape(cap, HLL_LANES, HLL_REG_PER_LANE)
+    packed = jnp.zeros((cap, HLL_LANES), dtype=jnp.uint64)
+    for j in range(HLL_REG_PER_LANE):
+        packed = packed | (r[:, :, j] << jnp.uint64(8 * j))
+    packed = packed.astype(jnp.int64)
+    return {i: packed[:, i] for i in range(HLL_LANES)}
+
+
+def _unpack(lanes, n: int) -> jnp.ndarray:
+    """[n, HLL_M] int32 registers from the packed int64 lanes."""
+    cols = []
+    for i in range(HLL_LANES):
+        word = lanes[i].astype(jnp.uint64)
+        for j in range(HLL_REG_PER_LANE):
+            cols.append(
+                ((word >> jnp.uint64(8 * j)) & jnp.uint64(0xFF)).astype(
+                    jnp.int32
+                )
+            )
+    return jnp.stack(cols, axis=1)  # order: lane-major = register index
+
+
+def hll_merge(
+    lanes, sel: jnp.ndarray, gid: jnp.ndarray, cap: int
+) -> Dict[int, jnp.ndarray]:
+    """Merge partial packed-register rows into final groups
+    (register-wise max)."""
+    _guard_cap(cap, HLL_M)
+    n = sel.shape[0]
+    regs = _unpack(lanes, n)  # [n, HLL_M]
+    regs = jnp.where(sel[:, None], regs, 0)
+    seg = (gid[:, None] * HLL_M + jnp.arange(HLL_M)[None, :]).reshape(-1)
+    flat = jax.ops.segment_max(
+        regs.reshape(-1), jnp.where(jnp.repeat(sel, HLL_M), seg, 0),
+        num_segments=cap * HLL_M,
+    )
+    flat = jnp.maximum(flat, 0)
+    return _pack(flat.reshape(cap, HLL_M))
+
+
+def hll_cardinality(lanes, cap: int) -> jnp.ndarray:
+    """HLL estimator with linear-counting small-range correction."""
+    regs = _unpack(lanes, cap).astype(jnp.float64)  # [cap, m]
+    inv = jnp.sum(jnp.exp2(-regs), axis=1)
+    raw = _HLL_ALPHA * HLL_M * HLL_M / inv
+    zeros = jnp.sum(regs == 0, axis=1)
+    small = (raw <= 2.5 * HLL_M) & (zeros > 0)
+    linear = HLL_M * jnp.log(HLL_M / jnp.maximum(zeros, 1e-9))
+    est = jnp.where(small, linear, raw)
+    return jnp.round(est).astype(jnp.int64)
+
+
+# --- k-minimum-hash uniform sample (percentile sketch) ----------------
+
+_H_EMPTY = jnp.int64(2**62)
+
+
+def kmv_accumulate(
+    v: jnp.ndarray,
+    live: jnp.ndarray,
+    gid: jnp.ndarray,
+    cap: int,
+    salt: int = 0,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-group uniform sample: the k rows with smallest per-ROW hash
+    (duplicated values sampled proportionally — the hash is over the row
+    index, not the value).  Returns (values [cap*k], hashes [cap*k]);
+    empty slots carry hash sentinel _H_EMPTY."""
+    _guard_cap(cap, KMV_K)
+    n = v.shape[0]
+    h = (
+        _mix64(jnp.arange(n, dtype=jnp.uint64) + jnp.uint64(salt * 2 + 1))
+        % jnp.uint64(2**40)
+    ).astype(jnp.int64)
+    return _kmv_keep_smallest(v, h, live, gid, cap)
+
+
+def _kmv_keep_smallest(v, h, live, gid, cap):
+    n = v.shape[0]
+    comp = jnp.where(live, gid * jnp.int64(2**40) + h, jnp.int64(2**62))
+    _, order = jax.lax.sort(
+        (comp, jnp.arange(n, dtype=jnp.int64)), num_keys=1
+    )
+    gs = jnp.where(live, gid, cap - 1)[order]
+    live_s = live[order]
+    first = jax.ops.segment_min(
+        jnp.where(live_s, jnp.arange(n, dtype=jnp.int64), n),
+        jnp.where(live_s, gs, 0),
+        num_segments=cap,
+    )
+    first = jnp.minimum(first, n)
+    rank = jnp.arange(n, dtype=jnp.int64) - first[gs]
+    dest = jnp.where(
+        live_s & (rank < KMV_K), gs * KMV_K + rank, cap * KMV_K
+    )
+    vals = (
+        jnp.zeros(cap * KMV_K, dtype=v.dtype)
+        .at[dest]
+        .set(v[order], mode="drop")
+    )
+    hs = (
+        jnp.full(cap * KMV_K, _H_EMPTY, dtype=jnp.int64)
+        .at[dest]
+        .set(h[order], mode="drop")
+    )
+    return vals, hs
+
+
+def kmv_merge(
+    vals: jnp.ndarray,
+    hs: jnp.ndarray,
+    sel: jnp.ndarray,
+    gid: jnp.ndarray,
+    cap: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Union partial sample rows (each row carries k candidate slots) and
+    re-keep the k smallest hashes per final group — still an exact
+    uniform sample of the union."""
+    _guard_cap(cap, KMV_K)
+    n = sel.shape[0]
+    live = jnp.repeat(sel, KMV_K) & (hs.reshape(-1) != _H_EMPTY)
+    gidr = jnp.repeat(gid, KMV_K)
+    return _kmv_keep_smallest(
+        vals.reshape(-1), hs.reshape(-1), live, gidr, cap
+    )
+
+
+def kmv_quantile(
+    vals: jnp.ndarray,
+    hs: jnp.ndarray,
+    cap: int,
+    q: float,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Nearest-rank quantile of each group's sample.  Returns
+    (value [cap], has_rows [cap])."""
+    flat_live = hs != _H_EMPTY
+    gidf = jnp.arange(cap * KMV_K, dtype=jnp.int64) // KMV_K
+    # sort samples by (group, value) — a 2-key sort on the full-width
+    # order encoding so the in-group value order is EXACT
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        from .aggregation import f64_order_bits
+
+        enc = (
+            f64_order_bits(vals) ^ jnp.uint64(1 << 63)
+        ).astype(jnp.int64)
+    else:
+        enc = vals.astype(jnp.int64)
+    gkey = jnp.where(flat_live, gidf, jnp.int64(cap))  # dead rows last
+    ntot = cap * KMV_K
+    sg, _, order = jax.lax.sort(
+        (gkey, enc, jnp.arange(ntot, dtype=jnp.int64)), num_keys=2
+    )
+    vs = vals[order]
+    ls = sg < cap
+    gs = jnp.where(ls, sg, cap - 1)
+    first = jax.ops.segment_min(
+        jnp.where(ls, jnp.arange(ntot, dtype=jnp.int64), ntot),
+        jnp.where(ls, gs, 0),
+        num_segments=cap,
+    )
+    counts = jax.ops.segment_sum(
+        ls.astype(jnp.int64), jnp.where(ls, gs, 0), num_segments=cap
+    )
+    first = jnp.minimum(first, ntot)
+    rank = jnp.arange(ntot, dtype=jnp.int64) - first[gs]
+    target = jnp.floor(
+        q * (jnp.maximum(counts, 1) - 1) + 0.5
+    ).astype(jnp.int64)
+    pick = ls & (rank == target[gs])
+    if jnp.issubdtype(vals.dtype, jnp.floating):
+        out = jax.ops.segment_max(
+            jnp.where(pick, vs, -jnp.inf), jnp.where(ls, gs, 0),
+            num_segments=cap,
+        )
+        out = jnp.where(counts > 0, out, 0.0)
+    else:
+        out = jax.ops.segment_max(
+            jnp.where(pick, vs, jnp.int64(-(2**62))),
+            jnp.where(ls, gs, 0), num_segments=cap,
+        )
+        out = jnp.where(counts > 0, out, 0)
+    return out, counts > 0

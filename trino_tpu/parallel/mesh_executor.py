@@ -404,7 +404,9 @@ class _MeshTraceCtx(_TraceCtx):
             sorted_lanes = {
                 s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
             }
-            accs = agg_ops.accumulate(specs, sorted_lanes, gid, sel_sorted, cap)
+            accs = agg_ops.accumulate(
+                specs, sorted_lanes, gid, sel_sorted, cap, step="partial"
+            )
             present_local = jnp.arange(cap) < ngroups
             keys_local = agg_ops.group_keys_output(
                 [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap

@@ -283,6 +283,11 @@ class AggInfo:
             if (name.endswith("$count") or name.endswith("$valid")
                     or name.endswith("$has") or name.endswith("$n")):
                 return T.BIGINT
+            base = name.rsplit("$", 1)[-1]
+            if base.startswith("hll") or base.startswith("ph") or base == "pn":
+                return T.BIGINT  # packed HLL registers / sample hashes
+            if base.startswith("pv") or base in ("pmin", "pmax"):
+                return it if it is not None else T.BIGINT  # sample values
             if moment:  # $sum/$sumsq/$sumlog/$sx... are float moments
                 return T.DOUBLE
             if name.endswith("$key"):  # min_by/max_by ordering key
