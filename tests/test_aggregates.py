@@ -382,3 +382,34 @@ def test_sketched_partial_final_distributed(session, oracle_conn):
             assert lo <= est <= hi, (k, est, lo, hi)
     finally:
         r.stop()
+
+
+def test_array_map_listagg(session, oracle_conn):
+    """Host-staged variable-length aggregates (array_agg/map_agg/listagg):
+    the reference ships these in operator/aggregation/; order within a
+    group follows input order."""
+    from trino_tpu.session import Session
+
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (g bigint, x bigint, name varchar)")
+    s.execute(
+        "insert into t values (1, 10, 'a'), (1, 20, 'b'), (2, 30, 'c'), "
+        "(1, null, 'd')"
+    )
+    assert s.execute(
+        "select g, array_agg(x) from t group by g order by g"
+    ).to_pylist() == [(1, [10, 20, None]), (2, [30])]
+    assert s.execute(
+        "select g, listagg(name, ',') from t group by g order by g"
+    ).to_pylist() == [(1, "a,b,d"), (2, "c")]
+    (row,) = s.execute("select map_agg(name, x) from t").to_pylist()
+    assert row[0] == {"a": 10, "b": 20, "c": 30, "d": None}
+    # over tpch data with a decimal element type
+    got = session.execute(
+        "select array_agg(o_totalprice) from orders where o_orderkey < 7"
+    ).to_pylist()
+    exact = [v for (v,) in oracle_conn.execute(
+        "select o_totalprice from orders where o_orderkey < 7"
+    )]
+    assert sorted(got[0][0]) == sorted(round(v, 2) for v in exact)

@@ -81,11 +81,21 @@ class Batch:
     replicated: bool = False  # identical on every mesh device (mesh exec)
 
 
-def _contains(plan: P.PlanNode, node_type) -> bool:
-    if isinstance(plan, node_type):
+def _contains(plan: P.PlanNode, node_type, pred=None) -> bool:
+    if isinstance(plan, node_type) and (pred is None or pred(plan)):
         return True
-    return any(_contains(s, node_type) for s in plan.sources)
+    return any(_contains(s, node_type, pred) for s in plan.sources)
 
+
+def _contains_host_aggs(plan: P.PlanNode) -> bool:
+    """Aggregates building per-group host dictionaries (array_agg /
+    map_agg / listagg) run eagerly, like UNNEST."""
+    from ..ops.aggregation import HOST_STAGED_KINDS
+
+    return _contains(
+        plan, P.Aggregate,
+        lambda n: any(a.kind in HOST_STAGED_KINDS for a in n.aggs),
+    )
 
 def _pad_capacity(n: int) -> int:
     """Static tile capacity: next multiple of 128 (TPU lane width)."""
@@ -255,6 +265,7 @@ class LocalExecutor:
                 self.config.get("jit_fragments")
                 and not self.config.get("collect_node_stats")
                 and not _contains(plan, (P.Unnest, P.MatchRecognize))
+                and not _contains_host_aggs(plan)
                 # unversioned sources (system tables, hive files) may change
                 # without shape changes: no safe compiled-fragment reuse
                 and all(
@@ -1081,9 +1092,19 @@ class _TraceCtx:
             b = self.visit(node.source)
         types = node.source.output_types()
         b, aggs = self._agg_dict_setup(node, b)
-        specs = [a.to_spec() for a in aggs]
+        all_specs = [a.to_spec() for a in aggs]
+        host_specs = [
+            s for s in all_specs if s.kind in agg_ops.HOST_STAGED_KINDS
+        ]
+        specs = [
+            s for s in all_specs if s.kind not in agg_ops.HOST_STAGED_KINDS
+        ]
         final = node.step in ("final", "intermediate")  # merges accumulators
         partial = node.step in ("partial", "intermediate")  # emits them
+        if host_specs and (final or partial):
+            raise ExecutionError(
+                "host-staged aggregates cannot split PARTIAL/FINAL"
+            )
 
         def reduce_rows(lanes, gid, sel, cap):
             if final:
@@ -1108,6 +1129,10 @@ class _TraceCtx:
             gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)
             accs = reduce_rows(b.lanes, gid, b.sel, 1)
             lanes = out_lanes(accs)
+            for hs in host_specs:
+                lanes[hs.output] = self._host_agg_lanes(
+                    hs, b.lanes, gid, b.sel, 1
+                )
             sel = jnp.ones(1, dtype=bool)
             # pad to 128 for consistency
             return Batch(
@@ -1127,6 +1152,7 @@ class _TraceCtx:
                 > 0
             )
             keys_out = agg_ops.group_keys_output(key_lanes, gid, b.sel, cap)
+            host_src = (b.lanes, gid, b.sel)
         else:
             cap = min(self.ex.group_capacity, b.sel.shape[0])
             perm, gid, ngroups = self._group_sort(key_lanes, b.sel, cap)
@@ -1140,7 +1166,10 @@ class _TraceCtx:
             keys_out = agg_ops.group_keys_output(
                 [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap
             )
+            host_src = (sorted_lanes, gid, sel_sorted)
         out = out_lanes(accs)
+        for hs in host_specs:
+            out[hs.output] = self._host_agg_lanes(hs, *host_src, cap)
         lanes = {}
         for k, kl in zip(node.keys, keys_out):
             lanes[k] = kl
@@ -1369,6 +1398,76 @@ class _TraceCtx:
                 bv, bok = lanes[s]
                 lanes[s] = (bv, bok & surviving)
         return Batch(lanes, sel)
+
+    def _host_agg_lanes(self, spec, lanes, gid, sel, cap):
+        """array_agg / map_agg / listagg: build per-group variable-length
+        values HOST-side into a fresh dictionary (the engine's model for
+        complex values — codes into a host dictionary, like
+        expr/arrays.py).  Runs eagerly (the jit gate excludes plans with
+        these aggregates), one python pass over the selected rows — the
+        same single-threaded row walk the reference's accumulators do.
+        Element values keep IR-constant conventions; Page.to_pylist
+        decodes them (page._element_decoder)."""
+        import numpy as np
+
+        v, ok = lanes[spec.input]
+        gid_np = np.asarray(gid)
+        sel_np = np.asarray(sel)
+        v_np = np.asarray(v)
+        ok_np = np.asarray(ok)
+        d_in = self.ex.dicts.get(spec.input)
+
+        def v_of(i, arr, okarr, d):
+            if not okarr[i]:
+                return None
+            x = arr[i].item()
+            if d is not None:
+                x = str(d[int(x)])
+            return x
+
+        groups: dict = {}
+        if spec.kind == "map_agg":
+            k2, ok2 = lanes[spec.input2]
+            k_np, k_ok = np.asarray(k2), np.asarray(ok2)
+            d_key = d_in
+            d_val = self.ex.dicts.get(spec.input2)
+            # spec.input is the KEY, input2 the VALUE (map_agg(key, value))
+            for i in np.nonzero(sel_np)[0]:
+                key = v_of(i, v_np, ok_np, d_key)
+                if key is None:
+                    continue  # NULL keys are skipped (reference behavior)
+                g = groups.setdefault(int(gid_np[i]), {})
+                g.setdefault(key, v_of(i, k_np, k_ok, d_val))
+        else:
+            for i in np.nonzero(sel_np)[0]:
+                g = groups.setdefault(int(gid_np[i]), [])
+                g.append(v_of(i, v_np, ok_np, d_in))
+
+        entries: list = []
+        index: dict = {}
+        codes = np.full(cap, -1, dtype=np.int32)
+        has = np.zeros(cap, dtype=bool)
+        for gi, val in groups.items():
+            if spec.kind == "array_agg":
+                obj = tuple(val)
+            elif spec.kind == "listagg":
+                obj = str(spec.param).join(
+                    str(x) for x in val if x is not None
+                )
+            else:  # map_agg: sorted key-value pair tuple
+                obj = tuple(sorted(val.items(), key=lambda kv: repr(kv[0])))
+            code = index.get(obj)
+            if code is None:
+                code = len(entries)
+                index[obj] = code
+                entries.append(obj)
+            codes[gi] = code
+            has[gi] = True
+        self.ex.dicts[spec.output] = np.array(entries, dtype=object)
+        return (
+            jnp.asarray(np.where(has, codes, 0)),
+            jnp.asarray(has),
+        )
 
     def _check_join_dicts(self, node: P.Join):
         for l, r in node.criteria:

@@ -73,7 +73,10 @@ BITWISE_KINDS = ("bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg")
 # SINGLE step they stay exact, but PARTIAL/FINAL ship mergeable sketch
 # state (ops/sketches.py: HLL registers / k-min-hash samples), the
 # reference's HyperLogLog + digest accumulator design.
-NON_DECOMPOSABLE = ()
+# array_agg/map_agg/listagg build variable-length host dictionaries per
+# group (host-staged, like UNNEST): raw rows must be colocated
+NON_DECOMPOSABLE = ("array_agg", "map_agg", "listagg")
+HOST_STAGED_KINDS = ("array_agg", "map_agg", "listagg")
 SKETCHED_KINDS = ("approx_distinct", "approx_percentile")
 
 TWO_ARG_KINDS = ("min_by", "max_by") + BINARY_MOMENT_KINDS
@@ -120,6 +123,8 @@ class AggSpec:
                 + [f"{o}$ph{i}" for i in range(K)]
                 + [f"{o}$pmin", f"{o}$pmax"]
             )
+        if self.kind in HOST_STAGED_KINDS:
+            return [f"{o}$val", f"{o}$valid"]  # host-staged; not shipped
         if self.kind in ("bool_and", "bool_or", "checksum",
                          "arbitrary") or self.kind in BITWISE_KINDS:
             return [f"{o}$val", f"{o}$valid"]
@@ -668,6 +673,11 @@ def accumulate(
                     hi = jnp.where(live, v.astype(jnp.int64), -I64_MAX)
                 out[f"{o}$pmin"] = _seg_min(lo, gid, cap)
                 out[f"{o}$pmax"] = _seg_max(hi, gid, cap)
+        elif s.kind in HOST_STAGED_KINDS:
+            raise NotImplementedError(
+                f"{s.kind} is host-staged (exec/local.py _host_agg_lanes)"
+                " and cannot run inside a traced kernel (mesh path)"
+            )
         else:
             raise NotImplementedError(s.kind)
     return out

@@ -165,8 +165,19 @@ def kmv_accumulate(
     empty slots carry hash sentinel _H_EMPTY."""
     _guard_cap(cap, KMV_K)
     n = v.shape[0]
+    # fold the VALUE BITS into the per-row hash: a pure row-index hash is
+    # identical on every shard, so merged samples would be position-
+    # correlated across workers (effective sample k/W when scan order
+    # correlates with the value); value bits decorrelate shards while the
+    # row index keeps duplicate values individually sampleable
+    from .aggregation import _key_bits
+
     h = (
-        _mix64(jnp.arange(n, dtype=jnp.uint64) + jnp.uint64(salt * 2 + 1))
+        _mix64(
+            jnp.arange(n, dtype=jnp.uint64)
+            ^ _mix64(_key_bits(v))
+            ^ jnp.uint64(salt * 2 + 1)
+        )
         % jnp.uint64(2**40)
     ).astype(jnp.int64)
     return _kmv_keep_smallest(v, h, live, gid, cap)

@@ -284,7 +284,7 @@ class AggInfo:
                     or name.endswith("$has") or name.endswith("$n")):
                 return T.BIGINT
             base = name.rsplit("$", 1)[-1]
-            if base.startswith("hll") or base.startswith("ph") or base == "pn":
+            if base.startswith("hll") or base.startswith("ph"):
                 return T.BIGINT  # packed HLL registers / sample hashes
             if base.startswith("pv") or base in ("pmin", "pmax"):
                 return it if it is not None else T.BIGINT  # sample values

@@ -49,9 +49,10 @@ ONE_ARG_AGGREGATES = {
     "bool_and", "bool_or",
     "bitwise_and_agg", "bitwise_or_agg", "bitwise_xor_agg",
     "checksum", "arbitrary", "count_if", "approx_distinct",
+    "array_agg",
 }
 TWO_ARG_AGGREGATES = {
-    "min_by", "max_by",
+    "min_by", "max_by", "map_agg", "listagg",
     "covar_pop", "covar_samp", "corr",
     "regr_slope", "regr_intercept",
     "approx_percentile",
@@ -2436,6 +2437,16 @@ class AggCollector(ExprAnalyzer):
                 )
                 if not (0.0 <= param <= 1.0):
                     raise SemanticError("percentile must be in [0, 1]")
+            elif kind == "listagg":
+                # second argument is the constant separator string
+                p = self._an(e.args[1])
+                if not isinstance(p, ir.Constant) or not isinstance(
+                    p.value, str
+                ):
+                    raise SemanticError(
+                        "listagg requires a constant varchar separator"
+                    )
+                param = p.value
             else:
                 arg2 = self._an(e.args[1])
                 in2_t = arg2.type
@@ -2633,6 +2644,14 @@ def _agg_output_type(
         if not T.is_numeric(in_t) and in_t.name != "unknown":
             raise SemanticError("approx_percentile requires a numeric argument")
         return in_t
+    if kind == "array_agg":
+        return T.array_of(in_t)
+    if kind == "map_agg":
+        if in2_t is None:
+            raise SemanticError("map_agg(key, value) takes two arguments")
+        return T.map_of(in_t, in2_t)
+    if kind == "listagg":
+        return T.VARCHAR
     if kind in ("min", "max", "arbitrary"):
         return in_t
     if kind in ("min_by", "max_by"):
