@@ -57,9 +57,9 @@ def test_spill_plan_detection():
 def test_non_spillable_query_exceeds_limit():
     s = tpch_session(SF, query_max_memory_bytes=50_000)
     with pytest.raises(ExceededMemoryLimitError):
-        s.execute(
-            "select l_orderkey, l_partkey from lineitem order by l_orderkey"
-        )
+        # bare projection: no aggregate/join/sort/window to stage out of
+        # core, so the limit must surface
+        s.execute("select l_orderkey, l_partkey from lineitem")
 
 
 def test_spill_disabled_enforces_limit():
@@ -147,3 +147,81 @@ def test_dynamic_filter_empty_build_prunes_all():
     ex = FragmentExecutor(s.catalogs, {}, {0: splits}, remote, dfs)
     page = ex.execute(plan)
     assert page.count == 0
+
+
+def test_join_spill_completes_under_memory_limit():
+    """A join whose inputs exceed the memory limit completes via the
+    partitioned out-of-core join (HashBuilderOperator SPILLING_INPUT
+    analog) with identical results."""
+    from trino_tpu.session import tpch_session
+
+    sql = (
+        "select c.c_mktsegment, count(*), sum(o.o_totalprice) "
+        "from orders o join customer c on o.o_custkey = c.c_custkey "
+        "where o.o_totalprice > 1000 "
+        "group by c.c_mktsegment order by c.c_mktsegment"
+    )
+    free = tpch_session(0.01)
+    expected = free.execute(sql).to_pylist()
+    tight = tpch_session(0.01, query_max_memory_bytes=400_000)
+    got = tight.execute(sql).to_pylist()
+    assert got == expected
+
+
+def test_sort_spill_total_order():
+    from trino_tpu.session import tpch_session
+
+    sql = (
+        "select o_orderkey, o_totalprice from orders "
+        "order by o_totalprice desc, o_orderkey"
+    )
+    free = tpch_session(0.01)
+    expected = free.execute(sql).to_pylist()
+    tight = tpch_session(0.01, query_max_memory_bytes=300_000)
+    got = tight.execute(sql).to_pylist()
+    assert got == expected
+
+
+def test_window_spill_partitioned():
+    from trino_tpu.session import tpch_session
+
+    sql = (
+        "select o_custkey, o_orderkey, "
+        "row_number() over (partition by o_custkey order by o_orderdate, o_orderkey) rn "
+        "from orders order by o_custkey, rn limit 50"
+    )
+    free = tpch_session(0.01)
+    expected = free.execute(sql).to_pylist()
+    tight = tpch_session(0.01, query_max_memory_bytes=300_000)
+    got = tight.execute(sql).to_pylist()
+    assert got == expected
+
+
+def test_sort_spill_varchar_dictionaries_unified():
+    """Regression: per-batch lazy dictionaries (o_clerk) must be remapped
+    into one union dictionary before merging sorted runs."""
+    from trino_tpu.session import tpch_session
+
+    sql = (
+        "select o_orderkey, o_clerk from orders "
+        "order by o_totalprice desc, o_orderkey"
+    )
+    free = tpch_session(0.01)
+    expected = free.execute(sql).to_pylist()
+    tight = tpch_session(0.01, query_max_memory_bytes=300_000)
+    got = tight.execute(sql).to_pylist()
+    assert got == expected
+
+
+def test_sort_spill_varchar_sort_key():
+    from trino_tpu.session import tpch_session
+
+    sql = (
+        "select o_clerk, o_orderkey from orders "
+        "order by o_clerk desc, o_orderkey limit 40"
+    )
+    free = tpch_session(0.01)
+    expected = free.execute(sql).to_pylist()
+    tight = tpch_session(0.01, query_max_memory_bytes=300_000)
+    got = tight.execute(sql).to_pylist()
+    assert got == expected

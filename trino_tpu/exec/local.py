@@ -226,6 +226,15 @@ class LocalExecutor:
             sp = spill.plan_spill(self, plan, int(limit))
             if sp is not None:
                 return spill.execute_spilled_aggregation(self, plan, *sp)
+            sp = spill.plan_join_spill(self, plan, int(limit))
+            if sp is not None:
+                return spill.execute_spilled_join(self, plan, *sp)
+            sp = spill.plan_sort_spill(self, plan, int(limit))
+            if sp is not None:
+                return spill.execute_spilled_sort(self, plan, *sp)
+            sp = spill.plan_window_spill(self, plan, int(limit))
+            if sp is not None:
+                return spill.execute_spilled_window(self, plan, *sp)
         # 1. host side: load scans, collect dictionaries
         scans: Dict[int, Dict[str, np.ndarray]] = {}
         dicts: Dict[str, np.ndarray] = {}
@@ -1463,7 +1472,11 @@ class _TraceCtx:
                 entries.append(obj)
             codes[gi] = code
             has[gi] = True
-        self.ex.dicts[spec.output] = np.array(entries, dtype=object)
+        # 1-D object array even when all entries are equal-length
+        # tuples (np.array would build a 2-D array)
+        d_out = np.empty(len(entries), dtype=object)
+        d_out[:] = entries
+        self.ex.dicts[spec.output] = d_out
         return (
             jnp.asarray(np.where(has, codes, 0)),
             jnp.asarray(has),
