@@ -144,3 +144,62 @@ def test_fte_survives_worker_death(runner, oracle_conn):
     sql = "select count(*) from orders"
     _, rows = runner.execute(sql)
     assert [tuple(r) for r in rows] == [(1500,)]
+
+
+def _inject_mode(uri: str, task_id: str, mode: str):
+    req = urllib.request.Request(
+        f"{uri}/v1/task/{task_id}/fail",
+        data=json.dumps({"mode": mode}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    urllib.request.urlopen(req, timeout=5.0).read()
+
+
+def test_speculative_execution_beats_straggler(runner, oracle_conn):
+    """A stalled task attempt is out-raced by a speculative backup on
+    another worker (EventDrivenFaultTolerantQueryScheduler SPECULATIVE
+    class): the query completes far sooner than the injected stall."""
+    import time
+
+    nm = runner.coordinator.coordinator.node_manager
+    fte = FaultTolerantScheduler(
+        runner.session.catalogs, nm,
+        properties={"group_capacity": 4096},
+    )
+    sql = "select count(*), sum(l_quantity) from lineitem"
+    expected = oracle_conn.execute(sql).fetchall()
+    qid = "q_fte_straggler"
+    stall = 20.0
+    # stall fragment 1 (the source stage, 2 tasks), task 0's first attempt
+    # on EVERY worker — wherever it lands, it stalls
+    for _, uri in nm.alive():
+        _inject_mode(uri, f"{qid}.1.0.0", f"STALL:{stall}")
+    plan = runner.session._plan_stmt(parse(sql))
+    t0 = time.time()
+    page = fte.run(plan, qid)
+    elapsed = time.time() - t0
+    assert_rows_match(page.to_pylist(), expected, tol=1e-6)
+    assert elapsed < stall, f"speculation did not engage ({elapsed:.1f}s)"
+
+
+def test_speculation_off_waits_for_straggler(runner, oracle_conn):
+    """Control: with speculative_execution disabled the query waits for
+    the stalled attempt."""
+    import time
+
+    nm = runner.coordinator.coordinator.node_manager
+    fte = FaultTolerantScheduler(
+        runner.session.catalogs, nm,
+        properties={"group_capacity": 4096,
+                    "speculative_execution": False},
+    )
+    sql = "select count(*) from lineitem"
+    qid = "q_fte_straggler_off"
+    stall = 3.0
+    for _, uri in nm.alive():
+        _inject_mode(uri, f"{qid}.1.0.0", f"STALL:{stall}")
+    plan = runner.session._plan_stmt(parse(sql))
+    t0 = time.time()
+    page = fte.run(plan, qid)
+    elapsed = time.time() - t0
+    assert page.count and elapsed >= stall * 0.9

@@ -126,6 +126,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "failure recovery: none (pipelined) | task (FTE over spool)",
             _retry_policy, "none",
         ),
+        PropertyMetadata(
+            "speculative_execution",
+            "FTE: launch backup attempts for straggler tasks "
+            "(EventDrivenFaultTolerantQueryScheduler SPECULATIVE class)",
+            _bool, True,
+        ),
     ]
 }
 

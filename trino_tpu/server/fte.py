@@ -41,6 +41,20 @@ MAX_ATTEMPTS = 4
 POLL_INTERVAL = 0.02
 TASK_TIMEOUT = 300.0
 POLL_FAILURE_TOLERANCE = 3  # consecutive status-poll errors = worker lost
+# speculative execution (EventDrivenFaultTolerantQueryScheduler SPECULATIVE
+# class): a task running longer than SPECULATION_FACTOR x the median
+# completed sibling (and at least SPECULATION_MIN_S) gets a backup attempt
+# on another worker; first committed attempt wins
+SPECULATION_FACTOR = 2.0
+SPECULATION_MIN_S = 0.75
+# failed attempts retry with exponentially grown memory
+# (ExponentialGrowthPartitionMemoryEstimator)
+MEMORY_GROWTH_FACTOR = 2
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    return s[len(s) // 2]
 
 
 class FaultTolerantScheduler:
@@ -148,62 +162,172 @@ class FaultTolerantScheduler:
         frag_json = plan_to_json(f.root)
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=max(ntasks, 1)) as pool:
+        sibling_times: List[float] = []  # completed task durations (stage)
+        # backups may double the concurrent attempts of a stage
+        with ThreadPoolExecutor(max_workers=max(2 * ntasks, 1)) as pool:
             futures = [
                 pool.submit(
                     self._run_task_with_retries,
                     query_id, f, i, frag_json, per_task_splits[i],
-                    out_buffers, committed, by_id,
+                    out_buffers, committed, by_id, sibling_times, pool,
                 )
                 for i in range(ntasks)
             ]
             return [fut.result() for fut in futures]
 
+    def _start_attempt(
+        self, query_id, f, task_index, attempt, frag_json, splits,
+        out_buffers, committed, by_id, worker_offset=0,
+    ):
+        """POST one attempt; returns (uri, task_id, sink)."""
+        workers = self.node_manager.alive()
+        if not workers:
+            raise SchedulerError("NO_NODES_AVAILABLE during retry")
+        node_id, uri = workers[
+            (task_index + attempt + worker_offset) % len(workers)
+        ]
+        sink = self.exchange.sink(query_id, f.id, task_index, attempt)
+        task_id = f"{query_id}.{f.id}.{task_index}.{attempt}"
+        props = dict(self.properties)
+        base_mem = props.get("query_max_memory_bytes")
+        if base_mem and attempt:
+            # re-try with exponentially grown memory
+            # (ExponentialGrowthPartitionMemoryEstimator)
+            props["query_max_memory_bytes"] = int(
+                base_mem * (MEMORY_GROWTH_FACTOR ** attempt)
+            )
+        doc = {
+            "fragment": frag_json,
+            "splits": {
+                str(k): [encode_value(s) for s in v]
+                for k, v in splits.items()
+            },
+            "output": {
+                "partitioning": f.output_partitioning,
+                "keys": list(f.output_keys),
+                "nbuffers": out_buffers,
+            },
+            "sources": self._sources_for(f, task_index, committed, by_id),
+            "properties": props,
+            "spool_path": sink.path,
+        }
+        _post_json(f"{uri}/v1/task/{task_id}", doc)
+        self._created_tasks.append((uri, task_id))
+        return uri, task_id, sink
+
     def _run_task_with_retries(
         self, query_id, f, task_index, frag_json, splits, out_buffers,
-        committed, by_id,
+        committed, by_id, sibling_times=None, pool=None,
     ) -> str:
         last_error = None
-        for attempt in range(MAX_ATTEMPTS):
-            workers = self.node_manager.alive()
-            if not workers:
-                raise SchedulerError("NO_NODES_AVAILABLE during retry")
-            node_id, uri = workers[(task_index + attempt) % len(workers)]
-            sink = self.exchange.sink(query_id, f.id, task_index, attempt)
-            task_id = f"{query_id}.{f.id}.{task_index}.{attempt}"
-            doc = {
-                "fragment": frag_json,
-                "splits": {
-                    str(k): [encode_value(s) for s in v]
-                    for k, v in splits.items()
-                },
-                "output": {
-                    "partitioning": f.output_partitioning,
-                    "keys": list(f.output_keys),
-                    "nbuffers": out_buffers,
-                },
-                "sources": self._sources_for(
-                    f, task_index, committed, by_id
-                ),
-                "properties": self.properties,
-                "spool_path": sink.path,
-            }
+        speculate = bool(self.properties.get("speculative_execution", True))
+        attempt = 0
+        while attempt < MAX_ATTEMPTS:
             try:
-                _post_json(f"{uri}/v1/task/{task_id}", doc)
-                self._created_tasks.append((uri, task_id))
-                self._await_task(uri, task_id)
+                uri, task_id, sink = self._start_attempt(
+                    query_id, f, task_index, attempt, frag_json, splits,
+                    out_buffers, committed, by_id,
+                )
+            except SchedulerError:
+                raise
+            except Exception as e:
+                last_error = e
+                attempt += 1
+                continue
+            backup = None  # (future, attempt_no)
+            t0 = time.time()
+            try:
+                while True:
+                    state = self._poll_task(uri, task_id)
+                    if state == "FINISHED":
+                        break
+                    if state is not None:
+                        raise SchedulerError(f"task {task_id} {state}")
+                    if time.time() - t0 > TASK_TIMEOUT:
+                        raise SchedulerError(f"task {task_id} timed out")
+                    # straggler? launch ONE speculative backup attempt on
+                    # another worker; first committed attempt wins
+                    if (
+                        speculate
+                        and backup is None
+                        and pool is not None
+                        and attempt + 1 < MAX_ATTEMPTS
+                        and sibling_times
+                        and time.time() - t0
+                        > max(
+                            SPECULATION_MIN_S,
+                            SPECULATION_FACTOR
+                            * _median(sibling_times),
+                        )
+                    ):
+                        backup = self._launch_backup(
+                            pool, query_id, f, task_index, attempt + 1,
+                            frag_json, splits, out_buffers, committed,
+                            by_id,
+                        )
+                    if backup is not None and backup[0].done():
+                        bpath = backup[0].result()
+                        if bpath is not None:
+                            if sibling_times is not None:
+                                sibling_times.append(time.time() - t0)
+                            return bpath
+                        backup = None  # backup failed; keep waiting
+                    time.sleep(POLL_INTERVAL)
                 if not sink.committed:
                     raise SchedulerError(
                         f"task {task_id} finished without committing spool"
                     )
+                if sibling_times is not None:
+                    sibling_times.append(time.time() - t0)
                 return sink.path
             except Exception as e:
                 last_error = e
-                continue  # next attempt on another worker
+                # a running backup may still win before we retry
+                if backup is not None:
+                    bpath = backup[0].result()
+                    if bpath is not None:
+                        return bpath
+                    attempt = max(attempt, backup[1])
+                attempt += 1
+                continue
         raise SchedulerError(
             f"task {query_id}.{f.id}.{task_index} failed after "
             f"{MAX_ATTEMPTS} attempts: {last_error}"
         )
+
+    def _launch_backup(
+        self, pool, query_id, f, task_index, attempt, frag_json, splits,
+        out_buffers, committed, by_id,
+    ):
+        def run_backup():
+            try:
+                uri, task_id, sink = self._start_attempt(
+                    query_id, f, task_index, attempt, frag_json, splits,
+                    out_buffers, committed, by_id, worker_offset=1,
+                )
+                self._await_task(uri, task_id)
+                return sink.path if sink.committed else None
+            except Exception:
+                return None
+
+        return pool.submit(run_backup), attempt
+
+    def _poll_task(self, uri: str, task_id: str) -> Optional[str]:
+        """One status poll: None while running, 'FINISHED', or a failure
+        state string."""
+        try:
+            with urllib.request.urlopen(
+                f"{uri}/v1/task/{task_id}", timeout=5.0
+            ) as resp:
+                doc = json.loads(resp.read())
+        except (urllib.error.URLError, ConnectionError, OSError):
+            return None  # transient; outer timeout bounds us
+        state = doc.get("state")
+        if state == "FINISHED":
+            return "FINISHED"
+        if state in ("FAILED", "ABORTED", "CANCELED"):
+            return f"{state}: {doc.get('error')}"
+        return None
 
     def _await_task(self, uri: str, task_id: str):
         deadline = time.time() + TASK_TIMEOUT

@@ -105,7 +105,15 @@ class TaskManager:
             with self.lock:
                 mode = self.injected_failures.pop(t.task_id, None)
             if mode is not None:
-                raise RuntimeError(f"injected task failure ({mode})")
+                if mode.startswith("STALL"):
+                    # straggler injection (FailureInjector TASK_MANAGEMENT
+                    # _TIMEOUT analog): sleep, then run normally — the
+                    # speculative scheduler should win with a backup attempt
+                    import time as _time
+
+                    _time.sleep(float(mode.split(":", 1)[1]))
+                else:
+                    raise RuntimeError(f"injected task failure ({mode})")
             doc = t.doc
             plan = plan_from_json(doc["fragment"])
             splits_by_scan: Dict[int, List[Split]] = {}
