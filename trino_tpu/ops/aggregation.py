@@ -15,10 +15,11 @@ VPU, so grouping uses two strategies (SURVEY §7 "sort-or-scatter group-by"):
      of the reference's BigintGroupByHash fast path and covers low-
      cardinality group-bys (TPC-H Q1: 2x2 codes -> 6 ids).
 
-  2. sort-based: rows lexicographically sorted by the full key tuple
-     (jax.lax.sort multi-operand, exact — no hash collisions), group
-     boundaries by adjacent-difference, group ids by prefix sum, then the
-     same segment_sum accumulators.  O(n log n) but fully static-shape.
+  2. hash-sort: rows sorted by a salted 64-bit locator of the key tuple
+     (single-operand sort — multi-key comparators explode XLA:TPU
+     compile time), adjacent rows exactly verified on the real columns,
+     detected collisions re-run under a fresh salt (never probabilistic),
+     then the same segment accumulators.
 
 Group capacity is static per compilation; the kernel returns the true group
 count so the host can recompile with a larger capacity when exceeded
@@ -39,8 +40,9 @@ Aggregate function families (reference operator/aggregation/*):
   checksum                                                — order-independent
   arbitrary (any_value)                                   — first non-null
   min_by/max_by                                           — argmin/argmax
-  approx_distinct                                         — exact distinct here
-  approx_percentile                                       — sort-based exact
+  approx_distinct   — exact at SINGLE step; HLL sketch PARTIAL/FINAL
+  approx_percentile — exact at SINGLE step; k-min-hash sample sketch
+  array_agg/map_agg/listagg — host-staged per-group dictionaries
 
 NULL semantics: a NULL key is its own group (tracked via the validity bit as
 an extra radix/sort key); sum/min/max ignore NULL inputs and return NULL for
@@ -356,21 +358,23 @@ def _use_masked(cap: int) -> bool:
 
 def _seg_sum(v, gid, cap):
     if _use_masked(cap) and v.ndim == 1:
-        import os
-
-        if (os.environ.get("TRINO_TPU_PALLAS") == "1"
-                and v.shape[0] <= 4_000_000  # f32-plane exactness bound
-                and v.dtype in (jnp.int64, jnp.dtype("int64"))):
-            # opt-in hand-tiled pallas kernel (ops/pallas_kernels.py):
-            # one streaming pass over the input for ALL groups
-            from .pallas_kernels import HAVE_PALLAS, grouped_sum_i64
-
-            if HAVE_PALLAS:
-                return grouped_sum_i64(v, gid, cap)
         m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
         zero = jnp.zeros((), dtype=v.dtype)
         return jnp.sum(jnp.where(m, v[None, :], zero), axis=1)
     return jax.ops.segment_sum(v, gid, num_segments=cap)
+
+
+def _seg_count(mask, gid, cap):
+    """Per-group count of a boolean mask.  Counts are the pallas
+    single-f32-plane case (ops/pallas_kernels.grouped_count, ~14x the XLA
+    lowering on TPU at SF1 shapes); general int64 sums measured SLOWER in
+    pallas (int ops lack VPU MACs) and stay on _seg_sum."""
+    from . import pallas_kernels
+
+    ps = pallas_kernels.seg_count_maybe(mask, gid, cap)
+    if ps is not None:
+        return ps
+    return _seg_sum(mask.astype(jnp.int64), gid, cap)
 
 
 def _seg_min(v, gid, cap):
@@ -544,15 +548,15 @@ def accumulate(
             out[f"{o}$count"] = distinct_count(gid, lanes[s.input], sel, cap)
             continue
         if s.kind == "count_star":
-            out[f"{o}$count"] = _seg_sum(sel.astype(jnp.int64), gid, cap)
+            out[f"{o}$count"] = _seg_count(sel, gid, cap)
             continue
         v, ok = lanes[s.input]
         live = sel & ok
         if s.kind == "count":
-            out[f"{o}$count"] = _seg_sum(live.astype(jnp.int64), gid, cap)
+            out[f"{o}$count"] = _seg_count(live, gid, cap)
         elif s.kind == "count_if":
             hit = live & (v.astype(bool))
-            out[f"{o}$count"] = _seg_sum(hit.astype(jnp.int64), gid, cap)
+            out[f"{o}$count"] = _seg_count(hit, gid, cap)
         elif s.kind == "approx_distinct":
             if step == "single":
                 out[f"{o}$count"] = distinct_count(gid, (v, ok), sel, cap)
@@ -570,7 +574,7 @@ def accumulate(
             else:
                 vv = jnp.where(live, v.astype(jnp.int64), 0)
             ssum = _seg_sum(vv, gid, cap)
-            cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
+            cnt = _seg_count(live, gid, cap)
             if s.kind == "sum":
                 out[f"{o}$val"] = ssum
                 out[f"{o}$valid"] = cnt
@@ -586,7 +590,7 @@ def accumulate(
                 vv = jnp.where(live, v.astype(jnp.int64), sentinel)
             seg = _seg_min if s.kind == "min" else _seg_max
             out[f"{o}$val"] = seg(vv, gid, cap)
-            out[f"{o}$valid"] = _seg_sum(live.astype(jnp.int64), gid, cap)
+            out[f"{o}$valid"] = _seg_count(live, gid, cap)
         elif s.kind in MOMENT_KINDS:
             sm, sq, cnt = _moment_sums(v, live, gid, cap, s.input_type)
             out[f"{o}$sum"], out[f"{o}$sumsq"], out[f"{o}$count"] = sm, sq, cnt
@@ -594,7 +598,7 @@ def accumulate(
             x = _as_double(v, s.input_type)
             lx = jnp.where(live & (x > 0), jnp.log(jnp.maximum(x, 1e-300)), 0.0)
             out[f"{o}$sumlog"] = _seg_sum(lx, gid, cap)
-            out[f"{o}$count"] = _seg_sum(live.astype(jnp.int64), gid, cap)
+            out[f"{o}$count"] = _seg_count(live, gid, cap)
         elif s.kind in BINARY_MOMENT_KINDS:
             y, yok = lanes[s.input]
             x, xok = lanes[s.input2]
@@ -606,9 +610,9 @@ def accumulate(
             out[f"{o}$sxy"] = _seg_sum(xf * yf, gid, cap)
             out[f"{o}$sxx"] = _seg_sum(xf * xf, gid, cap)
             out[f"{o}$syy"] = _seg_sum(yf * yf, gid, cap)
-            out[f"{o}$n"] = _seg_sum(both.astype(jnp.int64), gid, cap)
+            out[f"{o}$n"] = _seg_count(both, gid, cap)
         elif s.kind in ("bool_and", "bool_or"):
-            cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
+            cnt = _seg_count(live, gid, cap)
             if s.kind == "bool_and":
                 vv = jnp.where(live, v.astype(jnp.int64), 1)
                 out[f"{o}$val"] = _seg_min(vv, gid, cap)
@@ -619,7 +623,7 @@ def accumulate(
         elif s.kind in BITWISE_KINDS:
             op = {"bitwise_and_agg": "and", "bitwise_or_agg": "or",
                   "bitwise_xor_agg": "xor"}[s.kind]
-            cnt = _seg_sum(live.astype(jnp.int64), gid, cap)
+            cnt = _seg_count(live, gid, cap)
             out[f"{o}$val"] = _segment_bitwise(
                 v, live, gid, cap, op, cnt.astype(jnp.int32)
             )
@@ -629,7 +633,7 @@ def accumulate(
                 ok, _splitmix64(v), jnp.int64(0x6E67_6C6C_7561)
             )
             out[f"{o}$val"] = _seg_sum(jnp.where(sel, addend, 0), gid, cap)
-            out[f"{o}$valid"] = _seg_sum(sel.astype(jnp.int64), gid, cap)
+            out[f"{o}$valid"] = _seg_count(sel, gid, cap)
         elif s.kind == "arbitrary":
             n = gid.shape[0]
             ridx = _seg_min(

@@ -163,8 +163,7 @@ class FaultTolerantScheduler:
         from concurrent.futures import ThreadPoolExecutor
 
         sibling_times: List[float] = []  # completed task durations (stage)
-        # backups may double the concurrent attempts of a stage
-        with ThreadPoolExecutor(max_workers=max(2 * ntasks, 1)) as pool:
+        with ThreadPoolExecutor(max_workers=max(ntasks, 1)) as pool:
             futures = [
                 pool.submit(
                     self._run_task_with_retries,
@@ -177,15 +176,15 @@ class FaultTolerantScheduler:
 
     def _start_attempt(
         self, query_id, f, task_index, attempt, frag_json, splits,
-        out_buffers, committed, by_id, worker_offset=0,
+        out_buffers, committed, by_id, exclude_uri=None,
     ):
-        """POST one attempt; returns (uri, task_id, sink)."""
+        """POST one attempt; returns (uri, task_id, sink).  exclude_uri
+        steers a backup away from the straggling primary's worker."""
         workers = self.node_manager.alive()
         if not workers:
             raise SchedulerError("NO_NODES_AVAILABLE during retry")
-        node_id, uri = workers[
-            (task_index + attempt + worker_offset) % len(workers)
-        ]
+        candidates = [w for w in workers if w[1] != exclude_uri] or workers
+        node_id, uri = candidates[(task_index + attempt) % len(candidates)]
         sink = self.exchange.sink(query_id, f.id, task_index, attempt)
         task_id = f"{query_id}.{f.id}.{task_index}.{attempt}"
         props = dict(self.properties)
@@ -215,13 +214,36 @@ class FaultTolerantScheduler:
         self._created_tasks.append((uri, task_id))
         return uri, task_id, sink
 
+    def _abort_task(self, uri, task_id):
+        try:
+            req = urllib.request.Request(
+                f"{uri}/v1/task/{task_id}", method="DELETE"
+            )
+            urllib.request.urlopen(req, timeout=5.0).read()
+        except Exception:
+            pass
+
     def _run_task_with_retries(
         self, query_id, f, task_index, frag_json, splits, out_buffers,
         committed, by_id, sibling_times=None, pool=None,
     ) -> str:
-        last_error = None
+        """Primary attempts with failover + at most one speculative backup
+        per primary attempt; FIRST COMMITTED ATTEMPT WINS, the loser is
+        aborted.  Backups run on daemon threads so neither the stage pool
+        nor the retry loop ever blocks on a slow backup."""
+        import threading
+
         speculate = bool(self.properties.get("speculative_execution", True))
+        last_error = None
         attempt = 0
+        backups: List[dict] = []  # {'done','path','duration','uri','task'}
+
+        def backup_winner():
+            for b in backups:
+                if b["done"] and b["path"] is not None:
+                    return b
+            return None
+
         while attempt < MAX_ATTEMPTS:
             try:
                 uri, task_id, sink = self._start_attempt(
@@ -234,100 +256,129 @@ class FaultTolerantScheduler:
                 last_error = e
                 attempt += 1
                 continue
-            backup = None  # (future, attempt_no)
+            launched_backup = False
+            poll_failures = 0
             t0 = time.time()
             try:
                 while True:
-                    state = self._poll_task(uri, task_id)
+                    state, polled = self._poll_task(uri, task_id)
+                    if polled:
+                        poll_failures = 0
+                    else:
+                        poll_failures += 1
+                        if poll_failures >= POLL_FAILURE_TOLERANCE:
+                            raise SchedulerError(
+                                f"worker {uri} lost (status polls failing)"
+                            )
                     if state == "FINISHED":
                         break
-                    if state is not None:
+                    if state is not None and state != "RUNNING":
                         raise SchedulerError(f"task {task_id} {state}")
                     if time.time() - t0 > TASK_TIMEOUT:
                         raise SchedulerError(f"task {task_id} timed out")
-                    # straggler? launch ONE speculative backup attempt on
-                    # another worker; first committed attempt wins
+                    win = backup_winner()
+                    if win is not None:
+                        self._abort_task(uri, task_id)
+                        if sibling_times is not None:
+                            sibling_times.append(win["duration"])
+                        return win["path"]
                     if (
                         speculate
-                        and backup is None
-                        and pool is not None
-                        and attempt + 1 < MAX_ATTEMPTS
+                        and not launched_backup
+                        and attempt + 1 + len(backups) < MAX_ATTEMPTS
                         and sibling_times
                         and time.time() - t0
                         > max(
                             SPECULATION_MIN_S,
-                            SPECULATION_FACTOR
-                            * _median(sibling_times),
+                            SPECULATION_FACTOR * _median(sibling_times),
                         )
                     ):
-                        backup = self._launch_backup(
-                            pool, query_id, f, task_index, attempt + 1,
-                            frag_json, splits, out_buffers, committed,
-                            by_id,
-                        )
-                    if backup is not None and backup[0].done():
-                        bpath = backup[0].result()
-                        if bpath is not None:
-                            if sibling_times is not None:
-                                sibling_times.append(time.time() - t0)
-                            return bpath
-                        backup = None  # backup failed; keep waiting
+                        launched_backup = True
+                        battempt = attempt + 1 + len(backups)
+                        b = {"done": False, "path": None, "duration": 0.0,
+                             "uri": None, "task": None}
+                        backups.append(b)
+
+                        def run_backup(b=b, battempt=battempt,
+                                       primary_uri=uri):
+                            bt0 = time.time()
+                            try:
+                                buri, btid, bsink = self._start_attempt(
+                                    query_id, f, task_index, battempt,
+                                    frag_json, splits, out_buffers,
+                                    committed, by_id,
+                                    exclude_uri=primary_uri,
+                                )
+                                b["uri"], b["task"] = buri, btid
+                                self._await_task(buri, btid)
+                                if bsink.committed:
+                                    b["path"] = bsink.path
+                            except Exception:
+                                pass
+                            finally:
+                                b["duration"] = time.time() - bt0
+                                b["done"] = True
+
+                        threading.Thread(
+                            target=run_backup, daemon=True
+                        ).start()
                     time.sleep(POLL_INTERVAL)
                 if not sink.committed:
                     raise SchedulerError(
                         f"task {task_id} finished without committing spool"
                     )
+                # primary won: abort any still-running backup (frees the
+                # worker; the loser's spool dir is never read)
+                for b in backups:
+                    if not b["done"] and b["uri"]:
+                        self._abort_task(b["uri"], b["task"])
                 if sibling_times is not None:
                     sibling_times.append(time.time() - t0)
                 return sink.path
             except Exception as e:
                 last_error = e
-                # a running backup may still win before we retry
-                if backup is not None:
-                    bpath = backup[0].result()
-                    if bpath is not None:
-                        return bpath
-                    attempt = max(attempt, backup[1])
-                attempt += 1
+                win = backup_winner()
+                if win is not None:
+                    return win["path"]
+                # skip attempt numbers consumed by backups; never block on
+                # a pending backup — it stays in the race
+                attempt = attempt + 1 + len(
+                    [b for b in backups if not b["done"]]
+                )
                 continue
+        # primaries exhausted: grant outstanding backups a bounded grace
+        deadline = time.time() + 30.0
+        while time.time() < deadline and any(
+            not b["done"] for b in backups
+        ):
+            win = backup_winner()
+            if win is not None:
+                return win["path"]
+            time.sleep(POLL_INTERVAL)
+        win = backup_winner()
+        if win is not None:
+            return win["path"]
         raise SchedulerError(
             f"task {query_id}.{f.id}.{task_index} failed after "
             f"{MAX_ATTEMPTS} attempts: {last_error}"
         )
 
-    def _launch_backup(
-        self, pool, query_id, f, task_index, attempt, frag_json, splits,
-        out_buffers, committed, by_id,
-    ):
-        def run_backup():
-            try:
-                uri, task_id, sink = self._start_attempt(
-                    query_id, f, task_index, attempt, frag_json, splits,
-                    out_buffers, committed, by_id, worker_offset=1,
-                )
-                self._await_task(uri, task_id)
-                return sink.path if sink.committed else None
-            except Exception:
-                return None
-
-        return pool.submit(run_backup), attempt
-
-    def _poll_task(self, uri: str, task_id: str) -> Optional[str]:
-        """One status poll: None while running, 'FINISHED', or a failure
-        state string."""
+    def _poll_task(self, uri: str, task_id: str):
+        """One status poll: (state, reachable) — state None while running
+        or on a transient poll failure."""
         try:
             with urllib.request.urlopen(
                 f"{uri}/v1/task/{task_id}", timeout=5.0
             ) as resp:
                 doc = json.loads(resp.read())
         except (urllib.error.URLError, ConnectionError, OSError):
-            return None  # transient; outer timeout bounds us
+            return None, False
         state = doc.get("state")
         if state == "FINISHED":
-            return "FINISHED"
+            return "FINISHED", True
         if state in ("FAILED", "ABORTED", "CANCELED"):
-            return f"{state}: {doc.get('error')}"
-        return None
+            return f"{state}: {doc.get('error')}", True
+        return None, True
 
     def _await_task(self, uri: str, task_id: str):
         deadline = time.time() + TASK_TIMEOUT
