@@ -213,3 +213,29 @@ def test_replace_function_invalidates_cached_plans(session):
         "create or replace function cf(x bigint) returns bigint return x * 10",
     )
     assert rows(session, "select cf(1)") == [(10,)]
+
+
+def test_otlp_file_exporter(tmp_path):
+    """Spans export as OTLP/JSON documents at query completion
+    (tracing/TracingMetadata + airlift exporter role)."""
+    import json
+
+    from trino_tpu.session import tpch_session
+    from trino_tpu.utils.tracing import OtlpFileExporter
+
+    out = tmp_path / "spans.otlp.jsonl"
+    s = tpch_session(0.001)
+    s.tracer.attach_exporter(OtlpFileExporter(str(out)))
+    try:
+        s.execute("select count(*) from nation")
+    finally:
+        s.tracer.exporter = None
+    lines = out.read_text().strip().splitlines()
+    assert lines
+    doc = json.loads(lines[-1])
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    names = {sp["name"] for sp in spans}
+    assert "execute" in names
+    for sp in spans:
+        assert sp["endTimeUnixNano"] >= sp["startTimeUnixNano"]
+        assert len(sp["traceId"]) == 32

@@ -236,3 +236,56 @@ def test_http_event_listener():
         assert done["state"] == "FINISHED" and done["outputRows"] == 1
     finally:
         httpd.shutdown()
+
+
+def test_round2_session_properties_wired():
+    """New properties actually change behavior (not decorative)."""
+    from trino_tpu.plan import nodes as P
+    from trino_tpu.session import tpch_session
+
+    sql = (
+        "select count(*) from part, supplier, partsupp "
+        "where p_partkey = ps_partkey and s_suppkey = ps_suppkey"
+    )
+    on = tpch_session(0.001)
+    off = tpch_session(0.001, reorder_joins=False)
+
+    def joins(plan):
+        out = []
+
+        def walk(n):
+            if isinstance(n, P.Join):
+                out.append(n)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        return out
+
+    # reordered plan has no cross join; FROM-order plan keeps part x supplier
+    assert all(j.criteria for j in joins(on.plan(sql)) if j.kind != "cross")
+    assert any(j.kind == "cross" for j in joins(off.plan(sql)))
+
+    # in-list pushdown toggle controls the discrete ValueSet
+    s_in = tpch_session(0.001)
+    s_noin = tpch_session(0.001, in_list_pushdown=False)
+
+    def scan_of(plan):
+        n = plan
+        found = []
+
+        def walk(n):
+            if isinstance(n, P.TableScan):
+                found.append(n)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        return found[0]
+
+    q = "select count(*) from part where p_size in (1, 5)"
+    assert any(len(e) > 3 for e in scan_of(s_in.plan(q)).constraint)
+    assert all(len(e) == 3 for e in scan_of(s_noin.plan(q)).constraint)
+
+    # results identical either way
+    assert on.execute(sql).to_pylist() == off.execute(sql).to_pylist()

@@ -127,6 +127,61 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _retry_policy, "none",
         ),
         PropertyMetadata(
+            "reorder_joins",
+            "stats-based join-graph reordering (ReorderJoins / "
+            "EliminateCrossJoins analogs); off keeps the FROM order",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "in_list_pushdown",
+            "derive discrete-value TupleDomains from IN lists for "
+            "connector split/row-group pruning",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "column_pruning",
+            "prune unreferenced columns into table scans "
+            "(PruneUnreferencedOutputs)",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "topn_initial_factor",
+            "initial TopN candidate-set multiple (the two-phase top_k "
+            "path's 4n base grows by this)",
+            int, 1,
+        ),
+        PropertyMetadata(
+            "scan_cache_enabled",
+            "cache device-resident scans across queries (warm-HBM reuse)",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "client_page_rows",
+            "rows per protocol result page (client paging chunk)",
+            int, 10000,
+        ),
+        PropertyMetadata(
+            "fte_max_attempts",
+            "FTE: attempts per task before the query fails",
+            int, 4,
+        ),
+        PropertyMetadata(
+            "fte_task_timeout_s",
+            "FTE: per-attempt wall-clock timeout (seconds)",
+            float, 300.0,
+        ),
+        PropertyMetadata(
+            "fte_speculation_factor",
+            "FTE: speculate when a task exceeds this multiple of the "
+            "median completed sibling duration",
+            float, 2.0,
+        ),
+        PropertyMetadata(
+            "fte_speculation_min_s",
+            "FTE: minimum straggler age before speculation (seconds)",
+            float, 0.75,
+        ),
+        PropertyMetadata(
             "speculative_execution",
             "FTE: launch backup attempts for straggler tasks "
             "(EventDrivenFaultTolerantQueryScheduler SPECULATIVE class)",

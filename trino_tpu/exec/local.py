@@ -253,7 +253,9 @@ class LocalExecutor:
             # (HashBuilderOperator never assumes uniqueness; we learn it)
             self.force_expansion = set()
             self.group_salt = 0
-            self.topn_factor = 1
+            self.topn_factor = int(
+                self.config.get("topn_initial_factor") or 1
+            )
             self.force_wide_mul = False
             # start at the last successful capacities for this plan: the
             # overflow ladder re-runs (and on first touch, re-COMPILES) the

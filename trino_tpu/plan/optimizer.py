@@ -32,29 +32,33 @@ def optimize(
     metadata: Optional[Metadata] = None,
     properties=None,
 ) -> P.PlanNode:
-    prev = None
-    cur = plan
-    for _ in range(20):
-        if cur == prev:
-            break
-        prev = cur
-        cur = _push_predicates(cur)
-        cur = _merge_filters(cur)
-    if metadata is not None:
-        cur = _reorder_joins(cur, metadata)
-        # the reorder re-applies residual predicates above the new join
-        # tree; sink them back down before physical decisions
+    def prop(name, default=True):
+        return properties.get(name) if properties is not None else default
+
+    def sink_predicates(node):
         prev = None
         for _ in range(20):
-            if cur == prev:
+            if node == prev:
                 break
-            prev = cur
-            cur = _push_predicates(cur)
-            cur = _merge_filters(cur)
+            prev = node
+            node = _push_predicates(node)
+            node = _merge_filters(node)
+        return node
+
+    cur = sink_predicates(plan)
+    if metadata is not None:
+        if prop("reorder_joins"):
+            cur = _reorder_joins(cur, metadata)
+            # the reorder re-applies residual predicates above the new
+            # join tree; sink them back down before physical decisions
+            cur = sink_predicates(cur)
         cur = _choose_build_sides(cur, metadata)
         cur = _choose_join_distribution(cur, metadata, properties)
-    cur = _prune_columns(cur)
-    cur = _derive_scan_constraints(cur)
+    if prop("column_pruning"):
+        cur = _prune_columns(cur)
+    cur = _derive_scan_constraints(
+        cur, in_lists=prop("in_list_pushdown")
+    )
     return cur
 
 
@@ -168,9 +172,14 @@ def _values_of(conj: "ir.Expr", scan: P.TableScan):
     return col, tuple(sorted(set(vals)))
 
 
-def _derive_scan_constraints(node: P.PlanNode) -> P.PlanNode:
+def _derive_scan_constraints(
+    node: P.PlanNode, in_lists: bool = True
+) -> P.PlanNode:
     node = _rewrite_sources(
-        node, tuple(_derive_scan_constraints(s) for s in node.sources)
+        node,
+        tuple(
+            _derive_scan_constraints(s, in_lists) for s in node.sources
+        ),
     )
     if not (isinstance(node, P.Filter) and isinstance(node.source, P.TableScan)):
         return node
@@ -178,7 +187,7 @@ def _derive_scan_constraints(node: P.PlanNode) -> P.PlanNode:
     ranges = {}
     value_sets = {}
     for c in _conjuncts(node.predicate):
-        vs = _values_of(c, scan)
+        vs = _values_of(c, scan) if in_lists else None
         if vs is not None:
             col, vals = vs
             prev = value_sets.get(col)

@@ -159,7 +159,10 @@ class Coordinator:
                 sched = DistributedScheduler(
                     self.session.catalogs, workers, task_props
                 )
-                return sched.run(plan, q.query_id)
+                page = sched.run(plan, q.query_id)
+                # per-task stats rollup (TaskStats -> QueryStats)
+                q.task_stats = getattr(sched, "last_task_stats", [])
+                return page
         return self.session.execute(q.sql, user=q.user)
 
     def cancel(self, query_id: str):
@@ -184,8 +187,12 @@ class Coordinator:
                 )
             # FINISHED: page out rows in chunks
             page = q.page
-            start = token * PAGE_ROWS
-            end = min(start + PAGE_ROWS, page.count)
+            page_rows = int(
+                self.session.properties.get("client_page_rows")
+                or PAGE_ROWS
+            )
+            start = token * page_rows
+            end = min(start + page_rows, page.count)
             chunk = Page(
                 [c.__class__(c.type, c.values[start:end],
                              None if c.validity is None else c.validity[start:end],
@@ -306,6 +313,19 @@ class _Handler(BaseHTTPRequestHandler):
                         ((q.finished or time.time()) - q.created) * 1000
                     ),
                     "outputRows": q.page.count if q.page else None,
+                    # per-task rollup (OperatorStats->TaskStats->QueryStats
+                    # hierarchy analog): totals + the per-task detail
+                    "stats": {
+                        "scanBytes": sum(
+                            t.get("scanBytes", 0)
+                            for t in getattr(q, "task_stats", [])
+                        ),
+                        "dynamicFilterRowsPruned": sum(
+                            t.get("dynamicFilterRowsPruned", 0)
+                            for t in getattr(q, "task_stats", [])
+                        ),
+                        "tasks": getattr(q, "task_stats", []),
+                    },
                 })
             return
         if self.path == "/v1/query":

@@ -71,6 +71,17 @@ class FaultTolerantScheduler:
         self.node_manager = node_manager
         self.exchange = exchange or FileSystemExchangeManager()
         self.properties = properties or {}
+        p = self.properties
+        self.max_attempts = int(p.get("fte_max_attempts") or MAX_ATTEMPTS)
+        self.task_timeout = float(
+            p.get("fte_task_timeout_s") or TASK_TIMEOUT
+        )
+        self.spec_factor = float(
+            p.get("fte_speculation_factor") or SPECULATION_FACTOR
+        )
+        self.spec_min_s = float(
+            p.get("fte_speculation_min_s") or SPECULATION_MIN_S
+        )
 
     # ------------------------------------------------------------------
     def run(self, plan: P.Output, query_id: Optional[str] = None) -> Page:
@@ -244,7 +255,7 @@ class FaultTolerantScheduler:
                     return b
             return None
 
-        while attempt < MAX_ATTEMPTS:
+        while attempt < self.max_attempts:
             try:
                 uri, task_id, sink = self._start_attempt(
                     query_id, f, task_index, attempt, frag_json, splits,
@@ -274,7 +285,7 @@ class FaultTolerantScheduler:
                         break
                     if state is not None and state != "RUNNING":
                         raise SchedulerError(f"task {task_id} {state}")
-                    if time.time() - t0 > TASK_TIMEOUT:
+                    if time.time() - t0 > self.task_timeout:
                         raise SchedulerError(f"task {task_id} timed out")
                     win = backup_winner()
                     if win is not None:
@@ -285,12 +296,12 @@ class FaultTolerantScheduler:
                     if (
                         speculate
                         and not launched_backup
-                        and attempt + 1 + len(backups) < MAX_ATTEMPTS
+                        and attempt + 1 + len(backups) < self.max_attempts
                         and sibling_times
                         and time.time() - t0
                         > max(
-                            SPECULATION_MIN_S,
-                            SPECULATION_FACTOR * _median(sibling_times),
+                            self.spec_min_s,
+                            self.spec_factor * _median(sibling_times),
                         )
                     ):
                         launched_backup = True
@@ -360,7 +371,7 @@ class FaultTolerantScheduler:
             return win["path"]
         raise SchedulerError(
             f"task {query_id}.{f.id}.{task_index} failed after "
-            f"{MAX_ATTEMPTS} attempts: {last_error}"
+            f"{self.max_attempts} attempts: {last_error}"
         )
 
     def _poll_task(self, uri: str, task_id: str):

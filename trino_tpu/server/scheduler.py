@@ -155,6 +155,21 @@ class DistributedScheduler:
             )[0]
             if not pages:
                 raise SchedulerError("root task produced no pages")
+            # per-task stats rollup (OperatorStats -> TaskStats ->
+            # QueryStats hierarchy analog) surfaced at /v1/query/{id}
+            self.last_task_stats = []
+            for t in created:
+                try:
+                    with urllib.request.urlopen(
+                        f"{t.uri}/v1/task/{t.task_id}", timeout=5.0
+                    ) as resp:
+                        info = json.loads(resp.read())
+                    self.last_task_stats.append(
+                        {"taskId": t.task_id, "uri": t.uri,
+                         **(info.get("stats") or {})}
+                    )
+                except Exception:
+                    pass
             return concat_pages(pages)
         finally:
             for t in created:

@@ -136,10 +136,15 @@ class TaskManager:
             ex = FragmentExecutor(
                 self.catalogs, config, splits_by_scan, remote_pages, dfs
             )
+            import time as _time
+
+            _t0 = _time.time()
             page = ex.execute(plan)
             t.stats = {
                 "dynamicFilterRowsPruned": ex.df_rows_pruned,
                 "scanBytes": ex.scan_bytes,
+                "outputRows": page.count,
+                "wallMillis": int((_time.time() - _t0) * 1000),
             }
             out = doc.get("output") or {}
             part = out.get("partitioning", "single")

@@ -110,7 +110,13 @@ class Session:
             ),
             "spill_enabled": self.properties.get("spill_enabled"),
             "memory_pool": self.memory_pool,
-            "scan_cache": self._scan_cache,
+            "scan_cache": (
+                self._scan_cache
+                if self.properties.get("scan_cache_enabled") else None
+            ),
+            "topn_initial_factor": self.properties.get(
+                "topn_initial_factor"
+            ),
         }
         exec_config["jit_fragments"] = bool(
             self.properties.get("jit_fragments")
@@ -472,6 +478,8 @@ class Session:
             page = executor.execute(plan)
         # input working-set size of the last query (bench + stats surface)
         self.last_scan_bytes = getattr(executor, "scan_bytes", 0)
+        # batch-export completed spans when an OTLP exporter is attached
+        self.tracer.flush()
         return page
 
     def _explain_analyze(self, query, query_id: str) -> Page:

@@ -18,9 +18,10 @@ rewritten over key/agg output symbols (AggregationAnalyzer's validation
 that select expressions are composed of grouping keys and aggregates).
 
 Subqueries: uncorrelated IN -> SemiJoin; uncorrelated EXISTS / scalar ->
-ScalarJoin (EnforceSingleRow analog).  Correlated subqueries need the
-decorrelation rules (reference sql/planner/optimizations/
-TransformCorrelated*) — explicitly rejected for now.
+ScalarJoin (EnforceSingleRow analog).  Correlated subqueries decorrelate
+into multi-key SemiJoins / grouped joins on the correlation keys (the
+TransformCorrelated* rules' role — see _plan_exists / _plan_scalar_subquery
+below).
 """
 from __future__ import annotations
 

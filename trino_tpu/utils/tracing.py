@@ -73,5 +73,82 @@ class Tracer:
         with self._lock:
             self.spans.clear()
 
+    # -- export ---------------------------------------------------------
+    def attach_exporter(self, exporter: "OtlpFileExporter"):
+        self.exporter = exporter
+
+    def flush(self):
+        """Export + drop all recorded spans (called at query completion —
+        the airlift OTel exporter's batch-flush role).  Without an exporter
+        spans stay in memory for tests/system tables."""
+        exporter = getattr(self, "exporter", None)
+        if exporter is None:
+            return
+        with self._lock:
+            spans, self.spans = self.spans, []
+        if spans:
+            exporter.export(spans)
+
+
+class OtlpFileExporter:
+    """OTLP/JSON span exporter writing one `resourceSpans` document per
+    flush to a local file (newline-delimited) — the OpenTelemetry wire
+    schema (trace service ExportTraceServiceRequest JSON mapping), minus
+    the network: an OTel collector can tail the file, and air-gapped
+    environments (like the bench TPU) still get durable traces.
+
+    Reference parity: airlift's OpenTelemetry exporter wired through
+    tracing/TracingMetadata.java + TrinoAttributes span schema.
+    """
+
+    def __init__(self, path: str, service_name: str = "trino-tpu"):
+        self.path = path
+        self.service_name = service_name
+        self._lock = threading.Lock()
+
+    def export(self, spans: List[Span]):
+        import json
+
+        doc = {
+            "resourceSpans": [{
+                "resource": {"attributes": [{
+                    "key": "service.name",
+                    "value": {"stringValue": self.service_name},
+                }]},
+                "scopeSpans": [{
+                    "scope": {"name": "trino_tpu"},
+                    "spans": [
+                        {
+                            "traceId": s.trace_id,
+                            "spanId": s.span_id,
+                            "parentSpanId": s.parent_id or "",
+                            "name": s.name,
+                            "startTimeUnixNano": int(s.start * 1e9),
+                            "endTimeUnixNano": int(
+                                (s.end or s.start) * 1e9
+                            ),
+                            "attributes": [
+                                {"key": k,
+                                 "value": {"stringValue": str(v)}}
+                                for k, v in s.attributes.items()
+                            ],
+                        }
+                        for s in spans
+                    ],
+                }],
+            }]
+        }
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(doc) + "\n")
+
 
 TRACER = Tracer()
+
+# TRINO_TPU_OTLP_FILE wires the process tracer to a file exporter at
+# import (the etc/config.properties tracing.* binding analog)
+import os as _os
+
+_otlp = _os.environ.get("TRINO_TPU_OTLP_FILE")
+if _otlp:
+    TRACER.attach_exporter(OtlpFileExporter(_otlp))
