@@ -207,7 +207,10 @@ def main():
     backend = _backend()
     on_tpu = backend not in ("cpu",)
     iters = int(os.environ.get("BENCH_ITERS", "5"))
-    q3_sf = float(os.environ.get("BENCH_Q3_SF", "10" if on_tpu else "1"))
+    # SF10 exceeds the single chip (worker OOM-crash, measured); SF5 is
+    # the largest configuration that completes — BASELINE.md config 3
+    # is reported at the spec SF only when BENCH_Q3_SF=10 is forced
+    q3_sf = float(os.environ.get("BENCH_Q3_SF", "5" if on_tpu else "1"))
     ds_sf = float(os.environ.get("BENCH_DS_SF", "1"))
     hive_sf = float(os.environ.get("BENCH_HIVE_SF", "1"))
 

@@ -425,3 +425,30 @@ def test_offset_forms(session, oracle_conn):
         "select n_nationkey from nation order by 1 "
         "offset 2 rows fetch next 3 rows only"
     ).to_pylist() == [(2,), (3,), (4,)]
+
+
+def test_table_functions(session, oracle_conn):
+    """Polymorphic table functions (spi/function/table + operator/table):
+    sequence + exclude_columns, composable with joins/aggregation."""
+    assert session.execute(
+        "select * from table(sequence(1, 5))"
+    ).to_pylist() == [(1,), (2,), (3,), (4,), (5,)]
+    assert session.execute(
+        "select sum(sequential_number) from table(sequence(0, 100, 10))"
+    ).to_pylist() == [(550,)]
+    assert session.execute(
+        "select t.n from table(sequence(2, 4)) as t (n) order by n desc"
+    ).to_pylist() == [(4,), (3,), (2,)]
+    got = session.execute(
+        "select * from table(exclude_columns(table(nation), "
+        "descriptor(n_comment, n_regionkey))) order by n_nationkey limit 2"
+    ).to_pylist()
+    assert got == [(0, "ALGERIA"), (1, "ARGENTINA")]
+    assert session.execute(
+        "select count(*) from table(sequence(1, 3)) s "
+        "join nation on s.sequential_number = nation.n_nationkey"
+    ).to_pylist() == [(3,)]
+    # named-argument form
+    assert session.execute(
+        "select * from table(sequence(start => 7, stop => 8))"
+    ).to_pylist() == [(7,), (8,)]

@@ -74,3 +74,14 @@ def test_cli_local_execute(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "x" in out and "1" in out
+
+
+def test_web_ui_served(server):
+    """/ui serves the query-monitor page (webapp/ React UI analog)."""
+    import urllib.request
+
+    with urllib.request.urlopen(f"{server.uri}/ui") as r:
+        body = r.read().decode()
+    assert "trino-tpu" in body and "/v1/query" in body
+    with urllib.request.urlopen(f"{server.uri}/") as r:
+        assert "trino-tpu" in r.read().decode()

@@ -275,6 +275,17 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         parts = self.path.strip("/").split("/")
         co = self.coordinator
+        if self.path in ("/", "/ui", "/ui/"):
+            # query monitor (webapp/ React UI analog, single static page)
+            from .webui import UI_HTML
+
+            body = UI_HTML.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path == "/v1/info":
             self._json(200, {
                 "nodeId": co.node_id,

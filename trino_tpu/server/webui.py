@@ -1,0 +1,88 @@
+"""Coordinator web UI: a single-file query monitor.
+
+Reference parity: core/trino-main/src/main/resources/webapp/ — the React
+cluster/query UI served by the coordinator.  This engine serves one
+dependency-free HTML page at /ui that polls the same REST endpoints the
+reference UI uses (/v1/status, /v1/query, /v1/query/{id}) and renders the
+cluster summary, the query list, and per-query task statistics.
+"""
+
+UI_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>trino-tpu</title>
+<style>
+  body { font-family: ui-sans-serif, system-ui, sans-serif; margin: 0;
+         background: #0f1318; color: #e6e9ed; }
+  header { padding: 14px 24px; background: #161c24;
+           border-bottom: 1px solid #2a3340; display: flex; gap: 24px;
+           align-items: baseline; }
+  h1 { font-size: 16px; margin: 0; color: #7fd1b9; }
+  .stat { font-size: 13px; color: #9aa7b4; }
+  .stat b { color: #e6e9ed; }
+  main { padding: 18px 24px; }
+  table { border-collapse: collapse; width: 100%; font-size: 13px; }
+  th, td { text-align: left; padding: 6px 10px;
+           border-bottom: 1px solid #222b36; }
+  th { color: #9aa7b4; font-weight: 600; }
+  tr.q { cursor: pointer; }
+  tr.q:hover { background: #19212b; }
+  .FINISHED { color: #7fd1b9; } .FAILED { color: #e0707a; }
+  .RUNNING, .PLANNING, .QUEUED { color: #e3c567; }
+  #detail { margin-top: 18px; padding: 12px; background: #161c24;
+            border: 1px solid #2a3340; border-radius: 6px;
+            white-space: pre-wrap; font-family: ui-monospace, monospace;
+            font-size: 12px; display: none; }
+</style>
+</head>
+<body>
+<header>
+  <h1>trino-tpu</h1>
+  <span class="stat">workers <b id="workers">–</b></span>
+  <span class="stat">queries <b id="nqueries">–</b></span>
+  <span class="stat">uptime <b id="uptime">–</b></span>
+</header>
+<main>
+  <table>
+    <thead><tr><th>query id</th><th>state</th><th>query</th>
+               <th>error</th></tr></thead>
+    <tbody id="rows"></tbody>
+  </table>
+  <div id="detail"></div>
+</main>
+<script>
+async function j(u) { const r = await fetch(u); return r.json(); }
+async function refresh() {
+  try {
+    const st = await j('/v1/status');
+    document.getElementById('workers').textContent =
+      st.activeWorkers ?? st.workers ?? '–';
+    document.getElementById('uptime').textContent =
+      st.uptimeSeconds ? st.uptimeSeconds.toFixed(0) + 's' : '–';
+    const qs = await j('/v1/query');
+    document.getElementById('nqueries').textContent = qs.length;
+    const tbody = document.getElementById('rows');
+    tbody.innerHTML = '';
+    for (const q of qs.slice().reverse()) {
+      const tr = document.createElement('tr');
+      tr.className = 'q';
+      tr.innerHTML = '<td>' + q.queryId + '</td>' +
+        '<td class="' + q.state + '">' + q.state + '</td>' +
+        '<td>' + (q.query || '') + '</td>' +
+        '<td>' + (q.error || '') + '</td>';
+      tr.onclick = async () => {
+        const d = await j('/v1/query/' + q.queryId);
+        const el = document.getElementById('detail');
+        el.style.display = 'block';
+        el.textContent = JSON.stringify(d, null, 2);
+      };
+      tbody.appendChild(tr);
+    }
+  } catch (e) { /* coordinator restarting */ }
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

@@ -157,6 +157,13 @@ class Connector:
         """Monotonic change counter for cache invalidation."""
         return 0
 
+    def table_functions(self) -> dict:
+        """Connector-provided polymorphic table functions
+        (spi/function/table ConnectorTableFunction seam): name ->
+        callable(*scalar_args) returning (schema, rows) where schema
+        is [(column, Type), ...]."""
+        return {}
+
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError
 

@@ -1395,7 +1395,108 @@ class Analyzer:
                 P.Values((sym,), ((sym, T.BIGINT),), ((0,),)), Scope([])
             )
             return self._plan_unnest(dual, rel)
+        if isinstance(rel, ast.TableFunctionRelation):
+            return self._plan_table_function(rel)
         raise SemanticError(f"unsupported relation: {type(rel).__name__}")
+
+    # -- table functions (spi/function/table + operator/table) ----------
+    def _plan_table_function(
+        self, rel: "ast.TableFunctionRelation"
+    ) -> RelationPlan:
+        """Built-in polymorphic table functions (sequence,
+        exclude_columns) + the connector SPI seam
+        (Connector.table_functions() — ConnectorTableFunction analog)."""
+        name = rel.name
+        if name == "sequence":
+            return self._tf_sequence(rel)
+        if name == "exclude_columns":
+            return self._tf_exclude_columns(rel)
+        # connector-provided table functions (searched over catalogs)
+        for cat in self.metadata.catalogs.names():
+            conn = self.metadata.catalogs.get(cat)
+            tf = (conn.table_functions() or {}).get(name)
+            if tf is None:
+                continue
+            scalars = [
+                self._const_scalar(a, name)
+                for kind, a in rel.args if kind == "scalar"
+            ]
+            schema, rows = tf(*scalars)
+            syms, fields, types = [], [], []
+            for col, t in schema:
+                sym = self.symbols.new(col)
+                syms.append(sym)
+                types.append((sym, t))
+                fields.append(Field(rel.alias, col, sym, t))
+            return RelationPlan(
+                P.Values(tuple(syms), tuple(types),
+                         tuple(tuple(r) for r in rows)),
+                Scope(fields),
+            )
+        raise SemanticError(f"unknown table function: {name}")
+
+    def _const_scalar(self, e: ast.Node, what: str) -> object:
+        v = self._analyze_standalone(e)
+        if not isinstance(v, ir.Constant):
+            raise SemanticError(
+                f"table function {what} requires constant arguments"
+            )
+        return v.value
+
+    def _analyze_standalone(self, e: ast.Node):
+        dummy = RelationPlan(P.Values((), (), ()), Scope([]))
+        return ExprAnalyzer(self, dummy).analyze(e)
+
+    def _tf_sequence(self, rel) -> RelationPlan:
+        """TABLE(sequence(start, stop [, step])) -> one bigint column
+        `sequential_number` (io.trino.operator.table.Sequence)."""
+        scalars = [a for kind, a in rel.args if kind == "scalar"]
+        if len(scalars) not in (2, 3):
+            raise SemanticError("sequence(start, stop [, step])")
+        vals = [self._const_scalar(a, "sequence") for a in scalars]
+        start, stop = int(vals[0]), int(vals[1])
+        step = int(vals[2]) if len(vals) == 3 else 1
+        if step == 0:
+            raise SemanticError("sequence step cannot be zero")
+        n = max(0, (stop - start) // step + 1)
+        if n > 1_000_000:
+            raise SemanticError("sequence result exceeds 1,000,000 rows")
+        sym = self.symbols.new("sequential_number")
+        col = rel.columns[0] if rel.columns else "sequential_number"
+        return RelationPlan(
+            P.Values(
+                (sym,), ((sym, T.BIGINT),),
+                tuple((start + i * step,) for i in range(n)),
+            ),
+            Scope([Field(rel.alias, col.lower(), sym, T.BIGINT)]),
+        )
+
+    def _tf_exclude_columns(self, rel) -> RelationPlan:
+        """TABLE(exclude_columns(TABLE(t), DESCRIPTOR(a, b))) — passes the
+        input through minus the descriptor columns
+        (io.trino.operator.table.ExcludeColumns)."""
+        tables = [a for kind, a in rel.args if kind == "table"]
+        descs = [a for kind, a in rel.args if kind == "descriptor"]
+        if len(tables) != 1 or len(descs) != 1:
+            raise SemanticError(
+                "exclude_columns(TABLE(t), DESCRIPTOR(col, ...))"
+            )
+        inp = self.plan_relation(tables[0])
+        drop = {c.lower() for c in descs[0]}
+        fields = [f for f in inp.scope.fields if f.name not in drop]
+        if len(fields) == len(inp.scope.fields):
+            missing = drop - {f.name for f in inp.scope.fields}
+            if missing:
+                raise SemanticError(
+                    f"exclude_columns: unknown columns {sorted(missing)}"
+                )
+        if not fields:
+            raise SemanticError("exclude_columns would drop every column")
+        if rel.alias:
+            fields = [
+                Field(rel.alias, f.name, f.symbol, f.type) for f in fields
+            ]
+        return RelationPlan(inp.root, Scope(fields))
 
     def _plan_match_recognize(self, mr: ast.MatchRecognize) -> RelationPlan:
         """MATCH_RECOGNIZE -> P.MatchRecognize (PatternRecognitionNode):

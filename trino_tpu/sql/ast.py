@@ -222,6 +222,20 @@ class Table(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class TableFunctionRelation(Node):
+    """FROM TABLE(fn(arg, ...)) — polymorphic table function invocation
+    (spi/function/table + operator/table/TableFunctionOperator)."""
+
+    name: str
+    # each arg: ("scalar", expr) | ("table", relation) |
+    #           ("descriptor", (col, ...)); optional `name =>` prefixes
+    # are resolved positionally
+    args: Tuple[Tuple[str, object], ...]
+    alias: Optional[str] = None
+    columns: Optional[Tuple[str, ...]] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class SubqueryRelation(Node):
     query: "Query"
     alias: Optional[str] = None

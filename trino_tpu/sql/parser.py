@@ -824,6 +824,59 @@ class Parser:
             return items[0]
         return ast.PatternTerm("group", items=tuple(items))
 
+    def _table_function(self) -> ast.Node:
+        """TABLE(fn(arg [, ...])) with scalar, TABLE(rel) and
+        DESCRIPTOR(col, ...) arguments; `name =>` prefixes accepted."""
+        self.next()  # TABLE
+        self.expect_op("(")
+        fn = self.ident().lower()
+        self.expect_op("(")
+        args = []
+        if not (self.peek().kind == "op" and self.peek().text == ")"):
+            while True:
+                # optional named-argument prefix
+                if (self.peek().kind == "ident"
+                        and self.peek(1).kind == "op"
+                        and self.peek(1).text == "=>"):
+                    self.next()
+                    self.next()
+                t = self.peek()
+                low = t.text.lower() if t.kind in ("ident", "kw") else ""
+                if low == "table" and self.peek(1).text == "(":
+                    self.next()
+                    self.expect_op("(")
+                    rel = self.parse_relation()
+                    self.expect_op(")")
+                    args.append(("table", rel))
+                elif low == "descriptor" and self.peek(1).text == "(":
+                    self.next()
+                    self.expect_op("(")
+                    cols = [self.ident()]
+                    while self.accept_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    args.append(("descriptor", tuple(cols)))
+                else:
+                    args.append(("scalar", self.expr()))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        self.expect_op(")")
+        alias = None
+        cols = None
+        if self.accept_kw("as"):
+            alias = self.ident()
+        elif self.peek().kind == "ident":
+            alias = self.next().text
+        if alias is not None and self.accept_op("("):
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+        return ast.TableFunctionRelation(
+            fn, tuple(args), alias, tuple(cols) if cols else None
+        )
+
     def _sample_clause(self):
         t2 = self.next()
         if t2.kind != "ident" or t2.text.lower() not in (
@@ -840,6 +893,9 @@ class Parser:
 
     def relation_primary(self) -> ast.Node:
         t = self.peek()
+        if (t.kind in ("ident", "kw") and t.text.lower() == "table"
+                and self.peek(1).kind == "op" and self.peek(1).text == "("):
+            return self._table_function()
         if (t.kind == "ident" and t.text.lower() == "unnest"
                 and self.peek(1).kind == "op" and self.peek(1).text == "("):
             self.next()
