@@ -85,3 +85,49 @@ def test_web_ui_served(server):
     assert "trino-tpu" in body and "/v1/query" in body
     with urllib.request.urlopen(f"{server.uri}/") as r:
         assert "trino-tpu" in r.read().decode()
+
+
+def test_jwt_bearer_authentication():
+    """HS256 JWT bearer tokens authenticate the statement protocol
+    (server/security jwt analog): valid token in, expired/garbage out."""
+    import time
+    import urllib.error
+    import urllib.request
+
+    from trino_tpu.security import JwtAuthenticator
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.client.client import StatementClient
+
+    auth = JwtAuthenticator("secret-key", audience="trino")
+    srv = CoordinatorServer(
+        tpch_session(0.001), authenticator=auth
+    ).start()
+    try:
+        token = auth.sign(
+            {"sub": "alice", "aud": "trino", "exp": time.time() + 60}
+        )
+
+        def post(tok):
+            req = urllib.request.Request(
+                f"{srv.uri}/v1/statement",
+                data=b"select count(*) from nation",
+                headers={"Authorization": f"Bearer {tok}"},
+            )
+            return urllib.request.urlopen(req, timeout=10.0).status
+
+        assert post(token) == 200
+        expired = auth.sign(
+            {"sub": "alice", "aud": "trino", "exp": time.time() - 5}
+        )
+        for bad in (expired, "garbage.token.sig",
+                    auth.sign({"aud": "trino", "exp": time.time() + 60}),
+                    JwtAuthenticator("wrong").sign(
+                        {"sub": "eve", "aud": "trino"})):
+            try:
+                post(bad)
+                assert False, f"token accepted: {bad[:20]}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 401
+                assert "Bearer" in e.headers.get("WWW-Authenticate", "")
+    finally:
+        srv.stop()

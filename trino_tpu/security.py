@@ -170,6 +170,84 @@ class AccessControlManager(AccessControl):
         self._all("check_can_set_session", identity, name)
 
 
+class JwtAuthenticator:
+    """Bearer-token (JWT) authentication — server/security/jwt analog.
+
+    Validates HS256-signed JWTs with the standard compact serialization
+    (header.payload.signature, base64url), checking the signature against
+    the shared secret, `exp` expiry, and optional required audience; the
+    identity comes from the principal-field claim (default `sub`).  RS256
+    public-key verification is out of scope (stdlib-only build)."""
+
+    def __init__(self, secret: str, principal_field: str = "sub",
+                 audience: Optional[str] = None):
+        self.secret = secret.encode()
+        self.principal_field = principal_field
+        self.audience = audience
+
+    @staticmethod
+    def _b64url_decode(s: str) -> bytes:
+        import base64
+
+        pad = "=" * (-len(s) % 4)
+        return base64.urlsafe_b64decode(s + pad)
+
+    @staticmethod
+    def _b64url_encode(b: bytes) -> str:
+        import base64
+
+        return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+    def sign(self, claims: Dict[str, object]) -> str:
+        """Mint a token (testing + internal cluster communication)."""
+        import hmac
+        import json as _json
+
+        header = self._b64url_encode(
+            _json.dumps({"alg": "HS256", "typ": "JWT"}).encode()
+        )
+        payload = self._b64url_encode(_json.dumps(claims).encode())
+        msg = f"{header}.{payload}".encode()
+        sig = self._b64url_encode(
+            hmac.new(self.secret, msg, hashlib.sha256).digest()
+        )
+        return f"{header}.{payload}.{sig}"
+
+    def authenticate_token(self, token: str) -> Identity:
+        import hmac
+        import json as _json
+        import time as _time
+
+        try:
+            header_s, payload_s, sig_s = token.split(".")
+            header = _json.loads(self._b64url_decode(header_s))
+            if header.get("alg") != "HS256":
+                raise ValueError(f"unsupported alg {header.get('alg')}")
+            msg = f"{header_s}.{payload_s}".encode()
+            want = hmac.new(self.secret, msg, hashlib.sha256).digest()
+            if not hmac.compare_digest(want, self._b64url_decode(sig_s)):
+                raise ValueError("bad signature")
+            claims = _json.loads(self._b64url_decode(payload_s))
+        except AccessDeniedError:
+            raise
+        except Exception as e:
+            raise AccessDeniedError(f"Access Denied: invalid JWT ({e})")
+        exp = claims.get("exp")
+        if exp is not None and _time.time() > float(exp):
+            raise AccessDeniedError("Access Denied: token expired")
+        if self.audience is not None:
+            aud = claims.get("aud")
+            auds = aud if isinstance(aud, list) else [aud]
+            if self.audience not in auds:
+                raise AccessDeniedError("Access Denied: wrong audience")
+        principal = claims.get(self.principal_field)
+        if not principal:
+            raise AccessDeniedError(
+                f"Access Denied: missing {self.principal_field} claim"
+            )
+        return Identity(str(principal))
+
+
 class PasswordAuthenticator:
     """Password-file authentication (plugin/trino-password-authenticators
     PasswordStore): users map to salted sha256 digests; authenticate()

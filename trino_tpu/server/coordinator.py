@@ -147,6 +147,14 @@ class Coordinator:
                         props.get("query_max_memory_bytes"),
                     "spill_enabled": props.get("spill_enabled"),
                     "dynamic_filtering": props.get("dynamic_filtering"),
+                    "speculative_execution":
+                        props.get("speculative_execution"),
+                    "fte_max_attempts": props.get("fte_max_attempts"),
+                    "fte_task_timeout_s": props.get("fte_task_timeout_s"),
+                    "fte_speculation_factor":
+                        props.get("fte_speculation_factor"),
+                    "fte_speculation_min_s":
+                        props.get("fte_speculation_min_s"),
                 }
                 if props.get("retry_policy") == "task":
                     from .fte import FaultTolerantScheduler
@@ -237,7 +245,7 @@ class _Handler(BaseHTTPRequestHandler):
         import base64
 
         header = self.headers.get("Authorization", "")
-        if header.startswith("Basic "):
+        if header.startswith("Basic ") and hasattr(auth, "authenticate"):
             try:
                 decoded = base64.b64decode(header[6:]).decode()
                 u, _, pw = decoded.partition(":")
@@ -245,8 +253,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return u
             except Exception:
                 pass
+        if header.startswith("Bearer ") and hasattr(
+            auth, "authenticate_token"
+        ):
+            try:
+                return auth.authenticate_token(header[7:]).user
+            except Exception:
+                pass
+        scheme = (
+            "Bearer" if hasattr(auth, "authenticate_token") else "Basic"
+        )
         self.send_response(401)
-        self.send_header("WWW-Authenticate", "Basic realm=\"trino-tpu\"")
+        self.send_header(
+            "WWW-Authenticate", f'{scheme} realm="trino-tpu"'
+        )
         self.send_header("Content-Length", "0")
         self.end_headers()
         return None
