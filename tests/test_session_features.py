@@ -289,3 +289,38 @@ def test_round2_session_properties_wired():
 
     # results identical either way
     assert on.execute(sql).to_pylist() == off.execute(sql).to_pylist()
+
+
+def test_per_catalog_session_properties():
+    """SET SESSION <catalog>.<name> routes to the connector's declared
+    property metadata (per-catalog session properties SPI)."""
+    import pytest as _pytest
+
+    from trino_tpu.session import tpch_session
+
+    s = tpch_session(0.01)
+    conn = s.catalogs.get("tpch")
+    sm = conn.split_manager()
+    many = len(sm.get_splits("orders", 64))
+    s.execute("set session tpch.rows_per_split = 100000")
+    few = len(conn.split_manager().get_splits("orders", 64))
+    assert few < many  # bigger splits -> fewer of them
+    # validation: unknown names fail loudly
+    with _pytest.raises(Exception):
+        s.execute("set session tpch.nonsense = 1")
+    with _pytest.raises(Exception):
+        s.execute("set session nosuchcatalog.rows_per_split = 1")
+
+
+def test_catalog_property_validation_and_show():
+    import pytest as _pytest
+
+    from trino_tpu.session import tpch_session
+
+    s = tpch_session(0.01)
+    with _pytest.raises(Exception):
+        s.execute("set session tpch.rows_per_split = 0")
+    s.execute("set session tpch.rows_per_split = 2048")
+    rows = s.execute("show session").to_pylist()
+    by_name = {r[0]: r[1] for r in rows}
+    assert by_name.get("tpch.rows_per_split") == "2048"

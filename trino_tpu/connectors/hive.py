@@ -205,11 +205,21 @@ class HiveSplitManager(SplitManager):
     cannot satisfy the pushed-down constraint are pruned here — the
     engine-side analog of lib/trino-parquet predicate/ row-group pruning."""
 
-    def __init__(self, metadata: HiveMetadata):
+    def __init__(self, metadata: HiveMetadata, connector=None):
         self.meta = metadata
+        self.connector = connector
+
+    def _pruning_enabled(self) -> bool:
+        if self.connector is None:
+            return True
+        return bool(
+            self.connector.get_session_property("row_group_pruning")
+        )
 
     def get_splits(self, table, desired, constraint=None) -> List[Split]:
         _require_pyarrow()
+        if not self._pruning_enabled():
+            constraint = None
         files = self.meta._files(table)
         if HiveMetadata._format_of(files[0]) != "parquet":
             # ORC/CSV/JSON: one split per file (no engine-side footer
@@ -366,7 +376,18 @@ class HiveConnector(Connector):
         return self._metadata
 
     def split_manager(self) -> HiveSplitManager:
-        return HiveSplitManager(self._metadata)
+        return HiveSplitManager(self._metadata, self)
+
+    def session_property_metadata(self):
+        from ..config import PropertyMetadata, _bool
+
+        return {
+            "row_group_pruning": PropertyMetadata(
+                "row_group_pruning",
+                "prune parquet row groups from footer min/max stats",
+                _bool, True,
+            ),
+        }
 
     def page_source_provider(self) -> HivePageSourceProvider:
         return HivePageSourceProvider()

@@ -701,14 +701,21 @@ class TpchMetadata(ConnectorMetadata):
 class TpchSplitManager(SplitManager):
     """Reference: TpchSplitManager.java:40 — nodes x splitsPerNode."""
 
-    def __init__(self, sf: float):
+    def __init__(self, sf: float, connector=None):
         self.sf = sf
+        self.connector = connector
 
     def get_splits(self, table: str, desired: int, constraint=None) -> List[Split]:
         n = _counts(self.sf)["orders" if table == "lineitem" else table]
-        # honor the engine's desired parallelism down to 512-row splits so
-        # multi-node tests exercise real split distribution at tiny SF
-        k = max(1, min(desired, (n + 511) // 512))
+        # honor the engine's desired parallelism down to rows-per-split
+        # granularity so multi-node tests exercise real split distribution
+        # at tiny SF (SET SESSION <catalog>.rows-per-split overrides)
+        rows = 512
+        if self.connector is not None:
+            rows = int(
+                self.connector.get_session_property("rows_per_split")
+            )
+        k = max(1, min(desired, (n + rows - 1) // rows))
         return [Split(table, i, k, {"sf": self.sf}) for i in range(k)]
 
 
@@ -759,7 +766,18 @@ class TpchConnector(Connector):
         return TpchMetadata(self.sf)
 
     def split_manager(self):
-        return TpchSplitManager(self.sf)
+        return TpchSplitManager(self.sf, self)
+
+    def session_property_metadata(self):
+        from ..config import PropertyMetadata
+
+        return {
+            "rows_per_split": PropertyMetadata(
+                "rows_per_split",
+                "split granularity for the generator connector",
+                int, 512,
+            ),
+        }
 
     def page_source_provider(self):
         return TpchPageSourceProvider(self.sf)

@@ -204,10 +204,39 @@ class Session:
             self._capacity_hints.clear()
         if isinstance(stmt, ast.SetSession):
             self.access_control.check_can_set_session(identity, stmt.name)
-            self.properties.set(stmt.name, stmt.value)
+            if "." in stmt.name:
+                # per-catalog session property (SET SESSION catalog.name):
+                # validated against the connector's declared metadata
+                cat, _, prop = stmt.name.partition(".")
+                conn = self.catalogs.get(cat)
+                meta = conn.session_property_metadata().get(prop)
+                if meta is None:
+                    raise KeyError(
+                        f"unknown catalog session property: {stmt.name}"
+                    )
+                value = (
+                    meta.parse(stmt.value)
+                    if isinstance(stmt.value, str) else stmt.value
+                )
+                conn.set_session_property(prop, value)
+            else:
+                self.properties.set(stmt.name, stmt.value)
             return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
         if isinstance(stmt, ast.ShowSession):
-            rows = self.properties.show()
+            rows = list(self.properties.show())
+            # per-catalog session properties (Trino's SHOW SESSION lists
+            # catalog properties alongside system ones)
+            for cat in self.catalogs.names():
+                conn = self.catalogs.get(cat)
+                for name, meta in sorted(
+                    conn.session_property_metadata().items()
+                ):
+                    rows.append((
+                        f"{cat}.{name}",
+                        str(conn.get_session_property(name)),
+                        str(meta.default),
+                        meta.description,
+                    ))
             return page_from_pydict(
                 [("name", T.VARCHAR), ("value", T.VARCHAR),
                  ("default", T.VARCHAR), ("description", T.VARCHAR)],

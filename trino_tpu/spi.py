@@ -157,6 +157,36 @@ class Connector:
         """Monotonic change counter for cache invalidation."""
         return 0
 
+    def session_property_metadata(self) -> dict:
+        """Per-catalog session properties this connector understands
+        (spi/session PropertyMetadata via Connector
+        .getSessionProperties): name -> config.PropertyMetadata.
+        SET SESSION <catalog>.<name> = value routes here."""
+        return {}
+
+    def set_session_property(self, name: str, value) -> None:
+        """Apply a validated per-catalog session property (the
+        ConnectorSession property bag; sessions own their
+        CatalogManager, so connector instances are session-scoped)."""
+        meta = self.session_property_metadata().get(name)
+        if meta is not None and meta.parse is int and int(value) <= 0:
+            raise ValueError(
+                f"catalog session property {name} must be positive"
+            )
+        if not hasattr(self, "session_props"):
+            self.session_props = {}
+        self.session_props[name] = value
+
+    def get_session_property(self, name: str):
+        """Current value of a per-catalog session property, falling
+        back to its declared metadata default — the single read path
+        (no duplicated defaults at call sites)."""
+        props = getattr(self, "session_props", {})
+        if name in props:
+            return props[name]
+        meta = self.session_property_metadata().get(name)
+        return meta.default if meta is not None else None
+
     def table_functions(self) -> dict:
         """Connector-provided polymorphic table functions
         (spi/function/table ConnectorTableFunction seam): name ->

@@ -204,6 +204,8 @@ class Parser:
         if self.accept_kw("set"):
             self.expect_kw("session")
             name = self.ident()
+            while self.accept_op("."):  # catalog.property form
+                name += "." + self.ident()
             self.expect_op("=")
             t = self.next()
             if t.kind == "string":
