@@ -177,3 +177,28 @@ def test_partitioned_join_distributed(oracle_conn):
             assert_rows_match(actual, expected, tol=1e-2, ordered=True)
     finally:
         r.stop()
+
+
+def test_union_all_arbitrary_distribution(runner, oracle_conn):
+    """Distributed UNION ALL redistributes round-robin (FIXED_ARBITRARY /
+    RandomExchange) instead of gathering to one task."""
+    from trino_tpu.plan.fragment import fragment_plan
+
+    sql = (
+        "select o_orderpriority p, count(*) c from ("
+        "select o_orderpriority from orders where o_orderkey % 2 = 0 "
+        "union all "
+        "select o_orderpriority from orders where o_orderkey % 2 = 1"
+        ") t group by o_orderpriority order by p"
+    )
+    plan = runner.session.plan(sql)
+    frags = fragment_plan(plan)
+    assert any(f.partitioning == "arbitrary" for f in frags), [
+        (f.id, f.partitioning) for f in frags
+    ]
+    actual = runner.rows(sql)
+    expected = oracle_conn.execute(
+        "select o_orderpriority p, count(*) c from orders "
+        "group by o_orderpriority order by p"
+    ).fetchall()
+    assert_rows_match(actual, expected, tol=1e-9, ordered=True)

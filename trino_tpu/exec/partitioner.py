@@ -87,6 +87,15 @@ def partition_page(page: Page, keys: Sequence[str], nparts: int) -> List[Page]:
     return [take_rows(page, np.nonzero(part == p)[0]) for p in range(nparts)]
 
 
+def partition_page_round_robin(page: Page, nparts: int) -> List[Page]:
+    """Split a page into nparts pages row-round-robin (RandomExchanger /
+    FIXED_ARBITRARY_DISTRIBUTION): balances load with no key affinity."""
+    if nparts == 1:
+        return [page]
+    idx = np.arange(page.count)
+    return [take_rows(page, idx[p::nparts]) for p in range(nparts)]
+
+
 def chunk_page(page: Page, rows_per_chunk: int = 65536) -> List[Page]:
     """Split a page into bounded-size wire chunks (output buffer frames)."""
     if page.count <= rows_per_chunk:

@@ -34,6 +34,9 @@ SOURCE = "source"
 SINGLE = "single"
 HASH = "hash"
 BROADCAST = "broadcast"
+# load-balancing redistribution with no key affinity
+# (FIXED_ARBITRARY_DISTRIBUTION / RandomExchange)
+ARBITRARY = "arbitrary"
 
 
 @dataclasses.dataclass
@@ -327,10 +330,30 @@ class Fragmenter:
 
     # -- set operations ---------------------------------------------------
     def _do_setoperation(self, node: P.SetOperation):
+        rewritten = [self._rewrite(i) for i in node.inputs]
+        if (
+            node.kind == "union"
+            and node.all
+            and any(part != SINGLE for _, part, _ in rewritten)
+        ):
+            # distributed UNION ALL: each input redistributes round-robin
+            # (FIXED_ARBITRARY / RandomExchange) so the union stage stays
+            # parallel instead of gathering to one task
+            inputs = tuple(
+                self._cut(srcn, part, keys, ARBITRARY)
+                if part != SINGLE
+                else srcn
+                for srcn, part, keys in rewritten
+            )
+            return (
+                P.SetOperation(node.kind, node.all, inputs, node.symbols,
+                               node.types_),
+                ARBITRARY,
+                (),
+            )
         inputs = []
-        for i in node.inputs:
-            src, part, keys = self._rewrite(i)
-            inputs.append(self._gather(src, part, keys))
+        for srcn, part, keys in rewritten:
+            inputs.append(self._gather(srcn, part, keys))
         return (
             P.SetOperation(node.kind, node.all, tuple(inputs), node.symbols,
                            node.types_),

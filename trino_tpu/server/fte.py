@@ -28,7 +28,14 @@ from ..exchange.filesystem import FileSystemExchangeManager, read_spool_pages
 from ..exec.partitioner import concat_pages
 from ..page import Page
 from ..plan import nodes as P
-from ..plan.fragment import HASH, SINGLE, SOURCE, PlanFragment, fragment_plan
+from ..plan.fragment import (
+    ARBITRARY,
+    HASH,
+    SINGLE,
+    SOURCE,
+    PlanFragment,
+    fragment_plan,
+)
 from ..serde import encode_value, plan_to_json
 from .scheduler import (
     SchedulerError,
@@ -100,7 +107,11 @@ class FaultTolerantScheduler:
         if not cluster:
             raise SchedulerError("NO_NODES_AVAILABLE: no alive workers")
         for f in fragments:
-            width[f.id] = len(cluster) if f.partitioning in (SOURCE, HASH) else 1
+            width[f.id] = (
+                len(cluster)
+                if f.partitioning in (SOURCE, HASH, ARBITRARY)
+                else 1
+            )
 
         # committed spool dirs: fragment -> [task_index -> SpoolHandle path]
         committed: Dict[int, List[str]] = {}
@@ -167,7 +178,9 @@ class FaultTolerantScheduler:
     ) -> List[str]:
         ntasks = width[f.id]
         out_buffers = (
-            width[consumer[f.id]] if f.output_partitioning == HASH else 1
+            width[consumer[f.id]]
+            if f.output_partitioning in (HASH, ARBITRARY)
+            else 1
         )
         per_task_splits = assign_splits(self.catalogs, f, ntasks)
         frag_json = plan_to_json(f.root)

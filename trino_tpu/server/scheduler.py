@@ -30,6 +30,7 @@ from ..exec.partitioner import concat_pages
 from ..page import Page
 from ..plan import nodes as P
 from ..plan.fragment import (
+    ARBITRARY,
     BROADCAST,
     HASH,
     SINGLE,
@@ -85,9 +86,13 @@ def assign_splits(
 
 
 def source_buffer_index(src_frag: PlanFragment, task_index: int) -> int:
-    """Which producer buffer a consumer task reads: its own index for hash
-    repartitioning, buffer 0 for single/broadcast output."""
-    return task_index if src_frag.output_partitioning == HASH else 0
+    """Which producer buffer a consumer task reads: its own index for
+    hash/arbitrary repartitioning, buffer 0 for single/broadcast."""
+    return (
+        task_index
+        if src_frag.output_partitioning in (HASH, ARBITRARY)
+        else 0
+    )
 
 
 class DistributedScheduler:
@@ -119,7 +124,7 @@ class DistributedScheduler:
         ntasks: Dict[int, int] = {}
         placement: Dict[int, List[Tuple[str, str]]] = {}
         for f in fragments:
-            if f.partitioning in (SOURCE, HASH):
+            if f.partitioning in (SOURCE, HASH, ARBITRARY):
                 placement[f.id] = list(self.workers)
             else:  # SINGLE; spread roots of different queries via hash
                 w = self.workers[hash(query_id) % len(self.workers)]
@@ -129,7 +134,7 @@ class DistributedScheduler:
         # buffer counts: hash output -> one buffer per consumer task
         nbuffers: Dict[int, int] = {}
         for f in fragments:
-            if f.output_partitioning == HASH:
+            if f.output_partitioning in (HASH, ARBITRARY):
                 nbuffers[f.id] = ntasks[consumer[f.id]]
             else:
                 nbuffers[f.id] = 1

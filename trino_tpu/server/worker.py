@@ -32,7 +32,11 @@ from typing import Dict, List, Optional
 from ..catalog import CatalogManager
 from ..exec.exchange_client import ExchangeClient, RemoteTaskError
 from ..exec.fragment_exec import FragmentExecutor
-from ..exec.partitioner import chunk_page, partition_page
+from ..exec.partitioner import (
+    chunk_page,
+    partition_page,
+    partition_page_round_robin,
+)
 from ..page import Page
 from ..serde import decode_value, plan_from_json, serialize_page
 from ..spi import Split
@@ -152,6 +156,10 @@ class TaskManager:
             keys = list(out.get("keys") or [])
             if part == "hash" and nbuffers > 1:
                 parts = partition_page(page, keys, nbuffers)
+            elif part == "arbitrary" and nbuffers > 1:
+                # round-robin redistribution (RandomExchanger /
+                # ArbitraryOutputBuffer): no key affinity, pure balance
+                parts = partition_page_round_robin(page, nbuffers)
             else:
                 # single and broadcast: everything in buffer 0 (broadcast
                 # consumers all read buffer 0 — BroadcastOutputBuffer)
