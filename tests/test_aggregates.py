@@ -413,3 +413,30 @@ def test_array_map_listagg(session, oracle_conn):
         "select o_totalprice from orders where o_orderkey < 7"
     )]
     assert sorted(got[0][0]) == sorted(round(v, 2) for v in exact)
+
+
+def test_sum_overflow_fails_loudly():
+    """int64 sum accumulators must never wrap silently: pending
+    decimal(38) storage, an overflowing sum raises."""
+    import pytest as _pytest
+
+    from trino_tpu.session import Session
+
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (g bigint, v bigint)")
+    s.execute(
+        "insert into t values (1, 5000000000000000000), "
+        "(1, 5000000000000000000), (1, 5000000000000000000)"
+    )
+    with _pytest.raises(Exception, match="overflow"):
+        s.execute("select g, sum(v) from t group by g")
+    with _pytest.raises(Exception, match="overflow"):
+        s.execute("select sum(v) from t")
+    # near-but-under the bound is fine
+    s.execute("create table ok_t (v bigint)")
+    s.execute("insert into ok_t values (2000000000000000000), "
+              "(1000000000000000000)")
+    assert s.execute("select sum(v) from ok_t").to_pylist() == [
+        (3000000000000000000,)
+    ]

@@ -288,23 +288,24 @@ class LocalExecutor:
             for attempt in range(7):
                 if use_jit:
                     (out_lanes, sel, ordered, checks, dups, colls,
-                     wides) = self._run_jitted(plan, scans, counts)
+                     wides, sflags) = self._run_jitted(plan, scans, counts)
                 else:
                     ctx = self.trace_ctx_cls(self, scans, counts)
                     out_lanes, sel, ordered, checks = self._run(plan, ctx)
                     dups = ctx.dup_checks
                     colls = ctx.collision_checks
                     wides = ctx.lowering.overflow_flags
+                    sflags = ctx.sum_overflow
                 # ONE round trip for all control scalars AND the output
                 # lanes (the accelerator may sit behind a high-latency
                 # tunnel: each device_get costs an RTT; on the rare
                 # retry the prefetched outputs are simply discarded)
                 try:
                     (dup_vals, check_vals, coll_vals, wide_vals,
-                     host_lanes, sel_np) = jax.device_get(
+                     sflag_vals, host_lanes, sel_np) = jax.device_get(
                         ([d for _, d in dups],
                          [ng for ng, _, _ in checks],
-                         list(colls), list(wides),
+                         list(colls), list(wides), list(sflags),
                          {s: out_lanes[s] for s in plan.symbols}, sel)
                     )
                 except jax.errors.JaxRuntimeError as e:
@@ -353,6 +354,17 @@ class LocalExecutor:
                     if int(ngroups) > cap:
                         over_kinds.add(kind)
                 if not over_kinds:
+                    # only a settled attempt may raise: a capacity overflow
+                    # or collision retry piles unrelated groups into one
+                    # segment, making the shadow flag spurious
+                    for sv in sflag_vals:
+                        if int(sv) > 0:
+                            raise ExecutionError(
+                                "sum overflows the 18-digit decimal/"
+                                "bigint accumulator (decimal(38) storage "
+                                "is not implemented yet); rewrite with a "
+                                "smaller scale or pre-aggregate"
+                            )
                     break
                 if "group" in over_kinds:
                     self.group_capacity *= 8
@@ -702,6 +714,7 @@ class LocalExecutor:
                     tuple(d for _, d in ctx.dup_checks),
                     tuple(ctx.collision_checks),
                     tuple(ctx.lowering.overflow_flags),
+                    tuple(ctx.sum_overflow),
                 )
 
             fn = jax.jit(raw)
@@ -716,13 +729,14 @@ class LocalExecutor:
             # execute() loop's device_get, whose handler retries only
             # INVALID_ARGUMENT (never OOM) with a bounded recompile count
             out = entry["fn"](prep)
-        out_lanes, sel, ngroups, dup_vals, colls, wides = out
+        out_lanes, sel, ngroups, dup_vals, colls, wides, sflags = out
         checks = [
             (ng, cap, kind)
             for ng, (cap, kind) in zip(ngroups, cell["caps"])
         ]
         dups = list(zip(cell["dup_nodes"], dup_vals))
-        return out_lanes, sel, cell["ordered"], checks, dups, colls, wides
+        return (out_lanes, sel, cell["ordered"], checks, dups, colls,
+                wides, sflags)
 
     # ------------------------------------------------------------------
     def _run(self, plan: P.Output, ctx: "_TraceCtx"):
@@ -764,6 +778,9 @@ class _TraceCtx:
         self.capacity_checks: List[Tuple[jnp.ndarray, int]] = []
         self.dup_checks: List[Tuple[P.PlanNode, jnp.ndarray]] = []
         self.collision_checks: List[jnp.ndarray] = []
+        # int64 sum-accumulator overflow flags (no decimal(38) storage
+        # yet: wrap -> loud ExecutionError, never silent wrong sums)
+        self.sum_overflow: List[jnp.ndarray] = []
         self.lowering = LoweringContext(ex.dicts)
         self.lowering.force_wide_mul = getattr(ex, 'force_wide_mul', False)
 
@@ -1122,10 +1139,14 @@ class _TraceCtx:
                 acc_in = {
                     n: lanes[n] for s in specs for n in s.accumulator_names
                 }
-                return agg_ops.merge_accumulators(specs, acc_in, gid, sel, cap)
+                return agg_ops.merge_accumulators(
+                    specs, acc_in, gid, sel, cap,
+                    overflow_flags=self.sum_overflow,
+                )
             return agg_ops.accumulate(
                 specs, lanes, gid, sel, cap,
                 step="partial" if partial else "single",
+                overflow_flags=self.sum_overflow,
             )
 
         def out_lanes(accs):

@@ -84,6 +84,48 @@ SKETCHED_KINDS = ("approx_distinct", "approx_percentile")
 TWO_ARG_KINDS = ("min_by", "max_by") + BINARY_MOMENT_KINDS
 
 
+def _sum_overflow_flag(vv, gid, cap):
+    """int64 accumulators wrap silently; this flags any per-group sum
+    whose magnitude approaches the wrap point so the query FAILS LOUDLY
+    until decimal(38) storage exists.  Two stages so the safe common case
+    is ~free: a scalar sum(|v|) gate (an upper bound on EVERY group's
+    |sum|), and only when it fires, a per-group f64 shadow under lax.cond
+    (compiled both ways, executed only on the hot side; f64 error
+    ~1e-16*n cannot confuse 9.0e18 with the 9.22e18 wrap point)."""
+    gate = (
+        jnp.sum(jnp.abs(vv).astype(jnp.float64)) > 9.0e18
+    )
+
+    def precise():
+        shadow = _seg_sum(vv.astype(jnp.float64), gid, cap)
+        return jnp.sum(jnp.abs(shadow) > 9.0e18).astype(jnp.int64)
+
+    return jax.lax.cond(
+        gate, precise, lambda: jnp.zeros((), dtype=jnp.int64)
+    )
+
+
+def _merge_overflow_check(vals, w, gid, cap, overflow_flags):
+    """Shadow re-merge of partial int sums: flags a FINAL-side wrap
+    (partials fine per worker, total beyond int64)."""
+    if overflow_flags is None or vals.dtype.kind == "f":
+        return
+    overflow_flags.append(
+        _sum_overflow_flag(jnp.where(w, vals, 0), gid, cap)
+    )
+
+
+def _sum_could_overflow(nrows: int, input_type) -> bool:
+    """Static filter for the shadow overflow check: can nrows values
+    of this type exceed int64?  (decimal(p,s) raw values < 10^p)."""
+    digits = (
+        input_type.precision
+        if input_type is not None and input_type.is_decimal
+        else 19
+    )
+    return nrows * (10.0 ** digits) > 9.0e18
+
+
 @dataclasses.dataclass(frozen=True)
 class AggSpec:
     """One aggregate function instance (AggregatorFactory analog)."""
@@ -532,6 +574,7 @@ def accumulate(
     sel: jnp.ndarray,
     capacity: int,
     step: str = "single",
+    overflow_flags: Optional[list] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Compute accumulator arrays (shape [capacity]) per spec.
 
@@ -575,6 +618,12 @@ def accumulate(
                 vv = jnp.where(live, v.astype(jnp.int64), 0)
             ssum = _seg_sum(vv, gid, cap)
             cnt = _seg_count(live, gid, cap)
+            if (
+                v.dtype.kind != "f"
+                and overflow_flags is not None
+                and _sum_could_overflow(v.shape[0], s.input_type)
+            ):
+                overflow_flags.append(_sum_overflow_flag(vv, gid, cap))
             if s.kind == "sum":
                 out[f"{o}$val"] = ssum
                 out[f"{o}$valid"] = cnt
@@ -693,6 +742,7 @@ def merge_accumulators(
     gid: jnp.ndarray,
     sel: jnp.ndarray,
     capacity: int,
+    overflow_flags: Optional[list] = None,
 ) -> Dict[str, jnp.ndarray]:
     """FINAL step: merge partial accumulator rows grouped by gid."""
     out: Dict[str, jnp.ndarray] = {}
@@ -749,9 +799,15 @@ def merge_accumulators(
         elif s.kind == "avg":
             msum(f"{o}$sum")
             msum(f"{o}$count")
+            _merge_overflow_check(
+                acc_lanes[f"{o}$sum"][0], w, gid, cap, overflow_flags
+            )
         elif s.kind == "sum":
             msum(f"{o}$val")
             msum(f"{o}$valid")
+            _merge_overflow_check(
+                acc_lanes[f"{o}$val"][0], w, gid, cap, overflow_flags
+            )
         elif s.kind in MOMENT_KINDS:
             msum(f"{o}$sum")
             msum(f"{o}$sumsq")

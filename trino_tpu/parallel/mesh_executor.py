@@ -137,6 +137,9 @@ class MeshExecutor(LocalExecutor):
                         jax.lax.psum(w, AXIS)
                         for w in ctx.lowering.overflow_flags
                     ),
+                    tuple(
+                        jax.lax.psum(sv, AXIS) for sv in ctx.sum_overflow
+                    ),
                 )
 
             shard_fn = jax.shard_map(
@@ -146,9 +149,8 @@ class MeshExecutor(LocalExecutor):
                 out_specs=P_(),
                 check_vma=False,
             )
-            out_lanes, sel, checks, dups, colls, wides = jax.jit(shard_fn)(
-                scan_args, counts_args
-            )
+            (out_lanes, sel, checks, dups, colls, wides,
+             sflags) = jax.jit(shard_fn)(scan_args, counts_args)
             fell_back = False
             for (join_node, _), d in zip(ctx.dup_checks, dups):
                 if int(d) > 0:
@@ -171,6 +173,15 @@ class MeshExecutor(LocalExecutor):
                 if int(n) > cap:
                     over_kinds.add(kind)
             if not over_kinds:
+                # only a settled attempt may raise (capacity/collision
+                # retries make the shadow flag spurious)
+                for sv in sflags:
+                    if int(sv) > 0:
+                        raise ExecutionError(
+                            "sum overflows the 18-digit decimal/bigint "
+                            "accumulator (decimal(38) storage is not "
+                            "implemented yet)"
+                        )
                 break
             if "group" in over_kinds:
                 self.group_capacity *= 8
@@ -368,7 +379,10 @@ class _MeshTraceCtx(_TraceCtx):
 
         if not node.keys:
             gid = jnp.zeros(b.sel.shape[0], dtype=jnp.int64)
-            accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, 1)
+            accs = agg_ops.accumulate(
+                specs, b.lanes, gid, b.sel, 1,
+                overflow_flags=self.sum_overflow,
+            )
             accs = self._psum_accs(specs, accs)
             out = agg_ops.finalize(specs, accs)
             lanes = {
@@ -382,7 +396,10 @@ class _MeshTraceCtx(_TraceCtx):
         domains = self._direct_domains(node.keys, types)
         if domains is not None and psum_able:
             gid, cap = agg_ops.direct_group_ids(key_lanes, domains)
-            accs = agg_ops.accumulate(specs, b.lanes, gid, b.sel, cap)
+            accs = agg_ops.accumulate(
+                specs, b.lanes, gid, b.sel, cap,
+                overflow_flags=self.sum_overflow,
+            )
             present_local = (
                 jax.ops.segment_sum(
                     b.sel.astype(jnp.int64), gid, num_segments=cap
@@ -405,7 +422,8 @@ class _MeshTraceCtx(_TraceCtx):
                 s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
             }
             accs = agg_ops.accumulate(
-                specs, sorted_lanes, gid, sel_sorted, cap, step="partial"
+                specs, sorted_lanes, gid, sel_sorted, cap, step="partial",
+                overflow_flags=self.sum_overflow,
             )
             present_local = jnp.arange(cap) < ngroups
             keys_local = agg_ops.group_keys_output(
@@ -427,7 +445,8 @@ class _MeshTraceCtx(_TraceCtx):
                 s: (v[perm2], ok[perm2]) for s, (v, ok) in acc_lanes.items()
             }
             merged = agg_ops.merge_accumulators(
-                specs, acc_sorted, gid2, sel2, fcap
+                specs, acc_sorted, gid2, sel2, fcap,
+                overflow_flags=self.sum_overflow,
             )
             out = agg_ops.finalize(specs, merged)
             keys_out = agg_ops.group_keys_output(
@@ -461,13 +480,27 @@ class _MeshTraceCtx(_TraceCtx):
 
     def _psum_accs(self, specs, accs):
         """Cross-device accumulator merge by collective; callers must have
-        checked psum_kind != None for every accumulator first."""
+        checked psum_kind != None for every accumulator first.  int64 sum
+        accumulators get an f64 shadow psum so a cross-device wrap (each
+        shard under the threshold, total beyond int64) fails loudly."""
         out = {}
         ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
         for s in specs:
             for name in s.accumulator_names:
                 kind = s.psum_kind(name)
                 out[name] = ops[kind](accs[name], AXIS)
+                if (
+                    kind == "sum"
+                    and s.kind in ("sum", "avg")
+                    and accs[name].dtype == jnp.int64
+                    and (name.endswith("$val") or name.endswith("$sum"))
+                ):
+                    shadow = jax.lax.psum(
+                        accs[name].astype(jnp.float64), AXIS
+                    )
+                    self.sum_overflow.append(
+                        jnp.sum(jnp.abs(shadow) > 9.0e18).astype(jnp.int64)
+                    )
         return out
 
     # -- joins ----------------------------------------------------------

@@ -339,10 +339,13 @@ class Fragmenter:
             # distributed UNION ALL: each input redistributes round-robin
             # (FIXED_ARBITRARY / RandomExchange) so the union stage stays
             # parallel instead of gathering to one task
+            # EVERY input is cut (SINGLE ones too): the union stage
+            # runs one task per worker, and an inlined SINGLE subtree
+            # would be re-executed by each task, duplicating its rows —
+            # the round-robin output splits a single producer's rows
+            # across the consumer tasks instead
             inputs = tuple(
                 self._cut(srcn, part, keys, ARBITRARY)
-                if part != SINGLE
-                else srcn
                 for srcn, part, keys in rewritten
             )
             return (
