@@ -12,4 +12,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import trino_tpu
 
-trino_tpu.force_cpu(8)
+if os.environ.get("TRINO_TPU_TEST_TPU") == "1":
+    # hardware-validation mode: run single-device suites on the real
+    # TPU backend (mesh/distributed suites need 8 devices — skip them)
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+else:
+    trino_tpu.force_cpu(8)

