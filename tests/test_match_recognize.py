@@ -157,3 +157,26 @@ def test_all_rows_running_measures(session):
     # RUNNING: first row of each match has no DOWN mapped yet -> NULL;
     # two matches in A: (10,8,7) and (12,11)
     assert [r[3] for r in out] == [None, 8, 7, None, 11]
+
+
+def test_permute_pattern(session):
+    """PERMUTE(A, B) matches either ordering (expands to the alternation
+    of all permutations, lexicographic preference — SqlBase patternPermute)."""
+    from trino_tpu.session import Session
+
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table t (id bigint, v bigint)")
+    # two sequences: (10 then 20) and (20 then 10)
+    s.execute(
+        "insert into t values (1, 10), (2, 20), (3, 20), (4, 10)"
+    )
+    rows = s.execute(
+        "select * from t match_recognize ("
+        " order by id"
+        " measures a.id as aid, b.id as bid"
+        " pattern (PERMUTE(A, B))"
+        " define A as v = 10, B as v = 20"
+        ") m order by aid"
+    ).to_pylist()
+    assert rows == [(1, 2), (4, 3)]

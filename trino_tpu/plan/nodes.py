@@ -674,6 +674,17 @@ def plan_to_string(node: PlanNode, stats: Optional[dict] = None) -> str:
         extra = ""
         if isinstance(n, TableScan):
             extra = f" {n.catalog}.{n.table} {[s for s, _ in n.assignments]}"
+            if n.constraint:
+                doms = []
+                for e in n.constraint:
+                    col, lo, hi = e[0], e[1], e[2]
+                    if len(e) > 3:
+                        doms.append(f"{col} IN {list(e[3])}")
+                    else:
+                        lo_s = "-inf" if lo is None else f"{lo:g}"
+                        hi_s = "inf" if hi is None else f"{hi:g}"
+                        doms.append(f"{col}:[{lo_s},{hi_s}]")
+                extra += f" constraint({', '.join(doms)})"
         elif isinstance(n, Filter):
             extra = f" {n.predicate!r}"
         elif isinstance(n, Project):
@@ -682,6 +693,8 @@ def plan_to_string(node: PlanNode, stats: Optional[dict] = None) -> str:
             extra = f" keys={list(n.keys)} aggs={[a.output for a in n.aggs]} step={n.step}"
         elif isinstance(n, Join):
             extra = f" {n.kind} on={list(n.criteria)}"
+            if n.distribution:
+                extra += f" dist={n.distribution}"
         elif isinstance(n, (TopN,)):
             extra = f" n={n.count} keys={[k.column for k in n.keys]}"
         elif isinstance(n, Limit):

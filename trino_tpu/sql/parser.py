@@ -806,6 +806,36 @@ class Parser:
                 inner = self._pattern_alt()
                 self.expect_op(")")
                 atom = ast.PatternTerm("group", items=(inner,))
+            elif (
+                t.kind in ("ident", "kw")
+                and t.text.lower() == "permute"
+                and self.peek(1).kind == "op"
+                and self.peek(1).text == "("
+            ):
+                # PERMUTE(A, B, ...) = alternation of every ordering, in
+                # lexicographic preference order (SqlBase.g4 patternPermute
+                # -> the reference expands identically)
+                self.next()
+                self.next()
+                vars_ = [self._pattern_alt()]
+                while self.accept_op(","):
+                    vars_.append(self._pattern_alt())
+                self.expect_op(")")
+                if len(vars_) > 6:
+                    raise ParseError(
+                        "PERMUTE supports at most 6 elements "
+                        f"({len(vars_)} given: {len(vars_)}! orderings)"
+                    )
+                import itertools
+
+                branches = tuple(
+                    ast.PatternTerm("group", items=tuple(perm))
+                    for perm in itertools.permutations(vars_)
+                )
+                atom = ast.PatternTerm(
+                    "group",
+                    items=(ast.PatternTerm("alt", items=branches),),
+                )
             elif t.kind in ("ident", "kw") and t.text not in (")", "|"):
                 if t.kind == "kw" and t.text in ("define",):
                     break
