@@ -206,6 +206,18 @@ def test_sort_multi_key_desc_nulls():
     assert list(np.asarray(aok)) == [True, True, True, False]
 
 
+def test_sort_desc_int64_min():
+    """DESC must reverse via bitwise complement: -INT64_MIN wraps to
+    itself, so negation would sort INT64_MIN first instead of last."""
+    lo = np.iinfo(np.int64).min
+    hi = np.iinfo(np.int64).max
+    lanes = {"x": lane([lo, 5, -1, hi])}
+    perm = S.sort_perm([S.SortKey("x", False)], lanes, allsel(4))
+    out, _ = S.apply_perm(lanes, perm, allsel(4))
+    v, _ = out["x"]
+    assert list(np.asarray(v)) == [hi, 5, -1, lo]
+
+
 def test_topn():
     lanes = {"x": lane([5, 3, 9, 1, 7])}
     out, sel, _ = S.topn([S.SortKey("x", False)], lanes, allsel(5), 2)

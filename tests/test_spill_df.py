@@ -213,6 +213,31 @@ def test_sort_spill_varchar_dictionaries_unified():
     assert got == expected
 
 
+def test_sort_spill_desc_int64_min():
+    """Regression: the host-side merge of spilled sort runs must reverse
+    integer keys with ~v, not -v — negation wraps at INT64_MIN, which
+    would sort INT64_MIN first under DESC instead of last."""
+    from trino_tpu.exec import spill as spill_mod
+    from trino_tpu.session import tpch_session
+
+    lo, hi = -(2**63), 2**63 - 1
+    # 8 KB limit forces the spilled path for the 2000-row table
+    s = tpch_session(0.01, query_max_memory_bytes=8_000)
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table memory.default.ext (v bigint)")
+    vals = [lo, hi, 0, -1, 7] * 400
+    s.execute(
+        "insert into memory.default.ext values "
+        + ", ".join(f"({v})" for v in vals)
+    )
+    got = s.execute(
+        "select v from memory.default.ext order by v desc"
+    ).to_pylist()
+    assert [r[0] for r in got] == sorted(
+        [v for v in vals], reverse=True
+    )
+
+
 def test_sort_spill_varchar_sort_key():
     from trino_tpu.session import tpch_session
 

@@ -56,6 +56,9 @@ class StatementClient:
             nxt = doc.get("nextUri")
             if not nxt:
                 break
-            with urllib.request.urlopen(self.server + nxt) as resp:
+            poll = urllib.request.Request(
+                self.server + nxt, headers=headers
+            )
+            with urllib.request.urlopen(poll) as resp:
                 doc = json.load(resp)
         return columns, rows

@@ -433,9 +433,9 @@ def execute_spilled_sort(executor, plan, sort, scan):
         else:
             v = vals
         if not k.ascending:
-            # negate in the value domain (int64 negation for ints: float
-            # casts above 2^53 would diverge from the device sort)
-            v = -v if v.dtype.kind in ("i", "f") else ~v.astype(np.int64)
+            # ints reverse via bitwise complement (negation wraps at
+            # INT64_MIN, which would sort first under DESC); floats negate
+            v = -v if v.dtype.kind == "f" else ~v.astype(np.int64)
         lex.append(v)
         nullbit = ~oks if not k.nulls_first else oks
         lex.append(nullbit)

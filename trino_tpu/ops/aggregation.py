@@ -108,7 +108,7 @@ def _sum_overflow_flag(vv, gid, cap):
 def _merge_overflow_check(vals, w, gid, cap, overflow_flags):
     """Shadow re-merge of partial int sums: flags a FINAL-side wrap
     (partials fine per worker, total beyond int64)."""
-    if overflow_flags is None or vals.dtype.kind == "f":
+    if overflow_flags is None or jnp.issubdtype(vals.dtype, jnp.floating):
         return
     overflow_flags.append(
         _sum_overflow_flag(jnp.where(w, vals, 0), gid, cap)

@@ -54,7 +54,9 @@ def _negate_for_desc(v: jnp.ndarray) -> jnp.ndarray:
         return -v
     if v.dtype.kind == "b":
         return jnp.logical_not(v)
-    return -v.astype(jnp.int64)
+    # bitwise complement, not negation: -INT64_MIN wraps to itself and
+    # would sort first under DESC; ~v is an exact order reversal
+    return ~v.astype(jnp.int64)
 
 
 def apply_perm(

@@ -295,7 +295,28 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         parts = self.path.strip("/").split("/")
         co = self.coordinator
+        # Query texts/errors/results are sensitive: the monitor UI, the
+        # query inspection endpoints, and result fetches all require
+        # authentication whenever an authenticator is configured, like
+        # POST /v1/statement (the slug stays as a second factor).
+        if (len(parts) >= 2 and parts[:2] == ["v1", "query"]) or (
+            len(parts) >= 3 and parts[:3] == ["v1", "statement", "executing"]
+        ):
+            if self._authenticate() is None:
+                return
         if self.path in ("/", "/ui", "/ui/"):
+            # the page itself is constant HTML (data endpoints are gated
+            # above); challenge only under Basic auth, where the 401 pops
+            # the browser's credential dialog and so makes the page's
+            # same-origin fetches work — a Bearer-only 401 would just
+            # brick the monitor (browsers can't supply a token)
+            auth = co.authenticator
+            if (
+                auth is not None
+                and hasattr(auth, "authenticate")
+                and self._authenticate() is None
+            ):
+                return
             # query monitor (webapp/ React UI analog, single static page)
             from .webui import UI_HTML
 
@@ -316,6 +337,7 @@ class _Handler(BaseHTTPRequestHandler):
             })
             return
         if self.path == "/v1/status":
+            nm = co.node_manager
             self._json(200, {
                 "nodeId": co.node_id,
                 "activeQueries": sum(
@@ -323,6 +345,10 @@ class _Handler(BaseHTTPRequestHandler):
                     if q.state in ("QUEUED", "PLANNING", "RUNNING")
                 ),
                 "totalQueries": len(co.queries),
+                # the /ui header reads these (coordinator itself counts
+                # as the one executing node when no workers announced)
+                "activeWorkers": len(nm.alive()) if nm is not None else 1,
+                "uptimeSeconds": time.time() - co.started,
             })
             return
         if self.path == "/v1/resourceGroupState":
@@ -392,6 +418,14 @@ class _Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         parts = self.path.strip("/").split("/")
         if len(parts) >= 4 and parts[:3] == ["v1", "statement", "executing"]:
+            if self._authenticate() is None:
+                return
+            # the cancel URI is the nextUri path (includes the slug
+            # capability token); the slug is MANDATORY, like GET
+            q = self.coordinator.queries.get(parts[3])
+            if q is None or len(parts) < 5 or parts[4] != q.slug:
+                self._json(404, {"error": "query not found"})
+                return
             self.coordinator.cancel(parts[3])
             self._json(204, {})
         else:

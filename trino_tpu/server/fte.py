@@ -259,7 +259,11 @@ class FaultTolerantScheduler:
 
         speculate = bool(self.properties.get("speculative_execution", True))
         last_error = None
-        attempt = 0
+        # Monotonic attempt allocator: EVERY launched attempt (primary or
+        # backup, finished or not) consumes a number, so a task_id / spool
+        # dir {task}.{attempt} is never reused — a timed-out-but-running
+        # backup can never collide with a later primary.
+        next_attempt = 0
         backups: List[dict] = []  # {'done','path','duration','uri','task'}
 
         def backup_winner():
@@ -268,7 +272,9 @@ class FaultTolerantScheduler:
                     return b
             return None
 
-        while attempt < self.max_attempts:
+        while next_attempt < self.max_attempts:
+            attempt = next_attempt
+            next_attempt += 1
             try:
                 uri, task_id, sink = self._start_attempt(
                     query_id, f, task_index, attempt, frag_json, splits,
@@ -278,7 +284,6 @@ class FaultTolerantScheduler:
                 raise
             except Exception as e:
                 last_error = e
-                attempt += 1
                 continue
             launched_backup = False
             poll_failures = 0
@@ -309,7 +314,7 @@ class FaultTolerantScheduler:
                     if (
                         speculate
                         and not launched_backup
-                        and attempt + 1 + len(backups) < self.max_attempts
+                        and next_attempt < self.max_attempts
                         and sibling_times
                         and time.time() - t0
                         > max(
@@ -318,7 +323,8 @@ class FaultTolerantScheduler:
                         )
                     ):
                         launched_backup = True
-                        battempt = attempt + 1 + len(backups)
+                        battempt = next_attempt
+                        next_attempt += 1
                         b = {"done": False, "path": None, "duration": 0.0,
                              "uri": None, "task": None}
                         backups.append(b)
@@ -364,11 +370,8 @@ class FaultTolerantScheduler:
                 win = backup_winner()
                 if win is not None:
                     return win["path"]
-                # skip attempt numbers consumed by backups; never block on
-                # a pending backup — it stays in the race
-                attempt = attempt + 1 + len(
-                    [b for b in backups if not b["done"]]
-                )
+                # never block on a pending backup — it stays in the race;
+                # the next primary draws a fresh number from next_attempt
                 continue
         # primaries exhausted: grant outstanding backups a bounded grace
         deadline = time.time() + 30.0

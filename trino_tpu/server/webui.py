@@ -67,10 +67,13 @@ async function refresh() {
     for (const q of qs.slice().reverse()) {
       const tr = document.createElement('tr');
       tr.className = 'q';
-      tr.innerHTML = '<td>' + q.queryId + '</td>' +
-        '<td class="' + q.state + '">' + q.state + '</td>' +
-        '<td>' + (q.query || '') + '</td>' +
-        '<td>' + (q.error || '') + '</td>';
+      for (const [text, cls] of [[q.queryId, null], [q.state, q.state],
+                                 [q.query || '', null], [q.error || '', null]]) {
+        const td = document.createElement('td');
+        td.textContent = text;
+        if (cls) td.className = cls;
+        tr.appendChild(td);
+      }
       tr.onclick = async () => {
         const d = await j('/v1/query/' + q.queryId);
         const el = document.getElementById('detail');
