@@ -1,0 +1,167 @@
+"""Profiling pass 2: SQL-level bisection of Q1/Q3/Q6 on the real TPU.
+
+Pass 1 (PROFILE_r3.json) showed all warm time lands in the single
+jax.device_get — the tunnel's block_until_ready does not actually wait
+for small outputs, so micro numbers there were bogus.  Here every
+measurement is `session.execute` end-to-end (device_get included), and
+query variants isolate one feature at a time: each aggregate of Q1, each
+join of Q3, the device_get floor itself.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def steady(s, sql, iters=4):
+    s.execute(sql)  # cold
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        s.execute(sql)
+        best = min(best, time.perf_counter() - t0)
+    return round(best, 5)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    from trino_tpu.session import tpch_session
+
+    out = {}
+    s = tpch_session(1.0)
+
+    # floor: no scan, trivial scan, count only
+    out["floor_select1"] = steady(s, "select 1")
+    out["floor_count"] = steady(s, "select count(*) from lineitem")
+    out["floor_sum_qty"] = steady(s, "select sum(l_quantity) from lineitem")
+
+    # Q6 feature bisection
+    out["q6_full"] = steady(s, """
+select sum(l_extendedprice * l_discount) from lineitem
+where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07 and l_quantity < 24""")
+    out["q6_no_filter"] = steady(
+        s, "select sum(l_extendedprice * l_discount) from lineitem"
+    )
+    out["q6_no_mul"] = steady(s, """
+select sum(l_extendedprice) from lineitem
+where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07 and l_quantity < 24""")
+
+    # Q1 aggregate bisection (all keep the group-by + filter shape)
+    base = ("from lineitem where l_shipdate <= date '1998-09-02' "
+            "group by l_returnflag, l_linestatus")
+    out["q1_count_only"] = steady(
+        s, f"select l_returnflag, l_linestatus, count(*) {base}"
+    )
+    out["q1_one_sum"] = steady(
+        s, f"select l_returnflag, l_linestatus, sum(l_quantity) {base}"
+    )
+    out["q1_four_sums"] = steady(s, f"""
+select l_returnflag, l_linestatus, sum(l_quantity), sum(l_extendedprice),
+       sum(l_discount), sum(l_tax) {base}""")
+    out["q1_one_mul_sum"] = steady(s, f"""
+select l_returnflag, l_linestatus,
+       sum(l_extendedprice * (1 - l_discount)) {base}""")
+    out["q1_two_mul_sum"] = steady(s, f"""
+select l_returnflag, l_linestatus,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) {base}""")
+    out["q1_avgs_only"] = steady(s, f"""
+select l_returnflag, l_linestatus, avg(l_quantity), avg(l_extendedprice),
+       avg(l_discount) {base}""")
+    out["q1_full"] = steady(s, f"""
+select l_returnflag, l_linestatus, sum(l_quantity), sum(l_extendedprice),
+       sum(l_extendedprice * (1 - l_discount)),
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)),
+       avg(l_quantity), avg(l_extendedprice), avg(l_discount), count(*)
+       {base} order by l_returnflag, l_linestatus""")
+
+    # Q3 join bisection
+    out["q3_co_join"] = steady(s, """
+select count(*) from customer, orders
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and o_orderdate < date '1995-03-15'""")
+    out["q3_ol_join"] = steady(s, """
+select count(*) from orders, lineitem
+where l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'""")
+    out["q3_joins_count"] = steady(s, """
+select count(*) from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'""")
+    out["q3_joins_group"] = steady(s, """
+select l_orderkey, count(*) from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15' group by l_orderkey""")
+    out["q3_full"] = steady(s, """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
+  and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10""")
+
+    # properly-synced micro: device_get forces completion
+    import jax.numpy as jnp
+    import numpy as np
+
+    def sync_steady(fn, *args, n=4):
+        jax.device_get(fn(*args))
+        best = float("inf")
+        for _ in range(n):
+            t0 = time.perf_counter()
+            jax.device_get(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return round(best, 5)
+
+    nrows = 6_001_618
+    big = jnp.ones((21_000_000,), jnp.float64)
+    out["m_sum168MB_get"] = sync_steady(jax.jit(jnp.sum), big)
+    cols = [jnp.asarray(np.random.rand(nrows)) for _ in range(4)]
+
+    @jax.jit
+    def q6ish(a, b, c, d):
+        m = (a > 0.2) & (a < 0.9) & (b > 0.05) & (c < 0.7)
+        return jnp.sum(jnp.where(m, b * d, 0.0))
+
+    out["m_q6ish_get"] = sync_steady(q6ish, *cols)
+
+    gid = jnp.asarray(np.random.randint(0, 12, nrows))
+    ivals = [jnp.asarray(np.random.randint(0, 1 << 40, nrows))
+             for _ in range(3)]
+
+    @jax.jit
+    def segsums(gid, *vs):
+        return [jax.ops.segment_sum(v, gid, num_segments=16) for v in vs]
+
+    out["m_segsum3_i64_get"] = sync_steady(segsums, gid, *ivals)
+
+    fvals = [v.astype(jnp.float64) for v in ivals]
+    out["m_segsum3_f64_get"] = sync_steady(segsums, gid, *fvals)
+
+    @jax.jit
+    def narrow_mul(a, b):
+        p = a * b
+        approx = jnp.abs(a.astype(jnp.float64)) * jnp.abs(
+            b.astype(jnp.float64)
+        )
+        return p.sum(), jnp.sum(approx > 4e18)
+
+    out["m_narrowmul_flag_get"] = sync_steady(narrow_mul, ivals[0], ivals[1])
+
+    print(json.dumps(out), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "PROFILE_r3b.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -39,11 +39,16 @@ def load_tpch(conn: sqlite3.Connection, sf: float, tables: Iterable[str]):
 
 
 def normalize(rows: Sequence[tuple]) -> list:
+    from decimal import Decimal
+
     out = []
     for r in rows:
         norm = []
         for v in r:
-            if isinstance(v, float):
+            if isinstance(v, Decimal):
+                # wide decimals come back exact; oracle sides are floats
+                norm.append(round(float(v), 4))
+            elif isinstance(v, float):
                 norm.append(round(v, 4))
             elif isinstance(v, np.generic):
                 norm.append(v.item())

@@ -136,8 +136,10 @@ def test_math_functions(session, oracle_conn):
         assert sg == -1
         assert tr == math.trunc(tp)
         assert wb == min(10 + 1, max(0, int(10 * tp / 500000) + 1))
-        assert gr == max(tp, 100000)
-        assert le == min(tp, 100000)
+        # bigint coerces to decimal(19,0), so greatest/least are typed
+        # decimal(21,2) — wide — and decode to exact decimal.Decimal
+        assert float(gr) == max(tp, 100000)
+        assert float(le) == min(tp, 100000)
     keys = base(
         oracle_conn, "select o_orderkey from orders order by o_orderkey limit 50"
     )

@@ -79,7 +79,7 @@ def test_decimal_and_double_roundtrip(session):
         "select sum(amount), min(score), max(score) from events"
     ).to_pylist()
     expected_sum = round(sum(i * 0.25 for i in range(4000)), 2)
-    assert abs(rows[0][0] - expected_sum) < 0.01
+    assert abs(float(rows[0][0]) - expected_sum) < 0.01
     assert rows[0][1] == 0.0
 
 

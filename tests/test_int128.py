@@ -60,10 +60,10 @@ def test_high_scale_decimal_sql():
     exp = decimal.Decimal("123456.789012345678") * decimal.Decimal(
         "0.000000000042"
     )
-    # engine decimals store <= 18 digits: the (18,12)x(18,12) product is
-    # typed decimal(18,6) (scale capped), so expect the value rounded at
-    # scale 6 — unlike the reference's decimal(38,24)
-    assert res == pytest.approx(
+    # the (18,12)x(18,12) product is typed decimal(36,6) — wide (two-limb)
+    # storage with the engine's scale-6 cap (reference: decimal(38,24));
+    # the value itself comes back as an exact decimal.Decimal
+    assert float(res) == pytest.approx(
         float(exp.quantize(decimal.Decimal("0.000001"))), abs=1e-12
     )
 

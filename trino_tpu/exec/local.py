@@ -286,21 +286,28 @@ class LocalExecutor:
                 )
             )
             for attempt in range(7):
-                if use_jit:
-                    (out_lanes, sel, ordered, checks, dups, colls,
-                     wides, sflags) = self._run_jitted(plan, scans, counts)
-                else:
-                    ctx = self.trace_ctx_cls(self, scans, counts)
-                    out_lanes, sel, ordered, checks = self._run(plan, ctx)
-                    dups = ctx.dup_checks
-                    colls = ctx.collision_checks
-                    wides = ctx.lowering.overflow_flags
-                    sflags = ctx.sum_overflow
                 # ONE round trip for all control scalars AND the output
                 # lanes (the accelerator may sit behind a high-latency
                 # tunnel: each device_get costs an RTT; on the rare
-                # retry the prefetched outputs are simply discarded)
+                # retry the prefetched outputs are simply discarded).
+                # The axon executable-reuse fault can surface either at
+                # dispatch (fn call) or at device_get, so the retry
+                # wraps both.
                 try:
+                    if use_jit:
+                        (out_lanes, sel, ordered, checks, dups, colls,
+                         wides, sflags) = self._run_jitted(
+                            plan, scans, counts
+                        )
+                    else:
+                        ctx = self.trace_ctx_cls(self, scans, counts)
+                        out_lanes, sel, ordered, checks = self._run(
+                            plan, ctx
+                        )
+                        dups = ctx.dup_checks
+                        colls = ctx.collision_checks
+                        wides = ctx.lowering.overflow_flags
+                        sflags = ctx.sum_overflow
                     (dup_vals, check_vals, coll_vals, wide_vals,
                      sflag_vals, host_lanes, sel_np) = jax.device_get(
                         ([d for _, d in dups],
@@ -593,7 +600,9 @@ class LocalExecutor:
                 lanes[sym] = entry["dev"][col]
                 continue
             if arr.shape[0] < cap:
-                pad = np.zeros(cap - arr.shape[0], dtype=arr.dtype)
+                pad = np.zeros(
+                    (cap - arr.shape[0],) + arr.shape[1:], dtype=arr.dtype
+                )
                 arr = np.concatenate([arr, pad])
             v = jnp.asarray(arr)
             if valid is None:
@@ -828,12 +837,22 @@ class _TraceCtx:
             self.ex.dicts[sym] = np.array(list(d), dtype=object)
         for i, sym in enumerate(node.symbols):
             colvals = [r[i] for r in node.rows]
-            arr = np.zeros(cap, dtype=tmap[sym].np_dtype)
+            t = tmap[sym]
             ok = np.zeros(cap, dtype=bool)
-            for j, v in enumerate(colvals):
-                if v is not None:
-                    arr[j] = v
-                    ok[j] = True
+            if getattr(t, "wide", False):
+                from ..ops.wide_decimal import from_python_int
+
+                arr = np.zeros((cap, 2), dtype=np.int64)
+                for j, v in enumerate(colvals):
+                    if v is not None:
+                        arr[j, 0], arr[j, 1] = from_python_int(int(v))
+                        ok[j] = True
+            else:
+                arr = np.zeros(cap, dtype=t.np_dtype)
+                for j, v in enumerate(colvals):
+                    if v is not None:
+                        arr[j] = v
+                        ok[j] = True
             lanes[sym] = (jnp.asarray(arr), jnp.asarray(ok))
         sel = jnp.arange(cap) < n
         return Batch(lanes, sel)
@@ -1167,8 +1186,10 @@ class _TraceCtx:
                 )
             sel = jnp.ones(1, dtype=bool)
             # pad to 128 for consistency
+            from ..ops.wide_decimal import pad_rows
+
             return Batch(
-                {k: (jnp.pad(v, (0, 127)), jnp.pad(ok, (0, 127)))
+                {k: (pad_rows(v, 127), jnp.pad(ok, (0, 127)))
                  for k, (v, ok) in lanes.items()},
                 jnp.pad(sel, (0, 127)),
             )
@@ -1209,8 +1230,10 @@ class _TraceCtx:
             lanes[s] = out[s]
         pad_cap = _pad_capacity(cap)
         if pad_cap != cap:
+            from ..ops.wide_decimal import pad_rows
+
             lanes = {
-                s: (jnp.pad(v, (0, pad_cap - cap)), jnp.pad(ok, (0, pad_cap - cap)))
+                s: (pad_rows(v, pad_cap - cap), jnp.pad(ok, (0, pad_cap - cap)))
                 for s, (v, ok) in lanes.items()
             }
             present = jnp.pad(present, (0, pad_cap - cap))
@@ -1340,7 +1363,7 @@ class _TraceCtx:
         src = join_ops.build_unique(bkey, right.sel)
         self.dup_checks.append((node, src.dup_count))
         row, matched = join_ops.probe(src, pkey, left.sel)
-        if len(node.criteria) > 1:
+        if join_ops.needs_verification(rkeys):
             # exact equality on the real key columns: a 64-bit locator
             # collision must reject the candidate, not return a wrong row
             matched = matched & join_ops.verify_rows(rkeys, lkeys, row)
@@ -1399,7 +1422,7 @@ class _TraceCtx:
         # rows; mask them below via probe sel gather
         self._note_capacity(total, capacity, "join")
         psel = left.sel[probe_row]
-        if len(node.criteria) > 1:
+        if join_ops.needs_verification(rkeys):
             matched = matched & join_ops.verify_rows(
                 rkeys, lkeys, build_row, probe_row
             )
@@ -1548,7 +1571,8 @@ class _TraceCtx:
         (sorted search, any match counts).  Single-column keys compare the
         real value directly (collision-free); multi-column keys and residual
         predicates go through the expansion path with exact verification."""
-        if node.filter is not None or len(node.source_keys) > 1:
+        skeys = [src.lanes[k] for k in node.source_keys]
+        if node.filter is not None or join_ops.needs_verification(skeys):
             return self._semi_hit_expanded(node, src, filt)
         build = join_ops.build_multi(
             filt.lanes[node.filtering_keys[0]], filt.sel
@@ -1577,7 +1601,7 @@ class _TraceCtx:
             build, counts, lo, capacity
         )
         self._note_capacity(total, capacity, "join")
-        if len(skeys) > 1:
+        if join_ops.needs_verification(skeys):
             matched = matched & join_ops.verify_rows(
                 fkeys, skeys, build_row, probe_row
             )
@@ -1606,8 +1630,9 @@ class _TraceCtx:
         for s, (v, ok) in sub.lanes.items():
             val = v[first]
             okv = ok[first] & (sub.sel.sum() > 0)
+            shape = (n,) + val.shape  # wide decimals keep their limb dim
             lanes[s] = (
-                jnp.broadcast_to(val, (n,)),
+                jnp.broadcast_to(val, shape),
                 jnp.broadcast_to(okv, (n,)),
             )
         return Batch(lanes, src.sel, src.ordered, src.replicated)
@@ -1712,14 +1737,33 @@ class _TraceCtx:
             )
             return cnt, jnp.ones(cnt.shape, bool)
         if f.kind in ("min", "max"):
+            if lanes[f.args[0]][0].ndim == 2:
+                raise ExecutionError(
+                    "window min/max over wide decimals (>18 digits) is "
+                    "not implemented"
+                )
             v, cnt = W.framed_minmax(lanes[f.args[0]], sel, b, f.frame, f.kind)
             return jnp.where(cnt > 0, v, jnp.zeros_like(v)), cnt > 0
         if f.kind in ("sum", "avg"):
-            ssum, cnt = W.framed_sum_count(lanes[f.args[0]], sel, start, end)
+            ot, it_ = f.output_type, f.input_type
+            in_lane = lanes[f.args[0]]
+            wide_out = getattr(ot, "wide", False)
+            if wide_out or in_lane[0].ndim == 2:
+                # exact 128-bit windowed decimal sum (chunk cumsums)
+                from ..ops import wide_decimal as wd
+
+                wsum, cnt = W.framed_sum_wide(in_lane, sel, start, end)
+                if f.kind == "sum":
+                    return (
+                        (wsum if wide_out else wd.narrow(wsum)), cnt > 0
+                    )
+                num = wd.rescale(wsum, ot.scale - it_.scale)
+                q = wd.div_round(num, jnp.maximum(cnt, 1))
+                return (q if wide_out else wd.narrow(q)), cnt > 0
+            ssum, cnt = W.framed_sum_count(in_lane, sel, start, end)
             if f.kind == "sum":
                 return ssum, cnt > 0
             den = jnp.maximum(cnt, 1)
-            ot, it_ = f.output_type, f.input_type
             if ssum.dtype.kind == "f":
                 v = ssum / den
             elif ot.name in ("double", "real"):

@@ -432,11 +432,23 @@ def execute_spilled_sort(executor, plan, sort, scan):
             v = rank[safe]
         else:
             v = vals
-        if not k.ascending:
-            # ints reverse via bitwise complement (negation wraps at
-            # INT64_MIN, which would sort first under DESC); floats negate
-            v = -v if v.dtype.kind == "f" else ~v.astype(np.int64)
-        lex.append(v)
+        if v.ndim == 2:
+            # wide (two-limb) decimal key: minor operand = low limb in
+            # unsigned order (sign bit flipped into the signed domain),
+            # major = signed high limb; DESC complements both
+            lo = v[:, 0].astype(np.int64) ^ np.int64(-(2**63))
+            hi = v[:, 1].astype(np.int64)
+            if not k.ascending:
+                lo, hi = ~lo, ~hi
+            lex.append(lo)
+            lex.append(hi)
+        else:
+            if not k.ascending:
+                # ints reverse via bitwise complement (negation wraps at
+                # INT64_MIN, so it would sort first under DESC); floats
+                # negate
+                v = -v if v.dtype.kind == "f" else ~v.astype(np.int64)
+            lex.append(v)
         nullbit = ~oks if not k.nulls_first else oks
         lex.append(nullbit)
     idx = np.lexsort(lex) if lex else np.arange(total)

@@ -40,7 +40,21 @@ Lane = Tuple[jnp.ndarray, jnp.ndarray]  # (values, valid)
 
 def _const_lane(e: ir.Constant, n_ref: Lane) -> Lane:
     """Broadcast a constant against the shape of any reference lane."""
-    shape = n_ref[0].shape
+    shape = (n_ref[0].shape[0],)
+    if getattr(e.type, "wide", False):
+        from ..ops.wide_decimal import from_python_int
+
+        if e.value is None:
+            return (
+                jnp.zeros(shape + (2,), dtype=jnp.int64),
+                jnp.zeros(shape, dtype=bool),
+            )
+        lo, hi = from_python_int(int(e.value))
+        val = jnp.stack(
+            [jnp.full(shape, lo, jnp.int64), jnp.full(shape, hi, jnp.int64)],
+            axis=-1,
+        )
+        return val, jnp.ones(shape, dtype=bool)
     if e.value is None:
         return (
             jnp.zeros(shape, dtype=e.type.np_dtype),
@@ -155,7 +169,9 @@ def compile_expr(
             # align each bound against the ORIGINAL value lane independently
             v_lo, lo2 = align_numeric(node.value.type, v, node.low.type, lo)
             v_hi, hi2 = align_numeric(node.value.type, v, node.high.type, hi)
-            res = jnp.logical_and(lo2 <= v_lo, v_hi <= hi2)
+            res = jnp.logical_and(
+                _cmp("<=", lo2, v_lo), _cmp("<=", v_hi, hi2)
+            )
             if node.negate:
                 res = jnp.logical_not(res)
             return res, vok & lok & hok
@@ -201,12 +217,20 @@ def _lower_comparison(node: ir.Comparison, cols, ev, ctx: LoweringContext) -> La
     res = _cmp(node.op, lv, rv)
     if node.op == "is_distinct":
         both_null = jnp.logical_not(lok) & jnp.logical_not(rok)
-        neq = jnp.where(lok & rok, lv != rv, jnp.logical_not(both_null))
+        neq = jnp.where(
+            lok & rok, _cmp("is_distinct", lv, rv),
+            jnp.logical_not(both_null),
+        )
         return neq, _all_valid(neq)
     return res, lok & rok
 
 
 def _cmp(op: str, lv, rv):
+    if lv.ndim == 2 or rv.ndim == 2:
+        from ..ops import wide_decimal as wd
+
+        wide_op = {"=": "==", "<>": "!=", "is_distinct": "!="}.get(op, op)
+        return wd.compare(wd.promote(lv), wd.promote(rv), wide_op)
     if op == "=":
         return lv == rv
     if op in ("<>", "!="):
@@ -280,12 +304,13 @@ def _lower_in(node: ir.In, cols, ev, ctx: LoweringContext) -> Lane:
             res = jnp.logical_not(res)
         return res, cok
     v, vok = ev(node.value, cols)
-    res = jnp.zeros(v.shape, dtype=bool)
-    anynull = jnp.zeros(v.shape, dtype=bool)
+    n = v.shape[0]
+    res = jnp.zeros(n, dtype=bool)
+    anynull = jnp.zeros(n, dtype=bool)
     for it in node.items:
         iv, iok = ev(it, cols)
         a, b = align_numeric(node.value.type, v, it.type, iv)
-        res = res | jnp.where(iok, a == b, False)
+        res = res | jnp.where(iok, _cmp("=", a, b), False)
         anynull = anynull | jnp.logical_not(iok)
     # x IN (...) is null if no match and some item was null
     ok = vok & (res | jnp.logical_not(anynull))
@@ -297,24 +322,41 @@ def _lower_in(node: ir.In, cols, ev, ctx: LoweringContext) -> Lane:
 def _lower_case(node: ir.Case, cols, ev, ctx: LoweringContext) -> Lane:
     if node.type.is_dictionary:
         return _lower_case_dict(node, cols, ev, ctx)
+    wide_out = getattr(node.type, "wide", False)
+
+    def branch_value(e: ir.Expr, bv):
+        """Coerce one branch lane to the CASE output representation."""
+        if wide_out or bv.ndim == 2:
+            from ..ops import wide_decimal as wd
+
+            fs = e.type.scale if e.type.is_decimal else 0
+            w = wd.decimal_rescale_wide(
+                wd.promote(bv.astype(jnp.int64) if bv.ndim == 1 else bv),
+                fs, node.type.scale,
+            )
+            return w if wide_out else wd.narrow(w)
+        bv = bv.astype(node.type.np_dtype)
+        if e.type.is_decimal and node.type.is_decimal:
+            bv = decimal_rescale(bv, e.type.scale, node.type.scale)
+        return bv
+
     # evaluate all branches, select backwards (XLA fuses the selects)
     if node.default is not None:
         v, ok = ev(node.default, cols)
-        v = v.astype(node.type.np_dtype)
-        if node.default.type.is_decimal and node.type.is_decimal:
-            v = decimal_rescale(v, node.default.type.scale, node.type.scale)
+        v = branch_value(node.default, v)
     else:
         ref = next(iter(cols.values()))
-        v = jnp.zeros(ref[0].shape, dtype=node.type.np_dtype)
-        ok = jnp.zeros(ref[0].shape, dtype=bool)
+        n = ref[0].shape[0]
+        shape = (n, 2) if wide_out else (n,)
+        dt = jnp.int64 if wide_out else node.type.np_dtype
+        v = jnp.zeros(shape, dtype=dt)
+        ok = jnp.zeros(n, dtype=bool)
     for w in reversed(node.whens):
         cv, cok = ev(w.condition, cols)
         rv, rok = ev(w.result, cols)
-        rv = rv.astype(node.type.np_dtype)
-        if w.result.type.is_decimal and node.type.is_decimal:
-            rv = decimal_rescale(rv, w.result.type.scale, node.type.scale)
+        rv = branch_value(w.result, rv)
         take = cok & cv
-        v = jnp.where(take, rv, v)
+        v = jnp.where(take[..., None] if v.ndim == 2 else take, rv, v)
         ok = jnp.where(take, rok, ok)
     return v, ok
 
@@ -374,6 +416,26 @@ def _lower_cast(node: ir.Cast, cols, ev, ctx: LoweringContext) -> Lane:
         return v, ok
     if ft.is_dictionary:
         return _cast_varchar_parse(node, v, ok, ctx)
+    wide_src = v.ndim == 2
+    wide_tgt = getattr(tt, "wide", False)
+    if wide_src or wide_tgt:
+        from ..ops import wide_decimal as wd
+
+        if ft.is_decimal and tt.is_decimal:
+            w = wd.decimal_rescale_wide(wd.promote(v), ft.scale, tt.scale)
+            return (w if wide_tgt else wd.narrow(w)), ok
+        if wide_src and tt.name == "double":
+            return wd.to_double(v) / (10**ft.scale), ok
+        if wide_src and T.is_integral(tt):
+            w = wd.decimal_rescale_wide(v, ft.scale, 0)
+            return wd.narrow(w).astype(tt.np_dtype), ok
+        if T.is_integral(ft) and wide_tgt:
+            return wd.rescale(wd.widen(v.astype(jnp.int64)), tt.scale), ok
+        if ft.name in ("double", "real") and wide_tgt:
+            # via float: beyond 2^53 the double itself has no more digits
+            n = round_half_away(v * (10**tt.scale))
+            return wd.widen(n.astype(jnp.int64)), ok
+        raise NotImplementedError(f"cast {ft} -> {tt} (wide decimal)")
     if ft.is_decimal and tt.is_decimal:
         return decimal_rescale(v, ft.scale, tt.scale), ok
     if ft.is_decimal and tt.name == "double":

@@ -141,10 +141,35 @@ class AggSpec:
     param: Optional[float] = None  # constant parameter (approx_percentile)
 
     @property
+    def _wide_sum(self) -> bool:
+        """Wide (two-limb) chunked accumulation: any decimal sum/avg —
+        sum outputs are typed decimal(38,s) (Int128 accumulator analog,
+        spi/type/Int128Math.java), so the state is four 32-bit chunk
+        sums that merge by plain addition (psum-able)."""
+        from . import wide_decimal as wd
+
+        if self.kind == "sum":
+            return wd.is_wide_type(self.output_type)
+        if self.kind == "avg":
+            return (
+                self.input_type is not None
+                and self.input_type.is_decimal
+                and self.output_type is not None
+                and self.output_type.is_decimal
+            )
+        return False
+
+    @property
     def accumulator_names(self) -> List[str]:
         o = self.output
         if self.kind == "avg":
+            if self._wide_sum:
+                return [f"{o}$c0", f"{o}$c1", f"{o}$c2", f"{o}$c3",
+                        f"{o}$count"]
             return [f"{o}$sum", f"{o}$count"]
+        if self.kind == "sum" and self._wide_sum:
+            return [f"{o}$c0", f"{o}$c1", f"{o}$c2", f"{o}$c3",
+                    f"{o}$valid"]
         if self.kind in ("sum", "min", "max"):
             return [f"{o}$val", f"{o}$valid"]
         if self.kind in MOMENT_KINDS:
@@ -182,6 +207,11 @@ class AggSpec:
         collective: 'sum' | 'min' | 'max', or None when a collective cannot
         merge it (the executor must fall back to the gather+merge path)."""
         if self.kind in ("min", "max") and name.endswith("$val"):
+            from . import wide_decimal as wd
+
+            if wd.is_wide_type(self.output_type):
+                # per-limb min/max is not lexicographic 128-bit min/max
+                return None
             return self.kind
         if self.kind == "bool_and" and name.endswith("$val"):
             return "min"
@@ -299,6 +329,14 @@ def _key_bits(v: jnp.ndarray) -> jnp.ndarray:
     return v.astype(jnp.uint64)
 
 
+def _key_bit_lanes(v: jnp.ndarray):
+    """Key column as one or two uint64 bit-material lanes (wide decimals
+    contribute each limb as its own hashing/verification round)."""
+    if v.ndim == 2:
+        return [v[:, 0].astype(jnp.uint64), v[:, 1].astype(jnp.uint64)]
+    return [_key_bits(v)]
+
+
 def _group_hash(key_lanes: Sequence[Lane], salt: int) -> jnp.ndarray:
     """Salted 64-bit key-tuple locator.  The NULL flag is mixed as its own
     round (not as a sentinel value), so `NULL` and any real value can never
@@ -308,8 +346,9 @@ def _group_hash(key_lanes: Sequence[Lane], salt: int) -> jnp.ndarray:
     for v, ok in key_lanes:
         h = h * _GOLDEN + ok.astype(jnp.uint64) + _SALT_C
         h = h ^ (h >> jnp.uint64(31))
-        h = h * _GOLDEN + jnp.where(ok, _key_bits(v), jnp.uint64(0))
-        h = h ^ (h >> jnp.uint64(29))
+        for bits in _key_bit_lanes(v):
+            h = h * _GOLDEN + jnp.where(ok, bits, jnp.uint64(0))
+            h = h ^ (h >> jnp.uint64(29))
     return (h % jnp.uint64(2**61)).astype(jnp.int64)
 
 
@@ -350,8 +389,10 @@ def sort_group_ids(
     all_eq = jnp.ones(n, dtype=bool)
     for v, ok in key_lanes:
         okp, okq = ok[perm], ok[prev]
-        bits = _key_bits(v)
-        lane_eq = (okp == okq) & (~okp | (bits[perm] == bits[prev]))
+        vals_eq = jnp.ones(n, dtype=bool)
+        for bits in _key_bit_lanes(v):
+            vals_eq = vals_eq & (bits[perm] == bits[prev])
+        lane_eq = (okp == okq) & (~okp | vals_eq)
         all_eq = all_eq & lane_eq
     collisions = jnp.sum(same_run & ~all_eq)
     gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
@@ -439,6 +480,31 @@ def _seg_max(v, gid, cap):
         m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
         return jnp.max(jnp.where(m, v[None, :], sent), axis=1)
     return jax.ops.segment_max(v, gid, num_segments=cap)
+
+
+def _seg_minmax_wide(v, live, gid, cap, take_min: bool):
+    """Lexicographic segment min/max of a wide (two-limb) decimal lane:
+    extreme high limb first, then the extreme unsigned low limb among
+    rows whose high limb attains it (two segment passes, both exact).
+
+    Sentinels are the TRUE int64 extremes (not the engine's 2^62
+    I64_MAX): limbs span the full 64-bit domain."""
+    from . import wide_decimal as wd
+
+    lo, hi = wd.limbs(v)
+    lo_u = lo ^ jnp.int64(-0x8000000000000000)  # unsigned order, signed domain
+    seg = _seg_min if take_min else _seg_max
+    sent = (
+        jnp.int64(0x7FFFFFFFFFFFFFFF)
+        if take_min
+        else jnp.int64(-0x8000000000000000)
+    )
+    hi_ext = seg(jnp.where(live, hi, sent), gid, cap)
+    on_ext = live & (hi == hi_ext[gid])
+    lo_ext = seg(jnp.where(on_ext, lo_u, sent), gid, cap)
+    return wd.make_wide(
+        lo_ext ^ jnp.int64(-0x8000000000000000), hi_ext
+    )
 
 
 def _splitmix64(v: jnp.ndarray) -> jnp.ndarray:
@@ -612,12 +678,26 @@ def accumulate(
                 for i, arr in packed.items():
                     out[f"{o}$hll{i}"] = arr
         elif s.kind in ("sum", "avg"):
+            cnt = _seg_count(live, gid, cap)
+            if s._wide_sum:
+                # exact 128-bit decimal sum: 32-bit chunk segment sums
+                from . import wide_decimal as wd
+
+                chunks = (
+                    wd.wide_row_chunks(v, live)
+                    if wd.is_wide(v)
+                    else wd.narrow_row_chunks(v, live)
+                )
+                cs = wd.seg_sum_chunks(chunks, gid, cap)
+                for i, c in enumerate(cs):
+                    out[f"{o}$c{i}"] = c
+                out[f"{o}$valid" if s.kind == "sum" else f"{o}$count"] = cnt
+                continue
             if v.dtype.kind == "f":
                 vv = jnp.where(live, v, 0.0)
             else:
                 vv = jnp.where(live, v.astype(jnp.int64), 0)
             ssum = _seg_sum(vv, gid, cap)
-            cnt = _seg_count(live, gid, cap)
             if (
                 v.dtype.kind != "f"
                 and overflow_flags is not None
@@ -631,6 +711,14 @@ def accumulate(
                 out[f"{o}$sum"] = ssum
                 out[f"{o}$count"] = cnt
         elif s.kind in ("min", "max"):
+            from . import wide_decimal as wd
+
+            if wd.is_wide(v):
+                out[f"{o}$val"] = _seg_minmax_wide(
+                    v, live, gid, cap, s.kind == "min"
+                )
+                out[f"{o}$valid"] = _seg_count(live, gid, cap)
+                continue
             if v.dtype.kind == "f":
                 sentinel = jnp.inf if s.kind == "min" else -jnp.inf
                 vv = jnp.where(live, v, sentinel)
@@ -736,6 +824,20 @@ def accumulate(
     return out
 
 
+def _merge_wide_chunks(s, acc_lanes, w, gid, cap, out):
+    """Merge shipped wide-sum chunk columns: segment sums + one carry
+    pass (chunk sums stay canonical, so cross-worker merges never
+    overflow below 2^31 merged partials)."""
+    from . import wide_decimal as wd
+
+    o = s.output
+    merged = wd.merge_chunk_lanes(
+        [acc_lanes[f"{o}$c{i}"][0] for i in range(4)], w, gid, cap
+    )
+    for i, c in enumerate(merged):
+        out[f"{o}$c{i}"] = c
+
+
 def merge_accumulators(
     specs: Sequence[AggSpec],
     acc_lanes: Dict[str, Lane],
@@ -797,12 +899,20 @@ def merge_accumulators(
         elif s.kind in ("count", "count_star", "count_if"):
             msum(f"{o}$count")
         elif s.kind == "avg":
+            if s._wide_sum:
+                _merge_wide_chunks(s, acc_lanes, w, gid, cap, out)
+                msum(f"{o}$count")
+                continue
             msum(f"{o}$sum")
             msum(f"{o}$count")
             _merge_overflow_check(
                 acc_lanes[f"{o}$sum"][0], w, gid, cap, overflow_flags
             )
         elif s.kind == "sum":
+            if s._wide_sum:
+                _merge_wide_chunks(s, acc_lanes, w, gid, cap, out)
+                msum(f"{o}$valid")
+                continue
             msum(f"{o}$val")
             msum(f"{o}$valid")
             _merge_overflow_check(
@@ -819,9 +929,17 @@ def merge_accumulators(
             for suf in ("$sy", "$sx", "$sxy", "$sxx", "$syy", "$n"):
                 msum(o + suf)
         elif s.kind in ("min", "max"):
+            from . import wide_decimal as wd
+
             sv, _ = acc_lanes[f"{o}$val"]
             cv, _ = acc_lanes[f"{o}$valid"]
             has = w & (cv > 0)
+            if wd.is_wide(sv):
+                out[f"{o}$val"] = _seg_minmax_wide(
+                    sv, has, gid, cap, s.kind == "min"
+                )
+                out[f"{o}$valid"] = _seg_sum(jnp.where(w, cv, 0), gid, cap)
+                continue
             if sv.dtype.kind == "f":
                 sentinel = jnp.inf if s.kind == "min" else -jnp.inf
             else:
@@ -926,15 +1044,49 @@ def finalize(
             c = accs[f"{o}$count"]
             out[o] = (c, jnp.ones(c.shape, bool))
         elif s.kind == "sum":
+            if s._wide_sum:
+                from . import wide_decimal as wd
+
+                cs = wd.normalize_chunks(
+                    [accs[f"{o}$c{i}"] for i in range(4)]
+                )
+                cnt = accs[f"{o}$valid"]
+                out[o] = (wd.chunks_to_wide(cs), cnt > 0)
+                continue
             v = accs[f"{o}$val"]
             cnt = accs[f"{o}$valid"]
             out[o] = (v, cnt > 0)
         elif s.kind in ("min", "max"):
+            from . import wide_decimal as wd
+
             v = accs[f"{o}$val"]
             cnt = accs[f"{o}$valid"]
             zero = jnp.zeros_like(v)
-            out[o] = (jnp.where(cnt > 0, v, zero), cnt > 0)
+            has = cnt > 0
+            if wd.is_wide(v):
+                has = has[:, None]
+            out[o] = (jnp.where(has, v, zero), cnt > 0)
         elif s.kind == "avg":
+            if s._wide_sum:
+                from . import wide_decimal as wd
+
+                cs = wd.normalize_chunks(
+                    [accs[f"{o}$c{i}"] for i in range(4)]
+                )
+                cnt = accs[f"{o}$count"]
+                den = jnp.maximum(cnt, 1)
+                ot, it = s.output_type, s.input_type
+                # exact: 128-bit sum rescaled to the output scale, then
+                # one round-half-away 128/64 divide (Int128Math.divide)
+                num = wd.rescale(wd.chunks_to_wide(cs), ot.scale - it.scale)
+                q = wd.div_round(num, den)
+                if wd.is_wide_type(ot):
+                    out[o] = (q, cnt > 0)
+                else:
+                    # narrow output: averages are bounded by the input
+                    # magnitude, which fits one limb
+                    out[o] = (wd.narrow(q), cnt > 0)
+                continue
             ssum = accs[f"{o}$sum"]
             cnt = accs[f"{o}$count"]
             den = jnp.maximum(cnt, 1)

@@ -219,6 +219,15 @@ def expand_join_slots(
     return probe_row, build_row, matched, total, k
 
 
+def needs_verification(key_lanes) -> bool:
+    """True when the locator is a lossy hash that candidates must be
+    re-checked against: multi-column keys, or any wide (two-limb)
+    decimal key (whose 128 bits cannot pass through one locator)."""
+    return len(key_lanes) > 1 or any(
+        v.ndim == 2 for v, _ in key_lanes
+    )
+
+
 def verify_rows(
     build_keys, probe_keys, build_row: jnp.ndarray,
     probe_row: jnp.ndarray | None = None,
@@ -231,7 +240,10 @@ def verify_rows(
         b, bo = bv[build_row], bok[build_row]
         p = pv if probe_row is None else pv[probe_row]
         po = pok if probe_row is None else pok[probe_row]
-        e = (b == p) & bo & po
+        veq = b == p
+        if veq.ndim == 2:  # wide decimal: both limbs must match
+            veq = veq.all(axis=-1)
+        e = veq & bo & po
         eq = e if eq is None else (eq & e)
     return eq
 
@@ -247,17 +259,24 @@ def _mix(h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
 def composite_key(key_lanes, sel) -> Lane:
     """Combine a multi-column equi-join key into one int64 *locator* lane.
 
-    Single-column keys pass through (value == locator, collision-free).
-    Multi-column keys get a 64-bit mix used only to find candidate rows;
-    callers MUST filter candidates with `verify_rows` on the real columns —
-    a collision then only costs an extra (rejected) candidate.
+    Single-column NARROW keys pass through (value == locator,
+    collision-free).  Multi-column keys — and wide (two-limb) decimal
+    keys, whose 128 bits cannot ride one locator — get a 64-bit mix used
+    only to find candidate rows; callers MUST filter candidates with
+    `verify_rows` on the real columns whenever `needs_verification` says
+    so — a collision then only costs an extra (rejected) candidate.
     """
-    if len(key_lanes) == 1:
+    if not needs_verification(key_lanes):
         return key_lanes[0]
-    h = jnp.zeros_like(key_lanes[0][0], dtype=jnp.uint64)
+    n = key_lanes[0][0].shape[0]
+    h = jnp.zeros(n, dtype=jnp.uint64)
     allok = None
     for v, ok in key_lanes:
-        h = _mix(h, v.astype(jnp.uint64))
+        if v.ndim == 2:
+            h = _mix(h, v[:, 0].astype(jnp.uint64))
+            h = _mix(h, v[:, 1].astype(jnp.uint64))
+        else:
+            h = _mix(h, v.astype(jnp.uint64))
         allok = ok if allok is None else (allok & ok)
     # fold into the non-negative int64 range (dead rows are handled by the
     # live-first sort, not by a reserved value region)

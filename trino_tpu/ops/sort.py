@@ -41,6 +41,12 @@ def sort_perm(
         # null ordering as a leading bit per key
         nullbit = jnp.logical_not(ok) if not k.nulls_first else ok
         operands.append(nullbit)
+        if v.ndim == 2:
+            # wide (two-limb) decimal: two operands, 128-bit signed order
+            from . import wide_decimal as wd
+
+            operands.extend(wd.order_operands(v, not k.ascending))
+            continue
         vv = v.astype(jnp.int8) if v.dtype.kind == "b" else v
         # the nullbit key dominates, so null rows' values need no neutralizing
         operands.append(vv if k.ascending else _negate_for_desc(vv))
@@ -75,7 +81,13 @@ def _order_encode(v, ok, sel, key: SortKey) -> jnp.ndarray:
     sacrificed for the selection flag, so distinct values may tie — safe,
     because phase 2 re-sorts candidates on the exact keys and the
     completeness check counts encoded ties."""
-    if jnp.issubdtype(v.dtype, jnp.floating):
+    if v.ndim == 2:
+        # wide decimal: monotone 64-bit approximation; collapsed values
+        # surface as counted ties, phase 2 re-sorts on the exact limbs
+        from . import wide_decimal as wd
+
+        enc = wd.order_approx64(v).astype(jnp.uint64) ^ _SIGN
+    elif jnp.issubdtype(v.dtype, jnp.floating):
         from .aggregation import f64_order_bits
 
         # arithmetic IEEE reconstruction — bitcast f64<->u64 is

@@ -68,8 +68,11 @@ def compute_bounds(
         ch = jnp.zeros(n, dtype=bool)
         for v, ok in lanes:
             vv = v.astype(jnp.int8) if v.dtype.kind == "b" else v
+            neq = vv[1:] != vv[:-1]
+            if neq.ndim == 2:  # wide decimal: a change in either limb
+                neq = neq.any(axis=-1)
             ch = ch | jnp.concatenate(
-                [jnp.zeros(1, bool), (vv[1:] != vv[:-1]) | (ok[1:] != ok[:-1])]
+                [jnp.zeros(1, bool), neq | (ok[1:] != ok[:-1])]
             )
         return ch
 
@@ -207,8 +210,9 @@ def shift_value(
     else:
         dv = jnp.asarray(default, dtype=v.dtype)
         dok = jnp.ones((), dtype=bool)
+    take = in_part[..., None] if vj.ndim == 2 else in_part
     return (
-        jnp.where(in_part, vj, dv),
+        jnp.where(take, vj, dv),
         jnp.where(in_part, okj, dok),
     )
 
@@ -267,6 +271,37 @@ def framed_sum_count(
     ssum = jnp.where(nonempty, cs[e1] - cs[s], jnp.zeros((), masked.dtype))
     cnt = jnp.where(nonempty, cc[e1] - cc[s], 0)
     return ssum, cnt
+
+
+def framed_sum_wide(
+    lane: Lane, sel: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray
+):
+    """Exact 128-bit framed SUM over (narrow or wide) decimal lanes:
+    32-bit chunk exclusive cumsums, frame-end differences, one carry
+    normalization — the windowed form of the chunked group SUM
+    (DecimalSumAggregation Int128 state)."""
+    from . import wide_decimal as wd
+
+    v, ok = lane
+    live = sel & ok
+    nonempty = end >= start
+    s = jnp.clip(start, 0, sel.shape[0] - 1)
+    e1 = jnp.clip(end + 1, 0, sel.shape[0])
+    chunks = (
+        wd.wide_row_chunks(v, live)
+        if wd.is_wide(v)
+        else wd.narrow_row_chunks(v, live)
+    )
+    diffs = []
+    for c in chunks:
+        cs = _excl_cumsum(c)
+        diffs.append(jnp.where(nonempty, cs[e1] - cs[s], 0))
+    while len(diffs) < 4:
+        diffs.append(jnp.zeros_like(diffs[0]))
+    wide = wd.chunks_to_wide(wd.normalize_chunks(diffs))
+    cc = _excl_cumsum(live.astype(jnp.int64))
+    cnt = jnp.where(nonempty, cc[e1] - cc[s], 0)
+    return wide, cnt
 
 
 def _segscan(v: jnp.ndarray, reset: jnp.ndarray, op, reverse: bool):

@@ -88,6 +88,21 @@ class Column:
         elif self.type.is_decimal:
             scale = self.type.scale
             div = 10**scale
+            if vals.ndim == 2:
+                # wide (two-limb) decimal: exact python-int combine
+                from decimal import Decimal
+
+                lo = vals[:, 0].astype(np.uint64)
+                hi = vals[:, 1].astype(np.int64)
+                for l, h, ok in zip(lo, hi, valid):
+                    if not ok:
+                        out.append(None)
+                        continue
+                    u = (int(h) << 64) | int(l)
+                    out.append(
+                        Decimal(u).scaleb(-scale) if scale else u
+                    )
+                return out
             for v, ok in zip(vals, valid):
                 if not ok:
                     out.append(None)
@@ -215,9 +230,35 @@ def column_from_pylist(typ: T.Type, data: Sequence, dictionary=None) -> Column:
         return Column(typ, codes, validity, dictionary)
     if typ.is_decimal:
         scale = 10**typ.scale
+        if getattr(typ, "wide", False):
+            from decimal import ROUND_HALF_UP, Decimal
+
+            from .ops.wide_decimal import from_python_int
+
+            limbs = np.zeros((n, 2), dtype=np.int64)
+            for i, v in enumerate(data):
+                if v is None:
+                    continue
+                u = int(
+                    (Decimal(str(v)) * scale).to_integral_value(
+                        ROUND_HALF_UP
+                    )
+                )
+                limbs[i, 0], limbs[i, 1] = from_python_int(u)
+            return Column(typ, limbs, validity)
+        from decimal import ROUND_HALF_UP, Decimal
+
+        def enc(v):
+            # ints stay exact (float64 would round >2^53, e.g. 18-digit
+            # unscaled decimals); non-ints go through Decimal-of-str
+            if isinstance(v, int):
+                return v * scale
+            return int(
+                (Decimal(str(v)) * scale).to_integral_value(ROUND_HALF_UP)
+            )
+
         vals = np.array(
-            [0 if v is None else int(round(float(v) * scale)) for v in data],
-            dtype=np.int64,
+            [0 if v is None else enc(v) for v in data], dtype=np.int64
         )
         return Column(typ, vals, validity)
     if typ.name == "date":
@@ -245,10 +286,11 @@ def page_from_pydict(schema: Sequence, data: dict) -> Page:
 
 
 def pad_to(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
-    """Pad a 1-D array to a static capacity (the tile-shape trick)."""
+    """Pad an array to a static capacity along axis 0 (the tile-shape
+    trick); trailing dims (wide-decimal limbs) are preserved."""
     n = arr.shape[0]
     if n == capacity:
         return arr
     assert n < capacity, (n, capacity)
-    pad = np.full(capacity - n, fill, dtype=arr.dtype)
+    pad = np.full((capacity - n,) + arr.shape[1:], fill, dtype=arr.dtype)
     return np.concatenate([arr, pad])

@@ -385,8 +385,10 @@ class _MeshTraceCtx(_TraceCtx):
             )
             accs = self._psum_accs(specs, accs)
             out = agg_ops.finalize(specs, accs)
+            from ..ops.wide_decimal import pad_rows
+
             lanes = {
-                k: (jnp.pad(v, (0, 127)), jnp.pad(ok, (0, 127)))
+                k: (pad_rows(v, 127), jnp.pad(ok, (0, 127)))
                 for k, (v, ok) in out.items()
             }
             sel = jnp.pad(jnp.ones(1, bool), (0, 127))
@@ -465,9 +467,11 @@ class _MeshTraceCtx(_TraceCtx):
             lanes[s] = out[s]
         pad_cap = _pad_capacity(cap)
         if pad_cap != cap:
+            from ..ops.wide_decimal import pad_rows
+
             lanes = {
                 s: (
-                    jnp.pad(v, (0, pad_cap - cap)),
+                    pad_rows(v, pad_cap - cap),
                     jnp.pad(ok, (0, pad_cap - cap)),
                 )
                 for s, (v, ok) in lanes.items()

@@ -94,6 +94,8 @@ def serialize_page(page: Page) -> bytes:
             flags |= 1
         if col.dictionary is not None:
             flags |= 2
+        if vals.ndim == 2:
+            flags |= 4  # wide (two-limb) decimal rows
         payload.write(struct.pack("<B", flags))
         _w_bytes(payload, vals.dtype.str.encode())
         _w_bytes(payload, vals.tobytes())
@@ -138,6 +140,8 @@ def deserialize_page(frame: bytes) -> Page:
         dt, off = _r_bytes(mv, off)
         vb, off = _r_bytes(mv, off)
         vals = np.frombuffer(vb, dtype=np.dtype(dt.decode())).copy()
+        if flags & 4:
+            vals = vals.reshape(-1, 2)
         validity = None
         if flags & 1:
             bb, off = _r_bytes(mv, off)

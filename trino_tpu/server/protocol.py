@@ -33,7 +33,14 @@ def columns_json(page: Page, types: List[T.Type]) -> list:
 
 
 def data_json(page: Page) -> list:
-    return [list(row) for row in page.to_pylist()]
+    from decimal import Decimal
+
+    # wide decimals decode to decimal.Decimal; the wire sends them as
+    # strings (StatementClientV1 decimal representation)
+    return [
+        [str(v) if isinstance(v, Decimal) else v for v in row]
+        for row in page.to_pylist()
+    ]
 
 
 def query_results(

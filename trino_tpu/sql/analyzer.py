@@ -2647,7 +2647,7 @@ def _literal(e: ast.Literal) -> ir.Constant:
         scale = len(frac)
         unscaled = int((whole + frac) or "0")
         precision = max(len((whole + frac).lstrip("0")), scale + 1)
-        return ir.Constant(T.decimal(min(18, precision), scale), unscaled)
+        return ir.Constant(T.decimal(min(38, precision), scale), unscaled)
     if e.kind == "string":
         return ir.Constant(T.VARCHAR, e.value)
     if e.kind == "boolean":
@@ -2762,15 +2762,22 @@ def _agg_output_type(
         return in_t
     if kind == "sum":
         if in_t.is_decimal:
-            return T.decimal(18, in_t.scale)
+            # Trino: sum(decimal(p,s)) -> decimal(38,s) with an Int128
+            # accumulator (DecimalSumAggregation); wide chunked sums in
+            # ops/aggregation.py make this exact
+            return T.decimal(38, in_t.scale)
         if in_t.name in ("double", "real"):
             return T.DOUBLE
         return T.BIGINT
     if kind == "avg":
         if in_t.is_decimal:
             # scale 6 keeps boundary comparisons (e.g. Q17's qty < 0.2*avg)
-            # within rounding noise of exact decimal(38) math
-            return T.decimal(18, max(in_t.scale, 6))
+            # within rounding noise of exact decimal(38) math; integer
+            # digits are preserved (Trino: avg(decimal(p,s)) keeps p)
+            s = max(in_t.scale, 6)
+            return T.decimal(
+                min(38, max(in_t.precision - in_t.scale + s, 18)), s
+            )
         return T.DOUBLE
     if kind in ("var_samp", "var_pop", "stddev_samp", "stddev_pop",
                 "geometric_mean", "covar_pop", "covar_samp", "corr",

@@ -78,16 +78,22 @@ class FixedWidthType(Type):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(Type):
-    """Fixed-point decimal stored as int64 scaled by 10**scale."""
+    """Fixed-point decimal, scaled by 10**scale.
+
+    p <= 18 stores one int64 lane; p > 18 ("wide") stores a two-limb
+    (n, 2) int64 lane — the Int128ArrayBlock analog
+    (spi/block/Int128ArrayBlock.java:28, spi/type/Int128Math.java)."""
 
     precision: int = 18
     scale: int = 0
 
     def __post_init__(self):
-        if self.precision > 18:
-            raise NotImplementedError(
-                "decimal precision > 18 requires two-limb math (future work)"
-            )
+        if self.precision > 38:
+            raise ValueError("decimal precision exceeds 38")
+
+    @property
+    def wide(self) -> bool:
+        return self.precision > 18
 
     @property
     def np_dtype(self) -> np.dtype:
@@ -242,7 +248,7 @@ def common_super_type(a: Type, b: Type) -> Type:
     if a.is_decimal and b.is_decimal:
         scale = max(a.scale, b.scale)
         intd = max(a.precision - a.scale, b.precision - b.scale)
-        return decimal(min(18, intd + scale), scale)
+        return decimal(min(38, intd + scale), scale)
     if a.is_decimal and is_integral(b):
         return common_super_type(a, decimal(18, 0))
     if b.is_decimal and is_integral(a):
