@@ -15,8 +15,21 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def steady(s, sql, iters=4):
-    s.execute(sql)  # cold
+KEEP = []  # sessions stay alive: dropped-session buffer frees poison
+# later tunnel transfers (same workaround as bench.py), and running many
+# different queries through ONE session triggers the sibling-executable
+# INVALID_ARGUMENT fault — so each measurement gets its own session.
+
+
+def steady(_ignored, sql, iters=4):
+    from trino_tpu.session import tpch_session
+
+    s = tpch_session(1.0)
+    KEEP.append(s)
+    try:
+        s.execute(sql)  # cold
+    except Exception as e:  # noqa: BLE001
+        return f"error: {str(e)[:120]}"
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
@@ -29,15 +42,15 @@ def main():
     import jax
 
     jax.config.update("jax_enable_x64", True)
-    from trino_tpu.session import tpch_session
 
     out = {}
-    s = tpch_session(1.0)
+    s = None
 
     # floor: no scan, trivial scan, count only
     out["floor_select1"] = steady(s, "select 1")
     out["floor_count"] = steady(s, "select count(*) from lineitem")
     out["floor_sum_qty"] = steady(s, "select sum(l_quantity) from lineitem")
+    print(json.dumps(out), flush=True)
 
     # Q6 feature bisection
     out["q6_full"] = steady(s, """
@@ -99,6 +112,7 @@ select l_orderkey, count(*) from customer, orders, lineitem
 where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
   and l_orderkey = o_orderkey and o_orderdate < date '1995-03-15'
   and l_shipdate > date '1995-03-15' group by l_orderkey""")
+    print(json.dumps(out), flush=True)
     out["q3_full"] = steady(s, """
 select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
        o_orderdate, o_shippriority
@@ -109,6 +123,7 @@ where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
 group by l_orderkey, o_orderdate, o_shippriority
 order by revenue desc, o_orderdate limit 10""")
 
+    print(json.dumps(out), flush=True)
     # properly-synced micro: device_get forces completion
     import jax.numpy as jnp
     import numpy as np

@@ -210,3 +210,32 @@ def test_hive_orc_csv_json_formats(tmp_path):
     assert s.execute("select a, b from js order by a").to_pylist() == [
         (1, "q"), (2, "r"),
     ]
+
+
+def test_scan_cache_invalidates_on_file_change(tmp_path):
+    """Hive scans are HBM-cacheable with a filesystem-fingerprint
+    version: warm repeats skip the parquet decode; touching the
+    warehouse invalidates (LazyBlock/OS-page-cache role, device tier)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from trino_tpu.session import Session
+
+    wh = str(tmp_path)
+    (tmp_path / "t").mkdir()
+    pq.write_table(pa.table({"x": [1, 2, 3]}), f"{wh}/t/part0.parquet")
+    s = Session()
+    s.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
+    conn = s.catalogs.get("hive")
+    assert conn.cacheable
+    v0 = conn.data_version()
+    assert s.execute("select sum(x) from t").to_pylist() == [(6,)]
+    # warm repeat must hit the device cache (same version, cache entry)
+    assert conn.data_version() == v0
+    cache = s._scan_cache
+    assert any(k[0] == "hive" for k in cache.entries), "scan not cached"
+    assert s.execute("select sum(x) from t").to_pylist() == [(6,)]
+    # appending a file changes the version and the visible rows
+    pq.write_table(pa.table({"x": [10]}), f"{wh}/t/part1.parquet")
+    assert conn.data_version() != v0
+    assert s.execute("select sum(x) from t").to_pylist() == [(16,)]

@@ -308,3 +308,82 @@ def test_sketched_aggs_grouped_mesh(session, mesh_exec):
     assert set(dist) == set(local)
     for k, est in dist.items():
         assert abs(est - local[k]) <= max(0.2 * local[k], 4), (k, est, local[k])
+
+
+def test_partitioned_window_no_gather(session, mesh_exec):
+    """PARTITION BY windows hash-repartition instead of gathering
+    (AddExchanges.java:138 window partitioning)."""
+    from trino_tpu.parallel import mesh_executor as me
+
+    calls = []
+    orig_rp = me._MeshTraceCtx._hash_repartition
+
+    def spy(self, b, keys):
+        calls.append(tuple(keys))
+        return orig_rp(self, b, keys)
+
+    me._MeshTraceCtx._hash_repartition = spy
+    try:
+        run_both(
+            session, mesh_exec,
+            "select o_custkey, o_orderkey, "
+            "row_number() over (partition by o_custkey "
+            "order by o_orderdate, o_orderkey) rn "
+            "from orders order by o_custkey, rn, o_orderkey",
+        )
+    finally:
+        me._MeshTraceCtx._hash_repartition = orig_rp
+    assert ("o_custkey",) in calls, "window did not hash-repartition"
+
+
+def test_range_partitioned_order_by(session, mesh_exec):
+    """Distributed ORDER BY uses a RANGE exchange + local sorts: device
+    order concatenates into the total order (MergeOperator by
+    placement), with no gather-then-global-sort."""
+    from trino_tpu.parallel import mesh_executor as me
+    from trino_tpu.parallel import shuffle
+
+    calls = []
+    orig = shuffle.range_buckets
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    shuffle.range_buckets = spy
+    try:
+        run_both(
+            session, mesh_exec,
+            "select o_orderkey, o_totalprice from orders "
+            "order by o_totalprice desc, o_orderkey",
+        )
+        run_both(
+            session, mesh_exec,
+            "select l_orderkey, l_shipdate from lineitem "
+            "order by l_shipdate, l_orderkey",
+        )
+    finally:
+        shuffle.range_buckets = orig
+    assert calls, "distributed sort did not range-partition"
+
+
+def test_partitioned_distinct_stays_distributed(session, mesh_exec):
+    run_both(
+        session, mesh_exec,
+        "select distinct o_custkey from orders order by o_custkey",
+    )
+
+
+def test_mesh_intersect_except(session, mesh_exec):
+    run_both(
+        session, mesh_exec,
+        "select o_custkey from orders where o_totalprice > 100000 "
+        "intersect select o_custkey from orders where o_orderdate < "
+        "date '1996-01-01' order by o_custkey",
+    )
+    run_both(
+        session, mesh_exec,
+        "select o_custkey from orders "
+        "except select c_custkey from customer where c_acctbal < 0 "
+        "order by o_custkey",
+    )

@@ -1,0 +1,122 @@
+"""Streaming (bounded-working-set) execution tests.
+
+Reference parity: operator/Driver.java:372 bounded-page streaming,
+ScanFilterAndProjectOperator.java:190 split-at-a-time pull — here the
+streaming unit is an HBM-sized tile of splits through the regular
+fragment DAG (see exec/streaming.py docstring).
+"""
+import pytest
+
+from trino_tpu.exec import streaming
+from trino_tpu.session import tpch_session
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+Q1 = """
+select l_returnflag, l_linestatus, sum(l_quantity) q,
+       sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) c,
+       avg(l_quantity) a, count(*) n
+from lineitem where l_shipdate <= date '1998-09-02'
+group by l_returnflag, l_linestatus order by l_returnflag, l_linestatus
+"""
+
+Q6 = """
+select sum(l_extendedprice * l_discount) from lineitem
+where l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01'
+  and l_discount between 0.05 and 0.07 and l_quantity < 24
+"""
+
+
+@pytest.fixture(scope="module")
+def free():
+    return tpch_session(0.05)
+
+
+def _streamed(q, sf=0.05, limit=3_000_000):
+    """Run under a tight limit, asserting the streaming path engaged."""
+    calls = []
+    orig = streaming.execute_streaming
+
+    def spy(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    streaming.execute_streaming = spy
+    try:
+        s = tpch_session(sf, query_max_memory_bytes=limit)
+        rows = s.execute(q).to_pylist()
+    finally:
+        streaming.execute_streaming = orig
+    assert calls, "streaming path did not engage"
+    return rows
+
+
+def test_q6_streams_exact(free):
+    assert _streamed(Q6) == free.execute(Q6).to_pylist()
+
+
+def test_q1_streams_exact(free):
+    # grouped aggregation incl. wide decimal sums and avg across tiles
+    assert _streamed(Q1) == free.execute(Q1).to_pylist()
+
+
+def test_q3_streams_exact(free):
+    # joins (broadcast builds) + group-by + topN across tiles
+    assert _streamed(Q3) == free.execute(Q3).to_pylist()
+
+
+def test_count_distinct_falls_back_loudly(free):
+    """count(DISTINCT) needs raw rows colocated (not partializable):
+    streaming refuses and the memory limit surfaces loudly rather than
+    silently wrong — the hash-partitioned distinct is the mesh path."""
+    from trino_tpu.utils.memory import ExceededMemoryLimitError
+
+    q = "select count(distinct l_suppkey) from lineitem"
+    s = tpch_session(0.05, query_max_memory_bytes=1_000_000)
+    with pytest.raises(ExceededMemoryLimitError):
+        s.execute(q)
+
+
+def test_multiple_tiles_used(free):
+    """The tight limit must actually produce more than one tile."""
+    from trino_tpu.exec.fragment_exec import FragmentExecutor
+
+    created = []
+    orig = FragmentExecutor.__init__
+
+    def spy(self, *a, **k):
+        created.append(1)
+        return orig(self, *a, **k)
+
+    FragmentExecutor.__init__ = spy
+    try:
+        rows = _streamed(Q6)
+    finally:
+        FragmentExecutor.__init__ = orig
+    assert len(created) > 2, f"expected tiled executors, got {len(created)}"
+
+
+def test_pure_sort_falls_back_to_spill():
+    """Non-reducing plans must refuse streaming (spilled sort owns them:
+    tiling a bare scan would re-materialize the table downstream)."""
+    refused = []
+    orig = streaming.execute_streaming
+    streaming.execute_streaming = lambda *a, **k: refused.append(1) or orig(*a, **k)
+    try:
+        q = ("select l_orderkey, l_extendedprice from lineitem "
+             "order by l_extendedprice desc, l_orderkey")
+        s = tpch_session(0.01, query_max_memory_bytes=600_000)
+        base = tpch_session(0.01)
+        assert s.execute(q).to_pylist() == base.execute(q).to_pylist()
+    finally:
+        streaming.execute_streaming = orig
+    assert not refused, "streaming engaged for a non-reducing sort plan"

@@ -324,3 +324,29 @@ def test_wide_scalar_subquery():
         "select v from sq where v = (select max(v) from sq)"
     ).to_pylist()
     assert rows == [(D("99999999999999999999.9999"),)]
+
+
+def test_lane_narrow_wide_product_joins_stored_wide():
+    """A wide-TYPED product keeps a narrow fast-path lane; joining it
+    against a genuinely two-limb stored column must still hash/verify
+    consistently (joint locator decision + canonical limb hashing)."""
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table jt1 (d decimal(25,4), tag bigint)")
+    s.execute("create table jt2 (a decimal(13,2), b decimal(13,2), tag bigint)")
+    s.execute(
+        "insert into jt1 values (12.50, 1), "
+        "(99999999999999999999.9999, 2), (7.0, 3)"
+    )
+    s.execute("insert into jt2 values (2.50, 5.00, 10), (1.75, 4.00, 30)")
+    rows = s.execute(
+        "select jt1.tag, p.tag from jt1 join "
+        "(select a * b as prod, tag from jt2) p on jt1.d = p.prod "
+        "order by jt1.tag"
+    ).to_pylist()
+    assert rows == [(1, 10), (3, 30)]
+    rows = s.execute(
+        "select tag from jt1 where d in (select a * b from jt2) "
+        "order by tag"
+    ).to_pylist()
+    assert rows == [(1,), (3,)]

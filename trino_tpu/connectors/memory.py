@@ -168,7 +168,7 @@ class MemoryConnector(Connector):
         self.name = name
         self.store = _Store()
 
-    def data_version(self) -> int:
+    def data_version(self, table=None) -> int:
         return self.store.version
 
     def create_table(self, name: str, schema, data: dict):

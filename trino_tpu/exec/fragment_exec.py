@@ -71,15 +71,48 @@ class FragmentExecutor(LocalExecutor):
             self._apply_dynamic_filters(node, idx, scans, dicts, counts)
             return
         if isinstance(node, P.RemoteSource):
+            # streaming tiles re-read the SAME remote pages every tile:
+            # cache the host merge AND the device upload per fragment id
+            # for the run, so build tables stay HBM-resident across tiles
+            cache = getattr(self, "_streaming_cache", None)
+            key = None
+            if cache is not None:
+                # stable key: cross-run isolation comes from the fresh
+                # per-run cache OBJECT; a per-run nonce here would leak
+                # into the jit-cache key and recompile every warm run
+                key = ("__remote__", node.fragment_id)
+                hit = cache.get(key)
+                if hit is not None:
+                    scans[id(node)] = {
+                        s: lane for s, lane in hit["merged"].items()
+                    }
+                    dicts.update(hit["dicts"])
+                    counts[id(node)] = hit["total"]
+                    self._scan_keys[id(node)] = key
+                    return
             pages = self.remote_pages.get(node.fragment_id, [])
+            local_dicts: Dict[str, np.ndarray] = {}
             merged, total = merge_pages_to_arrays(
-                pages, node.symbols, node.types_, dicts
+                pages, node.symbols, node.types_, local_dicts
             )
             for s, t in node.types_:
-                if t.is_dictionary and s not in dicts:
-                    dicts[s] = np.array([], dtype=object)
+                if t.is_dictionary and s not in local_dicts:
+                    local_dicts[s] = np.array([], dtype=object)
+            dicts.update(local_dicts)
             scans[id(node)] = merged
             counts[id(node)] = total
+            if cache is not None:
+                nbytes = sum(
+                    int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
+                    for v, ok in merged.values()
+                )
+                cache.put(
+                    key,
+                    {"merged": dict(merged), "dicts": local_dicts,
+                     "total": total, "dev": {}},
+                    nbytes,
+                )
+                self._scan_keys[id(node)] = key
             return
         for s in node.sources:
             self._load_walk(s, scans, dicts, counts)

@@ -221,8 +221,16 @@ class LocalExecutor:
         # (MemoryRevokingScheduler -> spill, host RAM as the spill tier)
         limit = self.config.get("memory_limit_bytes")
         if limit and self.config.get("spill_enabled", True):
-            from . import spill
+            from . import spill, streaming
 
+            # streaming (fragment-tiled) execution first: the general
+            # bounded-working-set path; shape-matched spill rewrites
+            # remain for plans the fragmenter cannot tile
+            frags = streaming.plan_streaming(self, plan, int(limit))
+            if frags is not None:
+                return streaming.execute_streaming(
+                    self, plan, frags, int(limit)
+                )
             sp = spill.plan_spill(self, plan, int(limit))
             if sp is not None:
                 return spill.execute_spilled_aggregation(self, plan, *sp)
@@ -316,23 +324,49 @@ class LocalExecutor:
                          {s: out_lanes[s] for s in plan.symbols}, sel)
                     )
                 except jax.errors.JaxRuntimeError as e:
-                    # axon tunnel executable-reuse fault: drop the
-                    # cached executable and recompile the same trace.
-                    # ONLY for INVALID_ARGUMENT (the observed fault
-                    # signature), at most twice — OOM/crashes
-                    # (RESOURCE_EXHAUSTED/UNAVAILABLE) must surface
-                    # with their real message, not burn the ladder
+                    # axon tunnel executable-reuse fault: drop any cached
+                    # executable and recompile the same trace.  The fault
+                    # strikes warm re-dispatches AND cold first dispatches
+                    # (after a different-shape sibling compiled), so retry
+                    # regardless of cache state — a fresh jax.jit wrapper
+                    # gets a clean executable.  ONLY for INVALID_ARGUMENT
+                    # (the observed fault signature), at most three times —
+                    # OOM/crashes (RESOURCE_EXHAUSTED/UNAVAILABLE) must
+                    # surface with their real message, not burn the ladder
                     jc = self.config.get("jit_cache")
                     retries = getattr(self, "_jit_fault_retries", 0)
                     if (
                         use_jit
-                        and jc
-                        and retries < 2
+                        and retries < 3  # at most three fault retries
                         and "INVALID_ARGUMENT" in str(e)
-                        and getattr(self, "_last_jit_key", None) in jc
                     ):
                         self._jit_fault_retries = retries + 1
-                        del jc[self._last_jit_key]
+                        if jc:
+                            jc.pop(
+                                getattr(self, "_last_jit_key", None), None
+                            )
+                        if retries >= 1:
+                            # persistent fault: cached DEVICE buffers from
+                            # sibling queries can be the poisoned operand.
+                            # RETIRE them to a keep-alive graveyard — NOT
+                            # free them: the tunnel's async buffer frees
+                            # are themselves an observed poison source for
+                            # later transfers (bench.py keeps sessions
+                            # alive for the same reason) — then re-upload.
+                            sc = self.config.get("scan_cache")
+                            if sc is not None:
+                                # graveyard lives on the SESSION-lived
+                                # cache object: a per-query list would be
+                                # dropped at query end and free the very
+                                # buffers we are keeping alive
+                                grave = getattr(sc, "graveyard", None)
+                                if grave is None:
+                                    grave = sc.graveyard = []
+                                for entry in sc.entries.values():
+                                    dev = entry.get("dev", {})
+                                    if dev:
+                                        grave.append(dict(dev))
+                                        dev.clear()
                         continue
                     raise
                 fell_back = False
@@ -497,7 +531,7 @@ class LocalExecutor:
             tuple(c for _, c in node.assignments),
             node.constraint,
             tuple(repr(sp) for sp in splits),
-            conn.data_version(),
+            conn.data_version(node.table),
         )
 
     def _load_one_scan(self, node: P.TableScan, splits, scans, dicts, counts):
@@ -509,7 +543,12 @@ class LocalExecutor:
         parquet row-group dictionaries).  Results are cached across queries
         when the connector is versioned-cacheable (DeviceScanCache)."""
         cache: Optional[DeviceScanCache] = self.config.get("scan_cache")
-        key = self._scan_cache_key(node, splits)
+        # key computation can be expensive (hive stats the table files
+        # for data_version): skip it entirely when caching is off
+        key = (
+            self._scan_cache_key(node, splits)
+            if cache is not None else None
+        )
         if cache is not None and key is not None:
             hit = cache.get(key)
             if hit is not None:
@@ -580,13 +619,19 @@ class LocalExecutor:
                 nbytes,
             )
 
-    def _device_lanes(self, node: P.TableScan, arrays, count):
+    def _device_lanes(self, node: P.TableScan, arrays, count, nid=None):
         """Pad + upload one scan's host arrays to device lanes, reusing
         cached device arrays when the scan is version-cacheable (the
-        host->HBM transfer dominates when the TPU is tunnel-attached)."""
+        host->HBM transfer dominates when the TPU is tunnel-attached).
+        `nid` keys the scan-keys table for node-less sources (streaming
+        RemoteSource inputs, cached per run)."""
         cap = _pad_capacity(count)
-        cache: Optional[DeviceScanCache] = self.config.get("scan_cache")
-        key = self._scan_keys.get(id(node)) if node is not None else None
+        cache: Optional[DeviceScanCache] = self.config.get(
+            "scan_cache"
+        ) or getattr(self, "_streaming_cache", None)
+        if nid is None and node is not None:
+            nid = id(node)
+        key = self._scan_keys.get(nid) if nid is not None else None
         entry = cache.get(key) if (cache is not None and key) else None
         # RemoteSource (exchange input) reuses this load path but has no
         # column mapping and never caches (key is None for it)
@@ -687,7 +732,7 @@ class LocalExecutor:
             cache = {}
         prep = {
             nid: self._device_lanes(self._scan_nodes.get(nid), arrays,
-                                    counts[nid])
+                                    counts[nid], nid)
             for nid, arrays in scans.items()
         }
         key = (
@@ -772,6 +817,10 @@ class LocalExecutor:
             vals = v[idx]
             valid = ok[idx]
             t = types[sym]
+            if getattr(t, "wide", False) and vals.ndim == 1:
+                # lane-narrow/type-wide (fast-path arithmetic kept one
+                # limb): widen host-side so clients decode two limbs
+                vals = np.stack([vals, vals >> np.int64(63)], axis=-1)
             validity = None if valid.all() else valid
             cols.append(Column(t, vals, validity, self.dicts.get(sym)))
         return Page(cols, n, list(plan.names))
@@ -1166,6 +1215,11 @@ class _TraceCtx:
                 specs, lanes, gid, sel, cap,
                 step="partial" if partial else "single",
                 overflow_flags=self.sum_overflow,
+                # decimal(38) sums ride the wide-mul retry ladder: the
+                # narrow fast path flags a wrap, the retrace forces
+                # true chunked 128-bit sums
+                wide_flags=self.lowering.overflow_flags,
+                force_wide=self.lowering.force_wide_mul,
             )
 
         def out_lanes(accs):
@@ -1358,12 +1412,17 @@ class _TraceCtx:
         lkeys = [left.lanes[l] for l, _ in node.criteria]
         rkeys = [right.lanes[r] for _, r in node.criteria]
         self._check_join_dicts(node)
-        bkey = join_ops.composite_key(rkeys, right.sel)
-        pkey = join_ops.composite_key(lkeys, left.sel)
+        # JOINT hashing decision: either side being multi-column or wide
+        # forces both sides onto the hashed locator + exact verification
+        need_verify = join_ops.needs_verification(
+            rkeys
+        ) or join_ops.needs_verification(lkeys)
+        bkey = join_ops.composite_key(rkeys, right.sel, need_verify)
+        pkey = join_ops.composite_key(lkeys, left.sel, need_verify)
         src = join_ops.build_unique(bkey, right.sel)
         self.dup_checks.append((node, src.dup_count))
         row, matched = join_ops.probe(src, pkey, left.sel)
-        if join_ops.needs_verification(rkeys):
+        if need_verify:
             # exact equality on the real key columns: a 64-bit locator
             # collision must reject the candidate, not return a wrong row
             matched = matched & join_ops.verify_rows(rkeys, lkeys, row)
@@ -1401,8 +1460,11 @@ class _TraceCtx:
         lkeys = [left.lanes[l] for l, _ in node.criteria]
         rkeys = [right.lanes[r] for _, r in node.criteria]
         self._check_join_dicts(node)
-        bkey = join_ops.composite_key(rkeys, right.sel)
-        pkey = join_ops.composite_key(lkeys, left.sel)
+        need_verify = join_ops.needs_verification(
+            rkeys
+        ) or join_ops.needs_verification(lkeys)
+        bkey = join_ops.composite_key(rkeys, right.sel, need_verify)
+        pkey = join_ops.composite_key(lkeys, left.sel, need_verify)
         src = join_ops.build_multi(bkey, right.sel)
         counts, lo = join_ops.probe_counts(src, pkey, left.sel)
         if node.kind not in ("inner", "left"):
@@ -1422,7 +1484,7 @@ class _TraceCtx:
         # rows; mask them below via probe sel gather
         self._note_capacity(total, capacity, "join")
         psel = left.sel[probe_row]
-        if join_ops.needs_verification(rkeys):
+        if need_verify:
             matched = matched & join_ops.verify_rows(
                 rkeys, lkeys, build_row, probe_row
             )
@@ -1572,7 +1634,12 @@ class _TraceCtx:
         real value directly (collision-free); multi-column keys and residual
         predicates go through the expansion path with exact verification."""
         skeys = [src.lanes[k] for k in node.source_keys]
-        if node.filter is not None or join_ops.needs_verification(skeys):
+        fkeys0 = [filt.lanes[k] for k in node.filtering_keys]
+        if (
+            node.filter is not None
+            or join_ops.needs_verification(skeys)
+            or join_ops.needs_verification(fkeys0)
+        ):
             return self._semi_hit_expanded(node, src, filt)
         build = join_ops.build_multi(
             filt.lanes[node.filtering_keys[0]], filt.sel
@@ -1589,8 +1656,11 @@ class _TraceCtx:
         (EXISTS with non-equality correlation, e.g. TPC-H Q21)."""
         fkeys = [filt.lanes[k] for k in node.filtering_keys]
         skeys = [src.lanes[k] for k in node.source_keys]
-        bkey = join_ops.composite_key(fkeys, filt.sel)
-        pkey = join_ops.composite_key(skeys, src.sel)
+        need_verify = join_ops.needs_verification(
+            fkeys
+        ) or join_ops.needs_verification(skeys)
+        bkey = join_ops.composite_key(fkeys, filt.sel, need_verify)
+        pkey = join_ops.composite_key(skeys, src.sel, need_verify)
         build = join_ops.build_multi(bkey, filt.sel)
         counts, lo = join_ops.probe_counts(build, pkey, src.sel)
         n_src = src.sel.shape[0]
@@ -1601,7 +1671,7 @@ class _TraceCtx:
             build, counts, lo, capacity
         )
         self._note_capacity(total, capacity, "join")
-        if join_ops.needs_verification(skeys):
+        if need_verify:
             matched = matched & join_ops.verify_rows(
                 fkeys, skeys, build_row, probe_row
             )

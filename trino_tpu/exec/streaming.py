@@ -1,0 +1,228 @@
+"""Streaming (bounded-working-set) local execution.
+
+Reference parity: the reference's ENTIRE worker runtime streams —
+operator/Driver.java:372 moves bounded Pages through the operator chain,
+ScanFilterAndProjectOperator.java:190 pulls split by split, and
+project/PageProcessor.java:53 caps batches at 8192 rows, so one node can
+scan a table far bigger than memory.
+
+TPU-first redesign: XLA wants large static-shape programs, not 8k-row
+batches — so the streaming unit here is an HBM-sized TILE of splits, and
+the carried state is the same PARTIAL page state the distributed path
+ships between workers.  The optimized plan is cut by the regular
+Fragmenter (plan/fragment.py); each SOURCE fragment's splits are then
+executed tile-by-tile through a FragmentExecutor (one compiled XLA
+program, reused across tiles because every tile has the same padded
+shape), and its partial output pages accumulate host-side.  Downstream
+fragments consume the gathered partials exactly as a remote worker
+would.  In effect: local streaming IS distributed execution with one
+worker and host RAM as the exchange buffer — one mechanism, both
+scales (and any plan the cluster can run, one chip can now run).
+
+Build-side/remote input pages are uploaded to the device once per
+streaming run (a shared DeviceScanCache entry keyed by fragment id), so
+tiles re-dispatch against resident build tables instead of re-uploading
+them (the LazyBlock-stays-resident analog for a tunnel-attached TPU).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+from ..page import Page
+from ..plan import nodes as P
+from ..plan.fragment import fragment_plan
+
+# a tile's scan working set is bounded to limit/SAFETY so scan arrays +
+# kernel temporaries + partial state fit together (same factor the spill
+# framework uses)
+SAFETY_FACTOR = 3
+
+
+def _scan_row_bytes(node: P.TableScan) -> int:
+    total = 0
+    for _sym, _col in node.assignments:
+        t = dict(node.types)[_sym]
+        width = 8
+        try:
+            width = t.np_dtype.itemsize
+        except NotImplementedError:
+            pass
+        if getattr(t, "wide", False):
+            width = 16
+        total += width + 1  # validity byte
+    return max(total, 1)
+
+
+def _est_scan_bytes(executor, catalog: str, table: str, node) -> float:
+    conn = executor.catalogs.get(catalog)
+    try:
+        stats = conn.metadata().get_table_statistics(table)
+    except Exception:  # noqa: BLE001 — unknown stats: assume small
+        return 0.0
+    return float(stats.row_count) * _scan_row_bytes(node)
+
+
+def _find_scan_nodes(root: P.PlanNode) -> List[P.TableScan]:
+    out: List[P.TableScan] = []
+
+    def walk(n: P.PlanNode):
+        if isinstance(n, P.TableScan):
+            out.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(root)
+    return out
+
+
+def estimate_plan_scan_bytes(executor, plan: P.PlanNode) -> float:
+    return sum(
+        _est_scan_bytes(executor, sc.catalog, sc.table, sc)
+        for sc in _find_scan_nodes(plan)
+    )
+
+
+def plan_streaming(executor, plan: P.Output, memory_limit: int):
+    """Decide whether to stream: the estimated total scan working set
+    exceeds the memory limit and the plan fragments cleanly.  Returns
+    the fragment list or None."""
+    if estimate_plan_scan_bytes(executor, plan) <= memory_limit:
+        return None
+    # cache the fragment DAG per plan object: fragment roots key the jit
+    # cache by identity, so re-fragmenting would recompile every tile
+    # program on every run (and leak the old executables).  Entries are
+    # stored only AFTER the tileability checks pass ("refused" plans are
+    # cached as False), so a cache hit is always a vetted DAG.
+    fcache = executor.config.get("fragment_cache")
+    fkey = (id(plan), int(memory_limit))  # vetting depends on the budget
+    cached = fcache.get(fkey) if fcache is not None else None
+    # entries carry the plan object itself: the reference pins id(plan)
+    # against recycling (the fragment DAG does not reference the plan)
+    if cached is not None and cached[0] is plan:
+        return None if cached[1] is False else cached[1]
+
+    def _remember(value):
+        if fcache is not None:
+            fcache[fkey] = (plan, value)
+            for k in list(fcache)[:-256]:
+                fcache.pop(k, None)
+        return None if value is False else value
+
+    try:
+        frags = fragment_plan(plan)
+    except NotImplementedError:
+        return _remember(False)
+    if len(frags) < 2:
+        return _remember(False)  # nothing to tile (plain scan output)
+    # every oversized scan must sit in a tileable SOURCE fragment;
+    # oversized build/gather-side scans are the (partitioned) join-spill
+    # framework's job, not ours
+    budget = max(memory_limit // SAFETY_FACTOR, 1)
+    by_id = {f.id: f for f in frags}
+
+    def _reduces(n: P.PlanNode) -> bool:
+        if isinstance(
+            n, (P.Aggregate, P.TopN, P.Distinct, P.Limit)
+        ):
+            return True
+        return any(_reduces(s) for s in n.sources)
+
+    for f in frags:
+        oversized = any(
+            _est_scan_bytes(
+                executor, cat, tab, _find_scan_nodes(f.root)[idx]
+            ) > budget
+            for idx, (cat, tab, _cons) in f.scan_tables.items()
+        )
+        if not oversized:
+            continue
+        if f.partitioning != "source":
+            return _remember(False)
+        # an oversized fragment gathered straight into its consumer must
+        # REDUCE (partial agg/topN/limit), or the tile outputs simply
+        # re-materialize the oversized input downstream (pure sorts
+        # belong to the spilled-sort merge).  BROADCAST/HASH outputs are
+        # join inputs the consumer needs resident regardless — tiling
+        # still bounds the SCAN working set, so those may pass.
+        if f.output_partitioning == "single" and not _reduces(f.root):
+            return _remember(False)
+    if 0 not in by_id:
+        return _remember(False)
+    return _remember(frags)
+
+
+def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Page:
+    """Run the fragment DAG locally, tiling SOURCE fragments' splits."""
+    from .fragment_exec import FragmentExecutor
+    from .local import DeviceScanCache
+
+    budget = max(memory_limit // SAFETY_FACTOR, 1)
+    by_id = {f.id: f for f in frags}
+    pages_by_fragment: Dict[int, List[Page]] = {}
+    # device residency for build/remote inputs across tiles, scoped to
+    # this streaming run (tiles must not thrash the session scan cache).
+    # Cross-run isolation comes from the FRESH cache object per run; the
+    # remote cache keys themselves are stable so the jit-cache key (which
+    # embeds scan keys) stays warm across repeat executions.
+    run_cache = DeviceScanCache()
+
+    def tile_config() -> dict:
+        cfg = dict(executor.config)
+        # the per-query pool would double-count across tiles, and
+        # spill-in-tile would recurse — but the LIMIT stays enforced:
+        # when split granularity cannot realize the planned tile count
+        # (e.g. a hive table stored as one giant row group), the tile's
+        # own _account_memory raises loudly instead of silently running
+        # unbounded.
+        cfg.pop("memory_pool", None)
+        cfg["spill_enabled"] = False
+        cfg["scan_cache"] = None
+        return cfg
+
+    done = set()
+
+    def run_fragment(fid: int):
+        if fid in done:
+            return
+        f = by_id[fid]
+        for src in f.source_fragments:
+            run_fragment(src)
+        remote = {
+            src: pages_by_fragment[src] for src in f.source_fragments
+        }
+        scan_nodes = _find_scan_nodes(f.root)
+        if f.partitioning == "source":
+            (idx, (cat, tab, cons)) = next(iter(f.scan_tables.items()))
+            conn = executor.catalogs.get(cat)
+            est = _est_scan_bytes(executor, cat, tab, scan_nodes[idx])
+            ntiles = max(1, math.ceil(est / budget))
+            splits = conn.split_manager().get_splits(tab, ntiles, cons)
+            per = max(1, math.ceil(len(splits) / ntiles))
+            out: List[Page] = []
+            fe = None
+            for i in range(0, len(splits), per):
+                fe = FragmentExecutor(
+                    executor.catalogs, tile_config(),
+                    {idx: splits[i : i + per]}, remote,
+                )
+                fe._streaming_cache = run_cache
+                out.append(fe.execute(f.root))
+            pages_by_fragment[fid] = out
+        else:
+            splits_by_scan = {}
+            for idx, (cat, tab, cons) in f.scan_tables.items():
+                conn = executor.catalogs.get(cat)
+                splits_by_scan[idx] = conn.split_manager().get_splits(
+                    tab, 1, cons
+                )
+            fe = FragmentExecutor(
+                executor.catalogs, tile_config(), splits_by_scan, remote
+            )
+            fe._streaming_cache = run_cache
+            pages_by_fragment[fid] = [fe.execute(f.root)]
+        done.add(fid)
+
+    run_fragment(0)
+    (result,) = pages_by_fragment[0]
+    return result

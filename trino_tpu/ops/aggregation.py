@@ -59,7 +59,7 @@ import jax.numpy as jnp
 from .. import types as T
 from ..expr.lower import Lane
 
-I64_MAX = jnp.int64(2**62)
+I64_MAX = 2**62  # python int (see ops/int128.py const-arg note)
 
 # kinds whose accumulators are 2nd-moment sums over one input
 MOMENT_KINDS = ("var_samp", "var_pop", "stddev_samp", "stddev_pop")
@@ -248,8 +248,10 @@ def direct_group_ids(
     return gid, cap
 
 
-_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
-_SALT_C = jnp.uint64(0x632BE59BD9B4E019)
+# >int64 bit patterns must wrap in jnp.uint64(...) AT USE (trace-time
+# literal); raw python ints overflow the default int64 weak promotion
+_GOLDEN = 0x9E3779B97F4A7C15
+_SALT_C = 0x632BE59BD9B4E019
 
 
 def _exp2i_pair(e: jnp.ndarray):
@@ -342,12 +344,12 @@ def _group_hash(key_lanes: Sequence[Lane], salt: int) -> jnp.ndarray:
     round (not as a sentinel value), so `NULL` and any real value can never
     permanently collide — a salt change re-randomizes every collision."""
     n = key_lanes[0][0].shape[0]
-    h = jnp.full(n, jnp.uint64(salt * 2 + 1) * _GOLDEN, dtype=jnp.uint64)
+    h = jnp.full(n, jnp.uint64(salt * 2 + 1) * jnp.uint64(_GOLDEN), dtype=jnp.uint64)
     for v, ok in key_lanes:
-        h = h * _GOLDEN + ok.astype(jnp.uint64) + _SALT_C
+        h = h * jnp.uint64(_GOLDEN) + ok.astype(jnp.uint64) + jnp.uint64(_SALT_C)
         h = h ^ (h >> jnp.uint64(31))
         for bits in _key_bit_lanes(v):
-            h = h * _GOLDEN + jnp.where(ok, bits, jnp.uint64(0))
+            h = h * jnp.uint64(_GOLDEN) + jnp.where(ok, bits, jnp.uint64(0))
             h = h ^ (h >> jnp.uint64(29))
     return (h % jnp.uint64(2**61)).astype(jnp.int64)
 
@@ -518,9 +520,6 @@ def _splitmix64(v: jnp.ndarray) -> jnp.ndarray:
     return z.astype(jnp.int64)
 
 
-_BIT_SHIFTS = jnp.arange(64, dtype=jnp.uint64)
-
-
 def _segment_bitwise(vals, live, gid, cap, op: str, live_cnt=None):
     """Per-group bitwise and/or/xor via one 2-D segment_sum over bit planes.
 
@@ -528,8 +527,11 @@ def _segment_bitwise(vals, live, gid, cap, op: str, live_cnt=None):
     matrix, segment-sum it to per-group bit counts [cap, 64], then
     AND = (count == group_size), OR = (count > 0), XOR = (count & 1).
     """
+    # created at trace time (a module-level arange would become a hidden
+    # const arg — see ops/int128.py const-arg note)
+    bit_shifts = jnp.arange(64, dtype=jnp.uint64)
     u = vals.astype(jnp.uint64)
-    bits = ((u[:, None] >> _BIT_SHIFTS[None, :]) & jnp.uint64(1)).astype(
+    bits = ((u[:, None] >> bit_shifts[None, :]) & jnp.uint64(1)).astype(
         jnp.int32
     )
     bits = jnp.where(live[:, None], bits, 0)
@@ -542,7 +544,7 @@ def _segment_bitwise(vals, live, gid, cap, op: str, live_cnt=None):
         outbits = (sums == live_cnt[:, None]) & (live_cnt[:, None] > 0)
     else:  # xor
         outbits = (sums & 1) == 1
-    vals64 = (outbits.astype(jnp.uint64) << _BIT_SHIFTS[None, :]).sum(
+    vals64 = (outbits.astype(jnp.uint64) << bit_shifts[None, :]).sum(
         axis=1, dtype=jnp.uint64
     )
     return vals64.astype(jnp.int64)
@@ -641,12 +643,19 @@ def accumulate(
     capacity: int,
     step: str = "single",
     overflow_flags: Optional[list] = None,
+    wide_flags: Optional[list] = None,
+    force_wide: bool = True,
 ) -> Dict[str, jnp.ndarray]:
     """Compute accumulator arrays (shape [capacity]) per spec.
 
     step='single' keeps approx_* exact (sort-based); step='partial'
     emits mergeable sketch state instead (ops/sketches.py), the
-    decomposable PARTIAL/FINAL form shipped across exchanges."""
+    decomposable PARTIAL/FINAL form shipped across exchanges.
+
+    wide_flags/force_wide drive the decimal(38) sum fast path: callers
+    wired into the executor retry ladder pass the lowering's wide-mul
+    flag list and its force_wide_mul state; unwired callers keep the
+    always-exact (slower) chunked default via force_wide=True."""
     out: Dict[str, jnp.ndarray] = {}
     cap = capacity
     for s in specs:
@@ -680,15 +689,35 @@ def accumulate(
         elif s.kind in ("sum", "avg"):
             cnt = _seg_count(live, gid, cap)
             if s._wide_sum:
-                # exact 128-bit decimal sum: 32-bit chunk segment sums
+                # exact 128-bit decimal sum with a NARROW fast path: the
+                # accumulator SCHEMA is always four 32-bit chunk lanes
+                # ($c0..$c3, stable across retraces), but when the input
+                # is one limb and wide math is not forced, the sum runs
+                # as ONE int64 segment sum + a shadow overflow flag; a
+                # detected wrap retraces with force_wide (the same
+                # ladder as wide multiplies), where true chunked sums
+                # take over.  TPC-H Q1/Q6-scale sums never trip it, so
+                # exactness at decimal(38) costs ~nothing steady-state.
                 from . import wide_decimal as wd
 
-                chunks = (
-                    wd.wide_row_chunks(v, live)
-                    if wd.is_wide(v)
-                    else wd.narrow_row_chunks(v, live)
-                )
-                cs = wd.seg_sum_chunks(chunks, gid, cap)
+                if wd.is_wide(v) or force_wide:
+                    chunks = (
+                        wd.wide_row_chunks(v, live)
+                        if wd.is_wide(v)
+                        else wd.narrow_row_chunks(v, live)
+                    )
+                    cs = wd.seg_sum_chunks(chunks, gid, cap)
+                else:
+                    vv = jnp.where(live, v.astype(jnp.int64), 0)
+                    ssum = _seg_sum(vv, gid, cap)
+                    if wide_flags is not None and _sum_could_overflow(
+                        v.shape[0], s.input_type
+                    ):
+                        wide_flags.append(_sum_overflow_flag(vv, gid, cap))
+                    cs = wd.normalize_chunks([
+                        ssum & 0xFFFFFFFF, ssum >> jnp.int64(32),
+                        jnp.zeros_like(ssum), jnp.zeros_like(ssum),
+                    ])
                 for i, c in enumerate(cs):
                     out[f"{o}$c{i}"] = c
                 out[f"{o}$valid" if s.kind == "sum" else f"{o}$count"] = cnt

@@ -17,8 +17,13 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-_MASK32 = jnp.uint64(0xFFFFFFFF)
-_U1 = jnp.uint64(1)
+# python ints, NOT jnp scalars: module-level jnp constants are captured
+# as hidden const ARGUMENTS of every jitted program using them, and the
+# axon tunnel corrupts re-dispatch of such programs (INVALID_ARGUMENT on
+# every warm run once a sibling program exists — measured, 2026-07-30);
+# plain ints fold into HLO literals
+_MASK32 = 0xFFFFFFFF
+_U1 = 1
 
 
 def umul128(a: jnp.ndarray, b: jnp.ndarray):

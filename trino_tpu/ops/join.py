@@ -40,7 +40,7 @@ from ..expr.lower import Lane
 # pinned to int64 max AND a live-before-dead flag breaks the tie, so the
 # first `nvalid` sorted slots are exactly the live rows even when a real
 # key equals int64 max — no value is stolen from the key domain
-_SENTINEL = jnp.int64(2**63 - 1)
+_SENTINEL = 2**63 - 1  # python int (see ops/int128.py const-arg note)
 
 
 def _sort_live_first(kv, live, n):
@@ -240,12 +240,34 @@ def verify_rows(
         b, bo = bv[build_row], bok[build_row]
         p = pv if probe_row is None else pv[probe_row]
         po = pok if probe_row is None else pok[probe_row]
-        veq = b == p
-        if veq.ndim == 2:  # wide decimal: both limbs must match
-            veq = veq.all(axis=-1)
+        if b.ndim == 2 or p.ndim == 2:
+            # wide decimal (either side may be a lane-narrow wide value)
+            from . import wide_decimal as wd
+
+            veq = wd.compare(wd.promote(b), wd.promote(p), "==")
+        else:
+            veq = b == p
         e = veq & bo & po
         eq = e if eq is None else (eq & e)
     return eq
+
+
+def _canonical_bits(v: jnp.ndarray) -> jnp.ndarray:
+    """Lane value -> one uint64 of hash material, IDENTICAL for a
+    narrow lane and a two-limb lane holding the same value.  Wide
+    decimal arithmetic keeps fast-path lanes narrow even when typed
+    wide, so a join/bucket hash must not depend on the lane FORM: a
+    wide lane whose value fits one limb hashes as that limb; genuinely
+    128-bit values (never equal to any narrow-lane value) fold in the
+    high limb.  Callers verify candidates on the real columns."""
+    if v.ndim == 2:
+        from . import wide_decimal as wd
+
+        lo = v[:, 0].astype(jnp.uint64)
+        hi = v[:, 1].astype(jnp.uint64)
+        folded = lo ^ (hi * jnp.uint64(0x9E3779B97F4A7C15))
+        return jnp.where(wd.fits_narrow(v), lo, folded)
+    return v.astype(jnp.uint64)
 
 
 def _mix(h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
@@ -256,7 +278,7 @@ def _mix(h: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     return h ^ (h >> jnp.uint64(31))
 
 
-def composite_key(key_lanes, sel) -> Lane:
+def composite_key(key_lanes, sel, force_hash: bool = False) -> Lane:
     """Combine a multi-column equi-join key into one int64 *locator* lane.
 
     Single-column NARROW keys pass through (value == locator,
@@ -265,18 +287,19 @@ def composite_key(key_lanes, sel) -> Lane:
     only to find candidate rows; callers MUST filter candidates with
     `verify_rows` on the real columns whenever `needs_verification` says
     so — a collision then only costs an extra (rejected) candidate.
+
+    `force_hash` lets callers impose the JOINT decision across both join
+    sides: lane forms may differ per side (a wide-typed product keeps a
+    narrow fast-path lane), and build/probe locators must come from the
+    same function either way.
     """
-    if not needs_verification(key_lanes):
+    if not force_hash and not needs_verification(key_lanes):
         return key_lanes[0]
     n = key_lanes[0][0].shape[0]
     h = jnp.zeros(n, dtype=jnp.uint64)
     allok = None
     for v, ok in key_lanes:
-        if v.ndim == 2:
-            h = _mix(h, v[:, 0].astype(jnp.uint64))
-            h = _mix(h, v[:, 1].astype(jnp.uint64))
-        else:
-            h = _mix(h, v.astype(jnp.uint64))
+        h = _mix(h, _canonical_bits(v))
         allok = ok if allok is None else (allok & ok)
     # fold into the non-negative int64 range (dead rows are handled by the
     # live-first sort, not by a reserved value region)

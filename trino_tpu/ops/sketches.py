@@ -36,11 +36,11 @@ _HLL_ALPHA = 0.7213 / (1 + 1.079 / HLL_M)
 
 KMV_K = 256               # sample size: quantile rank error ~1/sqrt(256)
 
-_GOLD = jnp.uint64(0x9E3779B97F4A7C15)
+_GOLD = 0x9E3779B97F4A7C15  # python int (see ops/int128.py const-arg note)
 
 
 def _mix64(x: jnp.ndarray) -> jnp.ndarray:
-    x = (x.astype(jnp.uint64) + _GOLD)
+    x = (x.astype(jnp.uint64) + jnp.uint64(_GOLD))
     x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
     x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
     return x ^ (x >> jnp.uint64(31))
@@ -149,7 +149,7 @@ def hll_cardinality(lanes, cap: int) -> jnp.ndarray:
 
 # --- k-minimum-hash uniform sample (percentile sketch) ----------------
 
-_H_EMPTY = jnp.int64(2**62)
+_H_EMPTY = 2**62  # python int (see ops/int128.py const-arg note)
 
 
 def kmv_accumulate(

@@ -72,7 +72,10 @@ def apply_perm(
     return out, sel[perm]
 
 
-_SIGN = jnp.uint64(1 << 63)
+# python int, not a jnp scalar: module-level jnp constants become
+# hidden const args of jitted programs, which the axon tunnel corrupts
+# on re-dispatch (see ops/int128.py note)
+_SIGN_BITS = 1 << 63  # applied via jnp.uint64(_SIGN_BITS) at trace time
 
 
 def _order_encode(v, ok, sel, key: SortKey) -> jnp.ndarray:
@@ -86,7 +89,7 @@ def _order_encode(v, ok, sel, key: SortKey) -> jnp.ndarray:
         # surface as counted ties, phase 2 re-sorts on the exact limbs
         from . import wide_decimal as wd
 
-        enc = wd.order_approx64(v).astype(jnp.uint64) ^ _SIGN
+        enc = wd.order_approx64(v).astype(jnp.uint64) ^ jnp.uint64(_SIGN_BITS)
     elif jnp.issubdtype(v.dtype, jnp.floating):
         from .aggregation import f64_order_bits
 
@@ -96,14 +99,14 @@ def _order_encode(v, ok, sel, key: SortKey) -> jnp.ndarray:
     elif v.dtype.kind == "b":
         enc = v.astype(jnp.uint64)
     else:
-        enc = v.astype(jnp.int64).astype(jnp.uint64) ^ _SIGN
+        enc = v.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(_SIGN_BITS)
     if key.ascending:
         enc = ~enc  # top_k picks largest; ascending wants smallest first
     enc = jnp.where(ok, enc, jnp.uint64(0) if not key.nulls_first else ~jnp.uint64(0))
     enc = (enc >> jnp.uint64(1)) | (sel.astype(jnp.uint64) << jnp.uint64(63))
     # top_k wants a signed operand; u64->i64 after flipping the sign bit is
     # the monotone modular wrap (no 64-bit bitcast on TPU)
-    return (enc ^ _SIGN).astype(jnp.int64)
+    return (enc ^ jnp.uint64(_SIGN_BITS)).astype(jnp.int64)
 
 
 def topn(

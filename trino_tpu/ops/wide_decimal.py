@@ -27,8 +27,11 @@ import jax.numpy as jnp
 
 from . import int128
 
-_M32 = jnp.int64(0xFFFFFFFF)
-_SIGN64 = jnp.int64(-0x8000000000000000)  # 1 << 63 as the int64 bit pattern
+# python ints, NOT jnp scalars: module-level jnp constants become hidden
+# const ARGUMENTS of every jitted program that touches them (visible as
+# %arg0 tensor<i64> in the lowered HLO); plain ints fold into literals
+_M32 = 0xFFFFFFFF
+_SIGN64 = -0x8000000000000000  # 1 << 63 as the int64 bit pattern
 
 WIDE_DIGITS = 18  # precision above this needs two limbs
 
@@ -322,10 +325,16 @@ def seg_sum_chunks(row_chunks, gid: jnp.ndarray, cap: int):
     """Segment-sum per-row chunk lanes and normalize: the wide SUM
     kernel.  Two-chunk inputs (narrow rows) pad with zero chunks —
     `normalize_chunks`' arithmetic carries sign-extend negatives
-    correctly through the zero chunks."""
-    sums = [
-        jax.ops.segment_sum(c, gid, num_segments=cap) for c in row_chunks
-    ]
+    correctly through the zero chunks.
+
+    The chunk lanes are summed as ONE stacked (n, k) segment_sum
+    rather than k separate 1-D segment ops: one scatter pass over the
+    rows instead of k (the chunks ride the minor axis), and the fused
+    program avoids an XLA:TPU re-dispatch fault observed with the
+    multi-op form through the tunnel."""
+    mat = jnp.stack(row_chunks, axis=1)  # (n, k)
+    sums2 = jax.ops.segment_sum(mat, gid, num_segments=cap)  # (cap, k)
+    sums = [sums2[:, i] for i in range(len(row_chunks))]
     while len(sums) < 4:
         sums.append(jnp.zeros_like(sums[0]))
     return normalize_chunks(sums)

@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from .. import types as T
 from ..expr.lower import Lane
 
-I64_MAX = jnp.int64(2**62)
+I64_MAX = 2**62  # python int (see ops/int128.py const-arg note)
 
 
 @dataclasses.dataclass(frozen=True)

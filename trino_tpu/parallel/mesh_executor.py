@@ -382,6 +382,8 @@ class _MeshTraceCtx(_TraceCtx):
             accs = agg_ops.accumulate(
                 specs, b.lanes, gid, b.sel, 1,
                 overflow_flags=self.sum_overflow,
+                wide_flags=self.lowering.overflow_flags,
+                force_wide=self.lowering.force_wide_mul,
             )
             accs = self._psum_accs(specs, accs)
             out = agg_ops.finalize(specs, accs)
@@ -401,6 +403,8 @@ class _MeshTraceCtx(_TraceCtx):
             accs = agg_ops.accumulate(
                 specs, b.lanes, gid, b.sel, cap,
                 overflow_flags=self.sum_overflow,
+                wide_flags=self.lowering.overflow_flags,
+                force_wide=self.lowering.force_wide_mul,
             )
             present_local = (
                 jax.ops.segment_sum(
@@ -426,6 +430,8 @@ class _MeshTraceCtx(_TraceCtx):
             accs = agg_ops.accumulate(
                 specs, sorted_lanes, gid, sel_sorted, cap, step="partial",
                 overflow_flags=self.sum_overflow,
+                wide_flags=self.lowering.overflow_flags,
+                force_wide=self.lowering.force_wide_mul,
             )
             present_local = jnp.arange(cap) < ngroups
             keys_local = agg_ops.group_keys_output(
@@ -558,8 +564,11 @@ class _MeshTraceCtx(_TraceCtx):
         factor = getattr(self.ex, "join_factor", 1)
         lkeys = [left.lanes[l] for l, _ in node.criteria]
         rkeys = [right.lanes[r] for _, r in node.criteria]
-        lbuck, lok = shuffle.bucket_of(lkeys, left.sel, ndev)
-        rbuck, rok = shuffle.bucket_of(rkeys, right.sel, ndev)
+        joint = join_ops.needs_verification(
+            lkeys
+        ) or join_ops.needs_verification(rkeys)
+        lbuck, lok = shuffle.bucket_of(lkeys, left.sel, ndev, joint)
+        rbuck, rok = shuffle.bucket_of(rkeys, right.sel, ndev, joint)
         lkeep = left.sel & (lok | (node.kind == "left"))
         rkeep = right.sel & rok
         lchunk = _shuffle_chunk(left.sel.shape[0], ndev, factor)
@@ -608,13 +617,40 @@ class _MeshTraceCtx(_TraceCtx):
             )
         return Batch(lanes, src.sel, src.ordered, src.replicated)
 
+    def _hash_repartition(self, b: Batch, key_syms) -> Batch:
+        """FIXED_HASH exchange of a distributed batch by key columns —
+        rows with equal keys co-locate (AddExchanges partitioned
+        distribution for window/distinct/set ops)."""
+        ndev = self._ndev()
+        key_lanes = [b.lanes[s] for s in key_syms]
+        bucket, kok = shuffle.bucket_of(key_lanes, b.sel, ndev)
+        # NULL keys form their own group: bucket_of hashes value lanes
+        # only, so route invalid-key rows to a stable device (0)
+        bucket = jnp.where(kok, bucket, 0)
+        chunk = _shuffle_chunk(
+            b.sel.shape[0], ndev, getattr(self.ex, "join_factor", 1)
+        )
+        lanes, sel, mx = shuffle.repartition(
+            b.lanes, b.sel, bucket, b.sel, ndev, chunk, AXIS
+        )
+        self._note_capacity(mx, chunk, "join")
+        return Batch(lanes, sel, replicated=False)
+
     # -- window ----------------------------------------------------------
     def _visit_window(self, node: P.Window) -> Batch:
-        """Gathering exchange (single distribution) before the window sort;
-        hash-repartition by partition keys is the planned next increment."""
+        """Partitioned windows hash-repartition by the PARTITION BY keys
+        (AddExchanges.java:138 window partitioning) and window locally;
+        only partition-less windows need the gathering exchange."""
         b = self.visit(node.source)
-        if not b.replicated:
+        part_keys = tuple(node.partition_by)
+        if not b.replicated and part_keys:
+            b = self._hash_repartition(b, part_keys)
+            replicated_out = False
+        elif not b.replicated:
             b = _gather_batch(b)
+            replicated_out = True
+        else:
+            replicated_out = True
         saved_visit = self.visit
 
         def patched_visit(n):
@@ -625,19 +661,45 @@ class _MeshTraceCtx(_TraceCtx):
             out = _TraceCtx._visit_window(self, node)
         finally:
             self.visit = saved_visit
-        out.replicated = True
+        out.replicated = replicated_out
         return out
 
     # -- ordering --------------------------------------------------------
     def _visit_sort(self, node: P.Sort) -> Batch:
+        """Distributed sort = RANGE exchange on the leading key + local
+        sort per device: device order concatenates into the total order,
+        so no global gather-then-sort (MergeOperator by placement).
+        Replicated inputs keep the plain local sort."""
         b = self.visit(node.source)
-        if not b.replicated:
-            b = _gather_batch(b)  # gathering exchange (single distribution)
+        if b.replicated:
+            keys = self._rank_sort_keys(node.keys, b)
+            perm = sort_ops.sort_perm(keys, b.lanes, b.sel)
+            lanes, sel = sort_ops.apply_perm(b.lanes, perm, b.sel)
+            self.ordered_out = True
+            return Batch(lanes, sel, ordered=True, replicated=True)
+        ndev = self._ndev()
         keys = self._rank_sort_keys(node.keys, b)
-        perm = sort_ops.sort_perm(keys, b.lanes, b.sel)
-        lanes, sel = sort_ops.apply_perm(b.lanes, perm, b.sel)
+        lead = keys[0]
+        # _rank_sort_keys always registers its (possibly hidden $rank)
+        # lane in b.lanes, so the lead column is present by construction
+        bucket = shuffle.range_buckets(
+            b.lanes[lead.column], lead, b.sel, ndev, AXIS
+        )
+        chunk = _shuffle_chunk(
+            b.sel.shape[0], ndev, getattr(self.ex, "join_factor", 1)
+        )
+        lanes, sel, mx = shuffle.repartition(
+            b.lanes, b.sel, bucket, b.sel, ndev, chunk, AXIS
+        )
+        self._note_capacity(mx, chunk, "join")
+        b2 = Batch(lanes, sel, replicated=False)
+        keys2 = self._rank_sort_keys(node.keys, b2)
+        perm = sort_ops.sort_perm(keys2, b2.lanes, b2.sel)
+        lanes2, sel2 = sort_ops.apply_perm(b2.lanes, perm, b2.sel)
         self.ordered_out = True
-        return Batch(lanes, sel, ordered=True, replicated=True)
+        # device-ordered: the final all_gather (device order preserved)
+        # materializes the total order without any further sort
+        return Batch(lanes2, sel2, ordered=True, replicated=False)
 
     def _visit_topn(self, node: P.TopN) -> Batch:
         b = self.visit(node.source)
@@ -686,9 +748,12 @@ class _MeshTraceCtx(_TraceCtx):
     def _visit_distinct(self, node: P.Distinct) -> Batch:
         b = super()._visit_distinct(node)
         if not b.replicated:
-            b = _gather_batch(b)
+            # FIXED_HASH exchange on the distinct keys: equal rows
+            # co-locate, each device dedupes its hash range, and the
+            # output STAYS distributed (MarkDistinct partitioned plan)
+            b = self._hash_repartition(b, tuple(node.output_symbols()))
             b = self._local_distinct(node.output_symbols(), b)
-            b.replicated = True
+            b.replicated = False
         return b
 
     def _local_distinct(self, syms, b: Batch) -> Batch:
@@ -701,10 +766,95 @@ class _MeshTraceCtx(_TraceCtx):
         lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()}
         return Batch(lanes, b.sel[perm] & boundary, replicated=b.replicated)
 
+    def _partitioned_setop(self, node: P.SetOperation) -> Batch:
+        """INTERSECT/EXCEPT on the mesh: union the inputs positionally
+        (dictionaries merged — so codes are comparable mesh-wide), then
+        FIXED_HASH-repartition the tagged rows by the full row value and
+        run the tag-mark dedup per device hash range.  Rows from
+        replicated inputs are sent by device 0 only (one copy)."""
+        if node.all:
+            raise ExecutionError(
+                f"{node.kind.upper()} ALL not supported (DISTINCT only)"
+            )
+        batches = [self.visit(i) for i in node.inputs]
+        if all(b.replicated for b in batches):
+            saved_visit = self.visit
+            by_id = {id(i): b for i, b in zip(node.inputs, batches)}
+            self.visit = lambda n: by_id.get(id(n)) or saved_visit(n)
+            try:
+                out = _TraceCtx._visit_setoperation(self, node)
+            finally:
+                self.visit = saved_visit
+            out.replicated = True
+            return out
+        saved_visit = self.visit
+        by_id = {id(i): b for i, b in zip(node.inputs, batches)}
+        self.visit = lambda n: by_id.get(id(n)) or saved_visit(n)
+        try:
+            lanes0, sel, caps = self._union_lanes(node)
+        finally:
+            self.visit = saved_visit
+        tag = jnp.concatenate([
+            jnp.full(c, i, dtype=jnp.int32) for i, c in enumerate(caps)
+        ])
+        # one copy of replicated inputs' rows: only device 0 transmits
+        my_dev = jax.lax.axis_index(AXIS)
+        rep_row = jnp.concatenate([
+            jnp.full(c, b.replicated, dtype=bool)
+            for b, c in zip(batches, caps)
+        ])
+        keep = sel & (~rep_row | (my_dev == 0))
+        ndev = self._ndev()
+        key_lanes = [lanes0[s] for s in node.symbols]
+        bucket, kok = shuffle.bucket_of(key_lanes, sel, ndev)
+        bucket = jnp.where(kok, bucket, 0)
+        all_lanes = dict(lanes0)
+        all_lanes["__tag__"] = (tag, jnp.ones(tag.shape[0], bool))
+        chunk = _shuffle_chunk(
+            sel.shape[0], ndev, getattr(self.ex, "join_factor", 1)
+        )
+        lanes2, sel2, mx = shuffle.repartition(
+            all_lanes, sel, bucket, keep, ndev, chunk, AXIS
+        )
+        self._note_capacity(mx, chunk, "join")
+        tag2, _ = lanes2.pop("__tag__")
+        cap = sel2.shape[0]
+        key2 = [lanes2[s] for s in node.symbols]
+        perm, gid, ngroups = self._group_sort(key2, sel2, cap)
+        self._note_capacity(ngroups, cap)
+        sel_sorted = sel2[perm]
+        tag_sorted = tag2[perm]
+        side0 = (
+            jax.ops.segment_sum(
+                (sel_sorted & (tag_sorted == 0)).astype(jnp.int32), gid,
+                num_segments=cap,
+            )
+            > 0
+        )
+        side1 = (
+            jax.ops.segment_sum(
+                (sel_sorted & (tag_sorted == 1)).astype(jnp.int32), gid,
+                num_segments=cap,
+            )
+            > 0
+        )
+        keep_group = (
+            side0 & side1 if node.kind == "intersect" else side0 & ~side1
+        )
+        boundary = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
+        )
+        lanes3 = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes2.items()}
+        return Batch(
+            lanes3, sel_sorted & boundary & keep_group[gid],
+            replicated=False,
+        )
+
     def _visit_setoperation(self, node: P.SetOperation) -> Batch:
-        if node.kind != "union":
-            raise ExecutionError(f"{node.kind} not supported yet")
-        # gather every non-replicated input, then reuse the local union
+        if node.kind in ("intersect", "except"):
+            return self._partitioned_setop(node)
+        # UNION: gather every non-replicated input, then reuse the local
+        # union (ALL keeps the ARBITRARY-exchange path upstream)
         originals = {}
         for inp in node.inputs:
             batch = self.visit(inp)

@@ -22,26 +22,92 @@ import jax.numpy as jnp
 
 from ..ops import join as join_ops
 
-_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_M2 = jnp.uint64(0x94D049BB133111EB)
+_M1 = 0xBF58476D1CE4E5B9  # python ints (see ops/int128.py const-arg note)
+_M2 = 0x94D049BB133111EB
 
 
 def _mix64(x: jnp.ndarray) -> jnp.ndarray:
     """splitmix64 finalizer — spreads sequential keys across buckets."""
     x = x.astype(jnp.uint64)
-    x = (x ^ (x >> jnp.uint64(30))) * _M1
-    x = (x ^ (x >> jnp.uint64(27))) * _M2
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(_M1)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(_M2)
     return x ^ (x >> jnp.uint64(31))
 
 
-def bucket_of(key_lanes, sel, ndev: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def bucket_of(
+    key_lanes, sel, ndev: int, force_hash: bool = False
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Destination device per row: hash of the (composite) key mod ndev.
 
-    Both join sides must call this with corresponding key lanes so equal
-    keys co-locate.  Returns (bucket, key_ok)."""
-    v, ok = join_ops.composite_key(key_lanes, sel)
+    Both join sides must call this with corresponding key lanes (and the
+    same force_hash, the JOINT decision) so equal keys co-locate.
+    Returns (bucket, key_ok)."""
+    v, ok = join_ops.composite_key(key_lanes, sel, force_hash)
     h = _mix64(v.astype(jnp.int64))
     return (h % jnp.uint64(ndev)).astype(jnp.int32), ok
+
+
+def range_buckets(
+    key_lane, sort_key, sel: jnp.ndarray, ndev: int, axis: str
+):
+    """Destination device per row for a RANGE exchange on the leading
+    sort key (SystemPartitioningHandle range-partition analog, computed
+    in-mesh): sample local keys, all_gather the samples (small), pick
+    ndev-1 splitters at sample quantiles, bucket = number of splitters
+    strictly below the row.  Rows with EQUAL leading keys always share a
+    bucket, so per-device local sorts on the FULL key list concatenate
+    into a total order across devices in device order — the distributed
+    sort needs no global sort and no row gather (MergeOperator's role,
+    done by placement instead of merging)."""
+    from ..ops import sort as sort_ops
+
+    v, ok = key_lane
+    n = sel.shape[0]
+    nf = sort_key.nulls_first
+
+    def null_bit(o):
+        return jnp.logical_not(o) if not nf else o
+
+    def strictly_above(piv_v, piv_ok):
+        """row >order pivot (null ordering + direction aware)."""
+        nb_row, nb_piv = null_bit(ok & sel), null_bit(piv_ok)
+        if v.ndim == 2:
+            from ..ops import wide_decimal as wd
+
+            piv = jnp.broadcast_to(piv_v, v.shape)
+            gt = wd.compare(v, piv, ">" if sort_key.ascending else "<")
+        else:
+            gt = (v > piv_v) if sort_key.ascending else (v < piv_v)
+        return jnp.where(nb_row == nb_piv, gt, nb_row > nb_piv)
+
+    # local sorted sample -> global sample -> quantile splitters.
+    # Sample only the LIVE prefix (sort_perm puts unselected rows last):
+    # sampling across the padded capacity would fill the splitter pool
+    # with dead-row NULLs under selective filters and funnel every live
+    # row to one device.
+    S = 64
+    perm = sort_ops.sort_perm([sort_key], {sort_key.column: key_lane}, sel)
+    sv, sok = v[perm], ok[perm] & sel[perm]
+    n_live = jnp.maximum(sel.sum(), 1)
+    samp_idx = jnp.clip(
+        jnp.arange(S) * jnp.maximum(n_live // S, 1), 0, n_live - 1
+    )
+    all_v = jax.lax.all_gather(sv[samp_idx], axis, axis=0, tiled=True)
+    all_ok = jax.lax.all_gather(sok[samp_idx], axis, axis=0, tiled=True)
+    total = all_v.shape[0]
+    perm2 = sort_ops.sort_perm(
+        [sort_key],
+        {sort_key.column: (all_v, all_ok)},
+        jnp.ones(total, bool),
+    )
+    gs_v, gs_ok = all_v[perm2], all_ok[perm2]
+    bucket = jnp.zeros(n, dtype=jnp.int32)
+    for j in range(1, ndev):
+        pidx = min((j * total) // ndev, total - 1)
+        bucket = bucket + strictly_above(
+            gs_v[pidx], gs_ok[pidx]
+        ).astype(jnp.int32)
+    return bucket
 
 
 def repartition(
@@ -99,14 +165,25 @@ def repartition(
         )
     ]
     for s, (v, ok) in lanes.items():
-        planes.append(
-            (
-                (s, "v"),
-                jnp.zeros(ndev * chunk_cap, dtype=v.dtype)
-                .at[dest]
-                .set(v[order], mode="drop"),
+        if v.ndim == 2:  # wide decimal: one plane per limb
+            for limb in range(2):
+                planes.append(
+                    (
+                        (s, f"v{limb}"),
+                        jnp.zeros(ndev * chunk_cap, dtype=v.dtype)
+                        .at[dest]
+                        .set(v[order, limb], mode="drop"),
+                    )
+                )
+        else:
+            planes.append(
+                (
+                    (s, "v"),
+                    jnp.zeros(ndev * chunk_cap, dtype=v.dtype)
+                    .at[dest]
+                    .set(v[order], mode="drop"),
+                )
             )
-        )
         planes.append(
             (
                 (s, "ok"),
@@ -128,7 +205,15 @@ def repartition(
         ).reshape(len(items), ndev * chunk_cap)
         for i, (key, _) in enumerate(items):
             received[key] = recv[i]
-    new_lanes = {
-        s: (received[(s, "v")], received[(s, "ok")]) for s in lanes
-    }
+    new_lanes = {}
+    for s, (v, _ok) in lanes.items():
+        if v.ndim == 2:
+            new_lanes[s] = (
+                jnp.stack(
+                    [received[(s, "v0")], received[(s, "v1")]], axis=-1
+                ),
+                received[(s, "ok")],
+            )
+        else:
+            new_lanes[s] = (received[(s, "v")], received[(s, "ok")])
     return new_lanes, received["__sel__"], counts.max()

@@ -283,7 +283,12 @@ class AggInfo:
             if (name.endswith("$count") or name.endswith("$valid")
                     or name.endswith("$has") or name.endswith("$n")):
                 return T.BIGINT
-            base = name.rsplit("$", 1)[-1]
+            base_name = name.rsplit("$", 1)[-1]
+            if base_name in ("c0", "c1", "c2", "c3"):
+                # wide-decimal 32-bit chunk sums ship as plain int64
+                # columns (never as two-limb lanes themselves)
+                return T.BIGINT
+            base = base_name
             if base.startswith("hll") or base.startswith("ph"):
                 return T.BIGINT  # packed HLL registers / sample hashes
             if base.startswith("pv") or base in ("pmin", "pmax"):

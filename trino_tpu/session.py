@@ -92,6 +92,9 @@ class Session:
         self._jit_cache: dict = {}
         self._plan_cache: dict = {}
         self._capacity_hints: dict = {}
+        # streaming fragment DAGs keyed by id(plan): re-fragmenting per
+        # run would mint fresh plan objects and defeat jit-cache reuse
+        self._fragment_cache: dict = {}
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
@@ -126,6 +129,7 @@ class Session:
         )
         exec_config["jit_cache"] = self._jit_cache
         exec_config["capacity_hints"] = self._capacity_hints
+        exec_config["fragment_cache"] = self._fragment_cache
         if self.properties.get("distributed"):
             from .parallel.mesh_executor import MeshExecutor, default_mesh
 

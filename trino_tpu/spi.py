@@ -153,8 +153,9 @@ class Connector:
     # data_version() on every change
     cacheable: bool = True
 
-    def data_version(self) -> int:
-        """Monotonic change counter for cache invalidation."""
+    def data_version(self, table: Optional[str] = None) -> int:
+        """Change counter for cache invalidation; connectors tracking
+        per-table versions may scope it to `table`."""
         return 0
 
     def session_property_metadata(self) -> dict:
