@@ -1,0 +1,176 @@
+"""Microbench: grouped reduction strategies at TPC-H Q1 shape
+(n=8.4M padded, cap=12 groups, int64 values) + decimal multiply chain.
+
+Every timing device_get-synced (tunnel block_until_ready lies).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+
+def steady(fn, *args, n=5):
+    jax.device_get(fn(*args))
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.device_get(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return round(best, 5)
+
+
+def main():
+    print("backend:", jax.devices()[0].platform, flush=True)
+    n, cap = 8_388_608, 16
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.integers(0, 10**7, n))
+    gid = jnp.asarray(rng.integers(0, 12, n))
+    live = jnp.asarray(rng.random(n) < 0.95)
+    out = {}
+
+    # 1. masked (cap, n) matrix reduction (current _use_masked path)
+    @jax.jit
+    def masked_sum(v, gid, live):
+        vv = jnp.where(live, v, 0)
+        m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
+        return jnp.sum(jnp.where(m, vv[None, :], 0), axis=1)
+
+    out["masked_matrix_sum"] = steady(masked_sum, v, gid, live)
+
+    # 2. scatter segment_sum
+    @jax.jit
+    def scat(v, gid, live):
+        return jax.ops.segment_sum(
+            jnp.where(live, v, 0), gid, num_segments=cap
+        )
+
+    out["scatter_segment_sum"] = steady(scat, v, gid, live)
+
+    # 3. pallas grouped count (reference point; count not sum)
+    from trino_tpu.ops import pallas_kernels as pk
+
+    if pk.enabled():
+        f = jax.jit(lambda l, g: pk.grouped_count(l, g, cap))
+        out["pallas_grouped_count"] = steady(f, live, gid)
+
+    # 4. one-hot f32 matmul, 16-bit planes, 64k-row chunks via scan
+    #    (exact: per-chunk plane dot <= 65536*65535 < 2^31; f32 holds
+    #    integers to 2^24 — so use 8-bit planes: 65536*255 < 2^24)
+    CH = 65536
+    nch = n // CH
+
+    @jax.jit
+    def onehot_mm(v, gid, live):
+        vv = jnp.where(live, v, 0)
+        planes = jnp.stack(
+            [(vv >> jnp.int64(8 * k)) & 0xFF for k in range(8)], axis=1
+        ).astype(jnp.float32)  # (n, 8)
+        g3 = gid.reshape(nch, CH)
+        p3 = planes.reshape(nch, CH, 8)
+
+        def body(acc, xs):
+            g, p = xs
+            oh = (
+                g[:, None] == jnp.arange(cap, dtype=g.dtype)[None, :]
+            ).astype(jnp.float32)  # (CH, cap)
+            return acc + oh.T @ p, None  # (cap, 8)
+
+        acc, _ = jax.lax.scan(
+            body, jnp.zeros((cap, 8), jnp.float64), (g3, p3)
+        )
+        tot = jnp.zeros(cap, dtype=jnp.int64)
+        for k in range(8):
+            tot = tot + (acc[:, k].astype(jnp.int64) << jnp.int64(8 * k))
+        return tot
+
+    out["onehot_matmul_8bit"] = steady(onehot_mm, v, gid, live)
+
+    # 5. one-hot matmul WITHOUT chunking (let XLA schedule the big dot)
+    @jax.jit
+    def onehot_big(v, gid, live):
+        vv = jnp.where(live, v, 0)
+        planes = jnp.stack(
+            [(vv >> jnp.int64(16 * k)) & 0xFFFF for k in range(4)], axis=1
+        ).astype(jnp.float64)  # (n, 4) f64: exact to 2^53
+        oh = (
+            gid[:, None] == jnp.arange(cap, dtype=gid.dtype)[None, :]
+        ).astype(jnp.float64)
+        acc = oh.T @ planes  # (cap, 4)
+        tot = jnp.zeros(cap, dtype=jnp.int64)
+        for k in range(4):
+            tot = tot + (acc[:, k].astype(jnp.int64) << jnp.int64(16 * k))
+        return tot
+
+    out["onehot_matmul_f64"] = steady(onehot_big, v, gid, live)
+
+    # 6. f64 values path (Q1 avg/float sums): masked vs matmul
+    vf = v.astype(jnp.float64)
+
+    @jax.jit
+    def masked_f64(vf, gid, live):
+        vv = jnp.where(live, vf, 0.0)
+        m = gid[None, :] == jnp.arange(cap, dtype=gid.dtype)[:, None]
+        return jnp.sum(jnp.where(m, vv[None, :], 0.0), axis=1)
+
+    out["masked_matrix_f64"] = steady(masked_f64, vf, gid, live)
+
+    @jax.jit
+    def onehot_f64(vf, gid, live):
+        vv = jnp.where(live, vf, 0.0)
+        oh = (
+            gid[:, None] == jnp.arange(cap, dtype=gid.dtype)[None, :]
+        ).astype(jnp.float64)
+        return oh.T @ vv
+
+    out["onehot_mv_f64"] = steady(onehot_f64, vf, gid, live)
+
+    # 7. decimal multiply chain (Q1 sum_disc_price ingredient)
+    a = jnp.asarray(rng.integers(0, 10**7, n))
+    b = jnp.asarray(rng.integers(0, 100, n))
+
+    @jax.jit
+    def mul_i64(a, b):
+        return jnp.sum(a * b)
+
+    out["mul_i64_sum"] = steady(mul_i64, a, b)
+
+    @jax.jit
+    def mul_with_flag(a, b, live):
+        p = a * b
+        approx = jnp.abs(a.astype(jnp.float64)) * jnp.abs(
+            b.astype(jnp.float64)
+        )
+        suspect = jnp.sum((approx > 4e18) & live)
+        return jnp.sum(jnp.where(live, p, 0)), suspect
+
+    out["mul_flag_sum"] = steady(mul_with_flag, a, b, live)
+
+    # direct group ids from two int8 code lanes (Q1 keys)
+    c1 = jnp.asarray(rng.integers(0, 3, n))
+    c2 = jnp.asarray(rng.integers(0, 2, n))
+
+    @jax.jit
+    def direct_ids(c1, c2, live):
+        g = jnp.where(live, c1 * 3 + c2, 11)
+        return jax.ops.segment_sum(
+            jnp.ones_like(g), g, num_segments=cap
+        )
+
+    out["direct_ids_plus_scatter_count"] = steady(direct_ids, c1, c2, live)
+
+    print(json.dumps(out), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "MICRO_group.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

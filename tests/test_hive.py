@@ -239,3 +239,125 @@ def test_scan_cache_invalidates_on_file_change(tmp_path):
     pq.write_table(pa.table({"x": [10]}), f"{wh}/t/part1.parquet")
     assert conn.data_version() != v0
     assert s.execute("select sum(x) from t").to_pylist() == [(16,)]
+
+
+def test_scaled_writers_split_output_files(tmp_path):
+    """ScaledWriterScheduler analog: writer pool sized from observed
+    bytes — big CTAS writes parallel part files, small writes one."""
+    import glob
+
+    from trino_tpu.session import tpch_session
+
+    wh = str(tmp_path)
+    s = tpch_session(0.01)
+    s.create_catalog(
+        "hive", "hive",
+        {"hive.warehouse-dir": wh, "hive.writer-target-bytes": 200_000},
+    )
+    s.execute(
+        "create table hive.default.li as select l_orderkey, l_quantity, "
+        "l_extendedprice from lineitem"
+    )
+    parts = glob.glob(f"{wh}/li/part-*.parquet")
+    assert len(parts) > 1, "big write should scale to multiple writers"
+    got = s.execute(
+        "select count(*), sum(l_quantity) from hive.default.li"
+    ).to_pylist()
+    want = s.execute(
+        "select count(*), sum(l_quantity) from lineitem"
+    ).to_pylist()
+    assert got == want
+    s.execute(
+        "create table hive.default.tiny as select 1 as x"
+    )
+    assert len(glob.glob(f"{wh}/tiny/part-*.parquet")) == 1
+
+
+def test_skewed_partition_rebalancer():
+    """SkewedPartitionRebalancer.java:55 analog: a hot partition gets
+    extra buckets and its rows spread, bounding the max bucket load."""
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.exec.partitioner import SkewedPartitionRebalancer
+    from trino_tpu.page import Page, column_from_pylist
+
+    nparts = 4
+    reb = SkewedPartitionRebalancer(
+        nparts, skew_factor=1.5, rebalance_interval=10_000
+    )
+    # 90% of rows share ONE key (hash -> one hot partition)
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        keys = np.where(rng.random(20_000) < 0.9, 7, rng.integers(0, 1000, 20_000))
+        page = Page(
+            [column_from_pylist(T.BIGINT, keys.tolist())], len(keys), ["k"]
+        )
+        reb.partition_page(page, ["k"])
+    assert reb.scaled_partitions(), "hot partition never scaled"
+    total = reb.bucket_rows.sum()
+    # without rebalancing the hot bucket would hold ~90%; with it, the
+    # max bucket share drops well below that
+    assert reb.bucket_rows.max() / total < 0.55, reb.bucket_rows
+
+
+def test_skewed_write_spreads_hot_key(tmp_path):
+    """ScaleWriterPartitioningExchanger contract on the sink: rows
+    cluster by leading-column value, but a hot value's rows spread
+    across extra writers instead of stalling one."""
+    import glob
+
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.connectors.hive import HivePageSink
+    from trino_tpu.page import Page, column_from_pylist
+
+    rng = np.random.default_rng(1)
+    n = 200_000
+    keys = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 10_000, n))
+    page = Page(
+        [
+            column_from_pylist(T.BIGINT, keys.tolist()),
+            column_from_pylist(T.BIGINT, list(range(n))),
+        ],
+        n, ["k", "x"],
+    )
+    sink = HivePageSink(
+        str(tmp_path), "sk", ["k", "x"], overwrite=False,
+        writer_target_bytes=400_000,
+    )
+    sink.append(page)
+    assert sink.finish() == n
+    files = glob.glob(f"{tmp_path}/sk/part-*.parquet")
+    assert len(files) > 2, "hot key funneled all rows into few writers"
+    assert sink.rebalancer.scaled_partitions(), "skew never detected"
+    sizes = sink.rebalancer.bucket_rows
+    assert sizes.max() / sizes.sum() < 0.55, sizes
+
+
+def test_wide_decimal_parquet_roundtrip(tmp_path):
+    """decimal(19..38) parquet columns read as two-limb lanes and write
+    back exactly (Int128ArrayBlock layout over arrow decimal128)."""
+    from decimal import Decimal as D
+
+    from trino_tpu.session import Session
+
+    wh = str(tmp_path)
+    s = Session()
+    s.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
+    s.execute("create table hive.default.wd (v decimal(30,4))")
+    s.execute(
+        "insert into hive.default.wd values (123456789012345678901.2345), "
+        "(-0.0001), (null)"
+    )
+    rows = s.execute(
+        "select v from hive.default.wd order by v"
+    ).to_pylist()
+    assert rows == [
+        (D("-0.0001"),), (D("123456789012345678901.2345"),), (None,),
+    ]
+    (tot,) = s.execute(
+        "select sum(v) from hive.default.wd"
+    ).to_pylist()[0]
+    assert tot == D("123456789012345678901.2344")

@@ -350,3 +350,27 @@ def test_lane_narrow_wide_product_joins_stored_wide():
         "order by tag"
     ).to_pylist()
     assert rows == [(1,), (3,)]
+
+
+def test_wide_union_mixes_lane_forms():
+    """UNION/INTERSECT of a stored two-limb column with a lane-narrow
+    wide-typed product must promote forms before concatenating."""
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table u1 (d decimal(27,4))")
+    s.execute("create table u2 (a decimal(13,2), b decimal(13,2))")
+    s.execute(
+        "insert into u1 values (12.50), (99999999999999999999.9999)"
+    )
+    s.execute("insert into u2 values (2.50, 5.00), (1.75, 4.00)")
+    rows = s.execute(
+        "select d from u1 union all select a * b from u2 order by d"
+    ).to_pylist()
+    assert [r[0] for r in rows] == [
+        D("7.0000"), D("12.5000"), D("12.5000"),
+        D("99999999999999999999.9999"),
+    ]
+    rows = s.execute(
+        "select d from u1 intersect select a * b from u2"
+    ).to_pylist()
+    assert rows == [(D("12.5000"),)]

@@ -34,6 +34,8 @@ from ..spi import (
     Connector,
     ConnectorFactory,
     ConnectorMetadata,
+    PageSink,
+    PageSinkProvider,
     PageSource,
     PageSourceProvider,
     Split,
@@ -93,10 +95,7 @@ def _arrow_to_engine_type(at) -> T.Type:
     if pa.types.is_timestamp(at):
         return T.TIMESTAMP
     if pa.types.is_decimal(at):
-        if at.precision > 18:
-            raise NotImplementedError(
-                f"decimal({at.precision},{at.scale}) > 18 digits"
-            )
+        # <= 18 digits: one int64 limb; 19..38: two-limb wide lanes
         return T.decimal(at.precision, at.scale)
     if (
         pa.types.is_string(at)
@@ -105,6 +104,25 @@ def _arrow_to_engine_type(at) -> T.Type:
     ):
         return T.VARCHAR
     raise NotImplementedError(f"unsupported parquet type {at}")
+
+
+def _engine_to_arrow_type(t: T.Type):
+    if t.is_dictionary:
+        return pa.string()
+    if t.is_decimal:
+        return pa.decimal128(t.precision, t.scale)
+    arrow = {
+        "boolean": pa.bool_(), "tinyint": pa.int8(),
+        "smallint": pa.int16(), "integer": pa.int32(),
+        "bigint": pa.int64(), "double": pa.float64(),
+        "real": pa.float32(), "date": pa.date32(),
+        "timestamp": pa.timestamp("us"),
+    }.get(t.name)
+    if arrow is None:
+        raise NotImplementedError(
+            f"hive CREATE TABLE: unsupported column type {t}"
+        )
+    return arrow
 
 
 class HiveMetadata(ConnectorMetadata):
@@ -153,6 +171,23 @@ class HiveMetadata(ConnectorMetadata):
                 for f in schema
             ),
         )
+
+    def create_table(self, schema: TableSchema) -> None:
+        """CREATE TABLE [AS]: materialize the schema as an empty parquet
+        file so discovery (footer-based) sees the table immediately; the
+        scaled writer sink then adds part files beside it."""
+        _require_pyarrow()
+        tdir = os.path.join(self.warehouse, schema.name)
+        os.makedirs(tdir, exist_ok=True)
+        fields = [
+            pa.field(c.name, _engine_to_arrow_type(c.type))
+            for c in schema.columns
+        ]
+        empty = pa.table(
+            {f.name: pa.array([], f.type) for f in fields},
+            schema=pa.schema(fields),
+        )
+        pq.write_table(empty, os.path.join(tdir, "schema-0.parquet"))
 
     def get_table_statistics(self, table: str) -> TableStatistics:
         """Row counts from footers; per-column min/max/nulls from row-group
@@ -331,26 +366,36 @@ class HivePageSource(PageSource):
                 t, np.asarray(us.fill_null(0), dtype=np.int64), validity
             )
         if t.is_decimal:
-            # scaled int64 representation (Int128Math single-limb analog):
-            # arrow decimal128 stores little-endian 16-byte integers whose
-            # low limb IS the two's-complement scaled value for <= 18
-            # digits — read it zero-copy instead of a per-value Python loop
+            # arrow decimal128 stores little-endian 16-byte integers:
+            # the low limb IS the two's-complement scaled value for
+            # <= 18 digits (single-limb read, zero-copy); wide decimals
+            # (19..38) read BOTH limbs into the engine's (n, 2) lane
+            # (Int128ArrayBlock.java:28 layout)
             ints = arr.cast(pa.decimal128(at.precision, at.scale))
             if hasattr(ints, "combine_chunks"):
                 ints = ints.combine_chunks()
+            wide = getattr(t, "wide", False)
             buf = ints.buffers()[1]
             if buf is None:
-                vals = np.zeros(n, dtype=np.int64)
+                vals = np.zeros((n, 2) if wide else n, dtype=np.int64)
             else:
                 data = np.frombuffer(buf, dtype=np.int64)
                 lo = ints.offset * 2
-                vals = np.ascontiguousarray(
+                lo_limbs = np.ascontiguousarray(
                     data[lo : lo + 2 * len(ints) : 2]
                 )
+                if wide:
+                    hi_limbs = np.ascontiguousarray(
+                        data[lo + 1 : lo + 2 * len(ints) : 2]
+                    )
+                    vals = np.stack([lo_limbs, hi_limbs], axis=-1)
+                else:
+                    vals = lo_limbs
                 if validity is not None:
                     # arrow leaves null-slot bytes undefined; keep the
                     # engine's null-slots-are-zero convention
-                    vals = np.where(validity, vals, 0)
+                    mask = validity[:, None] if wide else validity
+                    vals = np.where(mask, vals, 0)
             return Column(t, vals, validity)
         vals = np.asarray(arr.fill_null(0), dtype=t.np_dtype)
         return Column(t, vals, validity)
@@ -373,9 +418,11 @@ class HiveConnector(Connector):
     # touched/changed/added file changes the version -> cache miss.
     cacheable = True
 
-    def __init__(self, name: str, warehouse: str):
+    def __init__(self, name: str, warehouse: str,
+                 writer_target_bytes: int = 32 << 20):
         self.name = name
         self.warehouse = warehouse
+        self.writer_target_bytes = writer_target_bytes
         self._metadata = HiveMetadata(warehouse)
 
     def data_version(self, table: Optional[str] = None) -> int:
@@ -423,6 +470,109 @@ class HiveConnector(Connector):
     def page_source_provider(self) -> HivePageSourceProvider:
         return HivePageSourceProvider()
 
+    def page_sink_provider(self) -> HivePageSinkProvider:
+        return HivePageSinkProvider(self)
+
+
+class HivePageSink(PageSink):
+    """SCALED parquet writer (ScaledWriterScheduler +
+    ScaleWriterPartitioningExchanger roles, collapsed to the local sink):
+    appended pages buffer host-side; finish() sizes the writer pool from
+    the OBSERVED data volume — one part file per `writer_target_bytes`
+    of input, up to `max_writers` — and writes the parts on parallel
+    threads.  Rows route through the SkewedPartitionRebalancer on the
+    leading column: same-valued rows cluster into the same part file
+    (better scan locality + row-group stats), but a HOT value's rows
+    spread across extra writers so no writer stalls on skew — exactly
+    the ScaleWriterPartitioningExchanger contract (clustering is a
+    preference, balance is enforced)."""
+
+    def __init__(self, warehouse: str, table: str, columns, overwrite: bool,
+                 writer_target_bytes: int = 32 << 20,
+                 max_writers: int = 8):
+        self.warehouse = warehouse
+        self.table = table
+        self.columns = list(columns)
+        self.overwrite = overwrite
+        self.writer_target_bytes = writer_target_bytes
+        self.max_writers = max_writers
+        self.pages: List[Page] = []
+        self.bytes = 0
+        self.writers_used = 0
+
+    def append(self, page: Page) -> None:
+        self.pages.append(page)
+        for c in page.columns:
+            self.bytes += int(np.asarray(c.values)[: page.count].nbytes)
+
+    def finish(self) -> int:
+        from ..exec.partitioner import concat_pages, take_rows
+
+        tdir = os.path.join(self.warehouse, self.table)
+        if self.overwrite and os.path.isdir(tdir):
+            for f in glob.glob(os.path.join(tdir, "*.parquet")):
+                os.remove(f)
+        if not self.pages:
+            self.writers_used = 0
+            return 0
+        page = concat_pages(self.pages)
+        page = Page(page.columns, page.count, self.columns)
+        nwriters = max(
+            1, min(self.max_writers, -(-self.bytes // self.writer_target_bytes))
+        )
+        self.writers_used = nwriters
+        import threading
+        import time as _time
+
+        stamp = f"{int(_time.time() * 1e6):x}"
+        if nwriters == 1:
+            write_parquet_table(
+                self.warehouse, self.table, page,
+                file_name=f"part-{stamp}-0.parquet",
+            )
+            return page.count
+        from ..exec.partitioner import SkewedPartitionRebalancer
+
+        reb = SkewedPartitionRebalancer(nwriters)
+        parts = reb.partition_page(page, [self.columns[0]])
+        self.rebalancer = reb
+        errors: List[BaseException] = []
+
+        def write_part(w: int):
+            try:
+                sub = parts[w]
+                if sub.count == 0:
+                    return
+                write_parquet_table(
+                    self.warehouse, self.table, sub,
+                    file_name=f"part-{stamp}-{w}.parquet",
+                )
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=write_part, args=(w,))
+            for w in range(nwriters)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return page.count
+
+
+class HivePageSinkProvider(PageSinkProvider):
+    def __init__(self, connector: "HiveConnector"):
+        self.connector = connector
+
+    def create_sink(self, table: str, columns, overwrite: bool = False):
+        return HivePageSink(
+            self.connector.warehouse, table, columns, overwrite,
+            writer_target_bytes=self.connector.writer_target_bytes,
+        )
+
 
 class HiveConnectorFactory(ConnectorFactory):
     """Reference: HiveConnectorFactory — config key hive.warehouse-dir."""
@@ -433,7 +583,12 @@ class HiveConnectorFactory(ConnectorFactory):
         warehouse = config.get("hive.warehouse-dir")
         if not warehouse:
             raise ValueError("hive catalog requires hive.warehouse-dir")
-        return HiveConnector(catalog_name, warehouse)
+        return HiveConnector(
+            catalog_name, warehouse,
+            writer_target_bytes=int(
+                config.get("hive.writer-target-bytes", 32 << 20)
+            ),
+        )
 
 
 def write_parquet_table(

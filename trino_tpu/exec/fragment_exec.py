@@ -89,6 +89,7 @@ class FragmentExecutor(LocalExecutor):
                     dicts.update(hit["dicts"])
                     counts[id(node)] = hit["total"]
                     self._scan_keys[id(node)] = key
+                    self._scan_dictfp[id(node)] = hit.get("dictfp", 0)
                     return
             pages = self.remote_pages.get(node.fragment_id, [])
             local_dicts: Dict[str, np.ndarray] = {}
@@ -101,6 +102,10 @@ class FragmentExecutor(LocalExecutor):
             dicts.update(local_dicts)
             scans[id(node)] = merged
             counts[id(node)] = total
+            from .local import dict_fingerprint
+
+            fp = dict_fingerprint(local_dicts, list(local_dicts))
+            self._scan_dictfp[id(node)] = fp
             if cache is not None:
                 nbytes = sum(
                     int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
@@ -109,7 +114,7 @@ class FragmentExecutor(LocalExecutor):
                 cache.put(
                     key,
                     {"merged": dict(merged), "dicts": local_dicts,
-                     "total": total, "dev": {}},
+                     "total": total, "dev": {}, "dictfp": fp},
                     nbytes,
                 )
                 self._scan_keys[id(node)] = key

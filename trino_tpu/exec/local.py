@@ -176,6 +176,19 @@ def merge_pages_to_arrays(pages, symbols, types, dicts):
     return merged, total
 
 
+def dict_fingerprint(dicts: Dict[str, np.ndarray], symbols) -> int:
+    """Exact content hash of the dictionaries for these symbols (dict
+    codes are baked into traced programs as constants; identical
+    fingerprints are required to share a compiled executable)."""
+    parts = []
+    for s in sorted(symbols):
+        d = dicts.get(s)
+        if d is None:
+            continue
+        parts.append((s, len(d), tuple(str(x) for x in d)))
+    return hash(tuple(parts))
+
+
 def _is_null_expr(e: ir.Expr) -> bool:
     while isinstance(e, ir.Cast):
         e = e.term
@@ -210,6 +223,8 @@ class LocalExecutor:
         # scan-node id -> DeviceScanCache key (None when uncacheable)
         self._scan_keys: Dict[int, tuple] = {}
         self._scan_nodes: Dict[int, P.TableScan] = {}
+        # scan-node id -> dictionary-content fingerprint (jit-key part)
+        self._scan_dictfp: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
@@ -335,10 +350,17 @@ class LocalExecutor:
                     # surface with their real message, not burn the ladder
                     jc = self.config.get("jit_cache")
                     retries = getattr(self, "_jit_fault_retries", 0)
+                    transient = (
+                        "INVALID_ARGUMENT" in str(e)
+                        # remote compile service hiccups (HTTP 500 /
+                        # truncated body) are infra flakes, not program
+                        # errors — retry them the same bounded way
+                        or "remote_compile" in str(e)
+                    )
                     if (
                         use_jit
                         and retries < 3  # at most three fault retries
-                        and "INVALID_ARGUMENT" in str(e)
+                        and transient
                     ):
                         self._jit_fault_retries = retries + 1
                         if jc:
@@ -353,7 +375,9 @@ class LocalExecutor:
                             # are themselves an observed poison source for
                             # later transfers (bench.py keeps sessions
                             # alive for the same reason) — then re-upload.
-                            sc = self.config.get("scan_cache")
+                            sc = self.config.get(
+                                "scan_cache"
+                            ) or getattr(self, "_streaming_cache", None)
                             if sc is not None:
                                 # graveyard lives on the SESSION-lived
                                 # cache object: a per-query list would be
@@ -543,12 +567,10 @@ class LocalExecutor:
         parquet row-group dictionaries).  Results are cached across queries
         when the connector is versioned-cacheable (DeviceScanCache)."""
         cache: Optional[DeviceScanCache] = self.config.get("scan_cache")
-        # key computation can be expensive (hive stats the table files
-        # for data_version): skip it entirely when caching is off
-        key = (
-            self._scan_cache_key(node, splits)
-            if cache is not None else None
-        )
+        # ALWAYS computed (even with caching off): the compiled-fragment
+        # path keys on it, and streaming tiles must stay jitted — hive's
+        # per-TABLE data_version walk is cheap (the table dir only)
+        key = self._scan_cache_key(node, splits)
         if cache is not None and key is not None:
             hit = cache.get(key)
             if hit is not None:
@@ -564,6 +586,7 @@ class LocalExecutor:
                 counts[id(node)] = hit["total"]
                 self._scan_keys[id(node)] = key
                 self._scan_nodes[id(node)] = node
+                self._scan_dictfp[id(node)] = hit.get("dictfp", 0)
                 return
         conn = self.catalogs.get(node.catalog)
         cols = [c for _, c in node.assignments]
@@ -601,6 +624,8 @@ class LocalExecutor:
         scans[id(node)] = merged
         counts[id(node)] = total
         self._scan_keys[id(node)] = key
+        fp = dict_fingerprint(dicts, symbols)
+        self._scan_dictfp[id(node)] = fp
         if cache is not None and key is not None:
             col_of = {s: c for s, c in node.assignments}
             host_merged = {col_of[s]: lane for s, lane in merged.items()}
@@ -615,9 +640,22 @@ class LocalExecutor:
             cache.put(
                 key,
                 {"merged": host_merged, "dicts": host_dicts, "total": total,
-                 "dev": {}},
+                 "dev": {}, "dictfp": fp},
                 nbytes,
             )
+
+    def _jit_scan_component(self, nid):
+        """Per-scan jit-key part: scan-cache key WITHOUT the split list,
+        plus the dictionary-content fingerprint (dict codes are baked
+        into traced programs as constants, so equal fingerprints are
+        REQUIRED for a safe executable share — and sufficient, together
+        with shapes, because the program reads nothing else from the
+        split identity)."""
+        key = self._scan_keys.get(nid)
+        if key is None:
+            return None
+        no_splits = key[:4] + key[5:]
+        return (no_splits, self._scan_dictfp.get(nid))
 
     def _device_lanes(self, node: P.TableScan, arrays, count, nid=None):
         """Pad + upload one scan's host arrays to device lanes, reusing
@@ -626,6 +664,9 @@ class LocalExecutor:
         `nid` keys the scan-keys table for node-less sources (streaming
         RemoteSource inputs, cached per run)."""
         cap = _pad_capacity(count)
+        override = int(self.config.get("scan_cap_override") or 0)
+        if override and isinstance(node, P.TableScan):
+            cap = max(cap, override)
         cache: Optional[DeviceScanCache] = self.config.get(
             "scan_cache"
         ) or getattr(self, "_streaming_cache", None)
@@ -730,22 +771,38 @@ class LocalExecutor:
         cache = self.config.get("jit_cache")
         if cache is None:
             cache = {}
-        prep = {
-            nid: self._device_lanes(self._scan_nodes.get(nid), arrays,
-                                    counts[nid], nid)
-            for nid, arrays in scans.items()
-        }
+        prep = {}
+        for nid, arrays in scans.items():
+            lanes = dict(self._device_lanes(
+                self._scan_nodes.get(nid), arrays, counts[nid], nid
+            ))
+            # the true row count rides as a TRACED scalar: baking it as
+            # a constant would specialize the executable per exact count
+            # (streaming tiles differ by a few rows while sharing the
+            # padded shape — they must share one program)
+            lanes["__count__"] = jnp.asarray(counts[nid], dtype=jnp.int64)
+            prep[nid] = lanes
         key = (
             id(plan), self.group_capacity, self.join_factor,
             getattr(self, "topn_factor", 1),
             getattr(self, "group_salt", 0),
             getattr(self, "force_wide_mul", False),
             frozenset(getattr(self, "force_expansion", ())),
-            # scan-cache keys embed the connector data_version, so a write
-            # that keeps row counts constant still recompiles (and refreshes
-            # the dictionary snapshot)
+            # a compiled program is a pure function of (plan, capacities,
+            # padded lane shapes, BAKED dictionary contents) — NOT of
+            # which splits produced the rows.  The per-scan component is
+            # therefore (row count, version-without-splits, dictionary
+            # fingerprint): streaming tiles with equal tile shapes and
+            # equal (usually empty) dictionaries share one executable,
+            # while a connector write (version bump) or any dictionary
+            # drift still recompiles and refreshes the dict snapshot.
             tuple(sorted(
-                (nid, counts[nid], self._scan_keys.get(nid))
+                (nid,
+                 max(_pad_capacity(counts[nid]),
+                     int(self.config.get("scan_cap_override") or 0)
+                     if isinstance(self._scan_nodes.get(nid), P.TableScan)
+                     else 0),
+                 self._jit_scan_component(nid))
                 for nid in scans
             )),
         )
@@ -869,12 +926,20 @@ class _TraceCtx:
     def _visit_tablescan(self, node: P.TableScan) -> Batch:
         count = self.counts[id(node)]
         cap = _pad_capacity(count)
+        override = int(self.ex.config.get("scan_cap_override") or 0)
+        if override and isinstance(node, P.TableScan):
+            # streaming tiles share one padded shape (and therefore one
+            # compiled program) even when their exact row counts differ
+            cap = max(cap, override)
         if getattr(self, "prepared", False):
-            # jitted-fragment mode: lanes are traced jit arguments
+            # jitted-fragment mode: lanes are traced jit arguments and
+            # the true row count is the traced "__count__" scalar
             lanes = dict(self.scans[id(node)])
+            cnt = lanes.pop("__count__", count)
         else:
             lanes = self.ex._device_lanes(node, self.scans[id(node)], count)
-        sel = jnp.arange(cap) < count
+            cnt = count
+        sel = jnp.arange(cap) < cnt
         return Batch(lanes, sel)
 
     def _visit_values(self, node: P.Values) -> Batch:
@@ -1202,7 +1267,7 @@ class _TraceCtx:
                 "host-staged aggregates cannot split PARTIAL/FINAL"
             )
 
-        def reduce_rows(lanes, gid, sel, cap):
+        def reduce_rows(lanes, gid, sel, cap, seg=None):
             if final:
                 acc_in = {
                     n: lanes[n] for s in specs for n in s.accumulator_names
@@ -1220,6 +1285,7 @@ class _TraceCtx:
                 # true chunked 128-bit sums
                 wide_flags=self.lowering.overflow_flags,
                 force_wide=self.lowering.force_wide_mul,
+                seg=seg,
             )
 
         def out_lanes(accs):
@@ -1252,12 +1318,10 @@ class _TraceCtx:
         if domains is not None:
             gid, cap = agg_ops.direct_group_ids(key_lanes, domains)
             accs = reduce_rows(b.lanes, gid, b.sel, cap)
-            present = (
-                jax.ops.segment_sum(
-                    b.sel.astype(jnp.int64), gid, num_segments=cap
-                )
-                > 0
-            )
+            # _seg_count picks the masked/pallas form at small caps — a
+            # raw segment_sum scatter here cost ~0.4s at SF1 (measured,
+            # MICRO_group.json: scatter 0.58s vs masked 0.08s at 8.4M)
+            present = agg_ops._seg_count(b.sel, gid, cap) > 0
             keys_out = agg_ops.group_keys_output(key_lanes, gid, b.sel, cap)
             host_src = (b.lanes, gid, b.sel)
         else:
@@ -1265,13 +1329,17 @@ class _TraceCtx:
             perm, gid, ngroups = self._group_sort(key_lanes, b.sel, cap)
             self._note_capacity(ngroups, cap)
             sel_sorted = b.sel[perm]
-            sorted_lanes = {
-                s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
-            }
-            accs = reduce_rows(sorted_lanes, gid, sel_sorted, cap)
+            from ..ops.filter_project import permute_lanes
+
+            sorted_lanes = permute_lanes(b.lanes, perm)
+            # gid is SORTED here: one shared run-range computation
+            # replaces per-aggregate scatters (SortedSegments)
+            ss = agg_ops.SortedSegments(gid, cap)
+            accs = reduce_rows(sorted_lanes, gid, sel_sorted, cap, seg=ss)
             present = jnp.arange(cap) < ngroups
             keys_out = agg_ops.group_keys_output(
-                [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap
+                [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap,
+                starts=ss.starts,
             )
             host_src = (sorted_lanes, gid, sel_sorted)
         out = out_lanes(accs)
@@ -1488,9 +1556,9 @@ class _TraceCtx:
             matched = matched & join_ops.verify_rows(
                 rkeys, lkeys, build_row, probe_row
             )
-        lanes = {}
-        for s, (v, ok) in left.lanes.items():
-            lanes[s] = (v[probe_row], ok[probe_row])
+        from ..ops.filter_project import permute_lanes
+
+        lanes = dict(permute_lanes(left.lanes, probe_row))
         for s, (v, ok) in right.lanes.items():
             lanes[s] = (v[build_row], ok[build_row] & matched)
         surviving = matched & psel  # matched is already within-capacity
@@ -1907,13 +1975,46 @@ class _TraceCtx:
                     vs.append(dict_gather(tbl, v, -1).astype(jnp.int32))
                     oks.append(ok)
             else:
+                wide_t = getattr(t, "wide", False)
                 for b, s in zip(batches, src_syms):
                     v, ok = b.lanes[s]
-                    vs.append(v.astype(t.np_dtype))
+                    if wide_t:
+                        # inputs may mix two-limb lanes with narrow
+                        # fast-path lanes of the same wide type
+                        from ..ops.wide_decimal import promote
+
+                        vs.append(promote(v.astype(jnp.int64) if v.ndim == 1 else v))
+                    else:
+                        vs.append(v.astype(t.np_dtype))
                     oks.append(ok)
             lanes[out_sym] = (jnp.concatenate(vs), jnp.concatenate(oks))
         sel = jnp.concatenate([b.sel for b in batches])
         return lanes, sel, caps
+
+    def _setop_tag_reduce(self, node, lanes0, sel, tag, cap):
+        """Shared INTERSECT/EXCEPT membership reduction over tagged
+        rows: group-sort by the full row, per-side presence marks,
+        keep-group predicate, first-of-group dedup.  Used by the local
+        path and (post-repartition) by the mesh path."""
+        key_lanes = [lanes0[s] for s in node.symbols]
+        perm, gid, ngroups = self._group_sort(key_lanes, sel, cap)
+        self._note_capacity(ngroups, cap)
+        sel_sorted = sel[perm]
+        tag_sorted = tag[perm]
+        side0 = agg_ops._seg_count(
+            sel_sorted & (tag_sorted == 0), gid, cap
+        ) > 0
+        side1 = agg_ops._seg_count(
+            sel_sorted & (tag_sorted == 1), gid, cap
+        ) > 0
+        keep_group = (
+            side0 & side1 if node.kind == "intersect" else side0 & ~side1
+        )
+        boundary = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
+        )
+        lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes0.items()}
+        return Batch(lanes, sel_sorted & boundary & keep_group[gid])
 
     def _intersect_except(self, node: P.SetOperation) -> Batch:
         if node.all:
@@ -1926,34 +2027,7 @@ class _TraceCtx:
             jnp.zeros(caps[0], dtype=jnp.int32),
             jnp.ones(caps[1], dtype=jnp.int32),
         ])
-        cap = sel.shape[0]
-        key_lanes = [lanes0[s] for s in node.symbols]
-        perm, gid, ngroups = self._group_sort(key_lanes, sel, cap)
-        self._note_capacity(ngroups, cap)
-        sel_sorted = sel[perm]
-        tag_sorted = tag[perm]
-        side0 = (
-            jax.ops.segment_sum(
-                (sel_sorted & (tag_sorted == 0)).astype(jnp.int32), gid,
-                num_segments=cap,
-            )
-            > 0
-        )
-        side1 = (
-            jax.ops.segment_sum(
-                (sel_sorted & (tag_sorted == 1)).astype(jnp.int32), gid,
-                num_segments=cap,
-            )
-            > 0
-        )
-        keep_group = (
-            side0 & side1 if node.kind == "intersect" else side0 & ~side1
-        )
-        boundary = jnp.concatenate(
-            [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
-        )
-        lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes0.items()}
-        return Batch(lanes, sel_sorted & boundary & keep_group[gid])
+        return self._setop_tag_reduce(node, lanes0, sel, tag, sel.shape[0])
 
 
 LocalExecutor.trace_ctx_cls = _TraceCtx

@@ -134,3 +134,81 @@ def concat_pages(pages: List[Page]) -> Page:
             )
         )
     return Page(cols, sum(p.count for p in pages), first.names)
+
+
+class SkewedPartitionRebalancer:
+    """Skew-aware partition assignment for scaled writes.
+
+    Reference parity: operator/output/SkewedPartitionRebalancer.java:55 +
+    ScaleWriterPartitioningExchanger — when one partition receives a
+    disproportionate share of the rows, it is assigned EXTRA writers and
+    its rows round-robin across them.  Writer affinity is a clustering
+    preference for writes, not a correctness requirement, so splitting a
+    hot partition is safe (the reference applies the same relaxation).
+
+    Stateful across pages: observed per-partition row counts accumulate,
+    and every `rebalance_interval` rows the hottest partitions (above
+    `skew_factor` x the mean) get one more bucket each, drawn from the
+    least-loaded buckets.
+    """
+
+    def __init__(self, nparts: int, skew_factor: float = 2.0,
+                 rebalance_interval: int = 65536):
+        self.nparts = nparts
+        self.skew_factor = skew_factor
+        self.rebalance_interval = rebalance_interval
+        self.part_rows = np.zeros(nparts, dtype=np.int64)
+        self.bucket_rows = np.zeros(nparts, dtype=np.int64)
+        # partition -> list of buckets its rows cycle through
+        self.assignments: List[List[int]] = [[p] for p in range(nparts)]
+        self._since_rebalance = 0
+        self._rr = np.zeros(nparts, dtype=np.int64)
+
+    def scaled_partitions(self) -> List[int]:
+        return [p for p, a in enumerate(self.assignments) if len(a) > 1]
+
+    def _maybe_rebalance(self):
+        if self._since_rebalance < self.rebalance_interval:
+            return
+        self._since_rebalance = 0
+        total = self.part_rows.sum()
+        if total == 0:
+            return
+        mean = total / self.nparts
+        for p in np.argsort(-self.part_rows):
+            if self.part_rows[p] <= self.skew_factor * mean:
+                break
+            if len(self.assignments[p]) >= self.nparts:
+                continue
+            # grant the least-loaded bucket not already assigned
+            for b in np.argsort(self.bucket_rows):
+                if int(b) not in self.assignments[p]:
+                    self.assignments[p].append(int(b))
+                    break
+
+    def assign(self, page: Page, keys: Sequence[str]) -> np.ndarray:
+        """Per-row OUTPUT bucket; hot partitions cycle their buckets."""
+        part = (
+            hash_rows(page, keys) % np.uint64(self.nparts)
+        ).astype(np.int64)
+        np.add.at(self.part_rows, part, 1)
+        self._since_rebalance += page.count
+        self._maybe_rebalance()
+        bucket = part.copy()
+        for p in self.scaled_partitions():
+            rows = np.nonzero(part == p)[0]
+            if len(rows) == 0:
+                continue
+            buckets = np.array(self.assignments[p], dtype=np.int64)
+            offs = (self._rr[p] + np.arange(len(rows))) % len(buckets)
+            bucket[rows] = buckets[offs]
+            self._rr[p] += len(rows)
+        np.add.at(self.bucket_rows, bucket, 1)
+        return bucket
+
+    def partition_page(self, page: Page, keys: Sequence[str]) -> List[Page]:
+        bucket = self.assign(page, keys)
+        return [
+            take_rows(page, np.nonzero(bucket == b)[0])
+            for b in range(self.nparts)
+        ]

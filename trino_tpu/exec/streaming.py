@@ -199,11 +199,23 @@ def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Pag
             ntiles = max(1, math.ceil(est / budget))
             splits = conn.split_manager().get_splits(tab, ntiles, cons)
             per = max(1, math.ceil(len(splits) / ntiles))
+            # one padded shape for (almost) all tiles -> one compiled
+            # program; generous slack so row-count jitter stays inside
+            try:
+                rows = conn.metadata().get_table_statistics(tab).row_count
+            except Exception:  # noqa: BLE001
+                rows = 0
+            est_tile_rows = int(rows * per / max(len(splits), 1) * 1.3)
+            from .local import _pad_capacity
+
+            est_tile_rows = _pad_capacity(max(est_tile_rows, 128))
             out: List[Page] = []
-            fe = None
             for i in range(0, len(splits), per):
+                cfg = tile_config()
+                if est_tile_rows:
+                    cfg["scan_cap_override"] = est_tile_rows
                 fe = FragmentExecutor(
-                    executor.catalogs, tile_config(),
+                    executor.catalogs, cfg,
                     {idx: splits[i : i + per]}, remote,
                 )
                 fe._streaming_cache = run_cache

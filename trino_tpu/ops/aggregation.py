@@ -605,14 +605,10 @@ def _percentile(lane: Lane, sel, gid, cap, frac: float):
     )
     pick = live2 & (rank == target[g2c])
     if v2.dtype.kind == "f":
-        out = jax.ops.segment_max(
-            jnp.where(pick, v2, -jnp.inf), g2c, num_segments=cap
-        )
+        out = _seg_max(jnp.where(pick, v2, -jnp.inf), g2c, cap)
         out = jnp.where(cnt > 0, out, 0.0)
     else:
-        out = jax.ops.segment_max(
-            jnp.where(pick, v2, -I64_MAX), g2c, num_segments=cap
-        )
+        out = _seg_max(jnp.where(pick, v2, -I64_MAX), g2c, cap)
         out = jnp.where(cnt > 0, out, 0)
     return out.astype(v.dtype) if v.dtype.kind != "f" else out, cnt > 0
 
@@ -635,6 +631,73 @@ def _moment_sums(v, live, gid, cap, in_t):
     )
 
 
+class SortedSegments:
+    """Scatter-free grouped reductions over a SORTED gid lane (the
+    hash-sort grouping path: rows arrive permuted so equal groups are
+    adjacent, gid non-decreasing).
+
+    XLA:TPU scatter runs ~16M updates/s regardless of sortedness hints
+    (MICRO_group.json), so at capacities beyond the masked-matrix range
+    every accumulator cost ~0.5s at SF1.  Sorted runs instead admit:
+      - ONE extra single-key sort (merge_rank of arange(cap) into the
+        sorted gids) shared by all aggregates, giving each group's
+        [start, end) row range, then
+      - per-aggregate cumsum + two cap-sized gathers (sums/counts) or a
+        segmented scan + end-gather (min/max) — all bandwidth-bound.
+    """
+
+    def __init__(self, gid: jnp.ndarray, cap: int):
+        from .join import merge_rank
+
+        self.gid = gid
+        self.cap = cap
+        self.n = gid.shape[0]
+        probe = jnp.arange(cap, dtype=jnp.int64)
+        self.starts = merge_rank(gid, probe, side="left")
+        self.ends = merge_rank(gid, probe, side="right")
+        self.counts_all = self.ends - self.starts  # incl. non-live rows
+
+    def _range_diff(self, cs: jnp.ndarray) -> jnp.ndarray:
+        """cs = inclusive prefix over rows -> per-group range totals."""
+        zero = jnp.zeros(1, dtype=cs.dtype)
+        cs0 = jnp.concatenate([zero, cs])  # cs0[i] = sum of rows < i
+        return cs0[self.ends] - cs0[self.starts]
+
+    def sum(self, v: jnp.ndarray) -> jnp.ndarray:
+        return self._range_diff(jnp.cumsum(v))
+
+    def count(self, mask: jnp.ndarray) -> jnp.ndarray:
+        return self._range_diff(jnp.cumsum(mask.astype(jnp.int64)))
+
+    def _scan_extreme(self, v: jnp.ndarray, take_min: bool) -> jnp.ndarray:
+        boundary = jnp.concatenate(
+            [jnp.ones(1, bool), self.gid[1:] != self.gid[:-1]]
+        )
+        op = jnp.minimum if take_min else jnp.maximum
+
+        def combine(a, b):
+            f1, v1 = a
+            f2, v2 = b
+            return (f1 | f2, jnp.where(f2, v2, op(v1, v2)))
+
+        _, run = jax.lax.associative_scan(combine, (boundary, v))
+        # group extremum lands at each run's LAST row = ends-1
+        last = jnp.clip(self.ends - 1, 0, self.n - 1)
+        return run[last]
+
+    def min(self, v: jnp.ndarray) -> jnp.ndarray:
+        return self._scan_extreme(v, True)
+
+    def max(self, v: jnp.ndarray) -> jnp.ndarray:
+        return self._scan_extreme(v, False)
+
+
+# aggregate kinds the SortedSegments fast path covers; others fall back
+# to the generic segment ops
+SORTED_FAST_KINDS = ("sum", "avg", "count", "count_star", "count_if",
+                     "min", "max")
+
+
 def accumulate(
     specs: Sequence[AggSpec],
     lanes: Dict[str, Lane],
@@ -645,6 +708,7 @@ def accumulate(
     overflow_flags: Optional[list] = None,
     wide_flags: Optional[list] = None,
     force_wide: bool = True,
+    seg: Optional["SortedSegments"] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Compute accumulator arrays (shape [capacity]) per spec.
 
@@ -658,6 +722,25 @@ def accumulate(
     always-exact (slower) chunked default via force_wide=True."""
     out: Dict[str, jnp.ndarray] = {}
     cap = capacity
+
+    # Scatter-free sorted-run reductions when the caller's gid is sorted
+    # (hash-sort grouping).  Integer-only for sums: float range-diffs
+    # would trade scatter cost for cancellation error.
+    def seg_cnt(mask):
+        if seg is not None:
+            return seg.count(mask)
+        return _seg_count(mask, gid, cap)
+
+    def seg_isum(vv):
+        if seg is not None and vv.dtype.kind != "f":
+            return seg.sum(vv)
+        return _seg_sum(vv, gid, cap)
+
+    def seg_ext(vv, take_min):
+        if seg is not None and vv.dtype.kind != "f":
+            return seg.min(vv) if take_min else seg.max(vv)
+        return (_seg_min if take_min else _seg_max)(vv, gid, cap)
+
     for s in specs:
         o = s.output
         if getattr(s, "distinct", False):
@@ -666,15 +749,15 @@ def accumulate(
             out[f"{o}$count"] = distinct_count(gid, lanes[s.input], sel, cap)
             continue
         if s.kind == "count_star":
-            out[f"{o}$count"] = _seg_count(sel, gid, cap)
+            out[f"{o}$count"] = seg_cnt(sel)
             continue
         v, ok = lanes[s.input]
         live = sel & ok
         if s.kind == "count":
-            out[f"{o}$count"] = _seg_count(live, gid, cap)
+            out[f"{o}$count"] = seg_cnt(live)
         elif s.kind == "count_if":
             hit = live & (v.astype(bool))
-            out[f"{o}$count"] = _seg_count(hit, gid, cap)
+            out[f"{o}$count"] = seg_cnt(hit)
         elif s.kind == "approx_distinct":
             if step == "single":
                 out[f"{o}$count"] = distinct_count(gid, (v, ok), sel, cap)
@@ -687,7 +770,7 @@ def accumulate(
                 for i, arr in packed.items():
                     out[f"{o}$hll{i}"] = arr
         elif s.kind in ("sum", "avg"):
-            cnt = _seg_count(live, gid, cap)
+            cnt = seg_cnt(live)
             if s._wide_sum:
                 # exact 128-bit decimal sum with a NARROW fast path: the
                 # accumulator SCHEMA is always four 32-bit chunk lanes
@@ -709,7 +792,7 @@ def accumulate(
                     cs = wd.seg_sum_chunks(chunks, gid, cap)
                 else:
                     vv = jnp.where(live, v.astype(jnp.int64), 0)
-                    ssum = _seg_sum(vv, gid, cap)
+                    ssum = seg_isum(vv)
                     if wide_flags is not None and _sum_could_overflow(
                         v.shape[0], s.input_type
                     ):
@@ -726,7 +809,7 @@ def accumulate(
                 vv = jnp.where(live, v, 0.0)
             else:
                 vv = jnp.where(live, v.astype(jnp.int64), 0)
-            ssum = _seg_sum(vv, gid, cap)
+            ssum = seg_isum(vv)
             if (
                 v.dtype.kind != "f"
                 and overflow_flags is not None
@@ -754,9 +837,8 @@ def accumulate(
             else:
                 sentinel = I64_MAX if s.kind == "min" else -I64_MAX
                 vv = jnp.where(live, v.astype(jnp.int64), sentinel)
-            seg = _seg_min if s.kind == "min" else _seg_max
-            out[f"{o}$val"] = seg(vv, gid, cap)
-            out[f"{o}$valid"] = _seg_count(live, gid, cap)
+            out[f"{o}$val"] = seg_ext(vv, s.kind == "min")
+            out[f"{o}$valid"] = seg_cnt(live)
         elif s.kind in MOMENT_KINDS:
             sm, sq, cnt = _moment_sums(v, live, gid, cap, s.input_type)
             out[f"{o}$sum"], out[f"{o}$sumsq"], out[f"{o}$count"] = sm, sq, cnt
@@ -1210,9 +1292,19 @@ def group_keys_output(
     gid: jnp.ndarray,
     sel: jnp.ndarray,
     capacity: int,
+    starts: Optional[jnp.ndarray] = None,
 ) -> List[Lane]:
-    """Representative key values per group id (first selected row wins)."""
+    """Representative key values per group id (first selected row wins).
+    With `starts` (sorted-gid run starts from SortedSegments), the
+    representative is simply the run-head row — no segment pass."""
     n = gid.shape[0]
+    if starts is not None:
+        present = starts < n
+        safe = jnp.clip(starts, 0, n - 1)
+        out = []
+        for v, ok in key_lanes:
+            out.append((v[safe], ok[safe] & present & sel[safe]))
+        return out
     first = _seg_min(
         jnp.where(sel, jnp.arange(n, dtype=jnp.int64), n), gid, capacity
     )

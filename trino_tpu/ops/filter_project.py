@@ -40,3 +40,47 @@ def compile_filter_project(
         return out, sel
 
     return apply
+
+
+def permute_lanes(
+    lanes: Dict[str, Lane], idx: jnp.ndarray, extra_ok=None
+) -> Dict[str, Lane]:
+    """Gather every lane at `idx` via per-dtype STACKED matrix gathers.
+
+    XLA:TPU random gather is per-element-overhead bound (~36M elem/s
+    measured); one (n, k) row gather over k stacked columns runs ~2.4x
+    faster than k column gathers (MICRO gmicro: 4x i64 0.68s separate
+    vs 0.32s stacked at 8.4M).  Lanes are grouped by dtype, stacked,
+    row-gathered once, and unstacked; wide (two-limb) lanes contribute
+    their limbs as two stack columns.  `extra_ok` optionally ANDs a
+    mask into every validity lane (join `matched`)."""
+    groups: Dict[object, list] = {}  # dtype -> [(key, array, kind)]
+    for s, (v, ok) in lanes.items():
+        if v.ndim == 2:  # wide decimal limbs
+            groups.setdefault(v.dtype, []).append(((s, "v0"), v[:, 0]))
+            groups.setdefault(v.dtype, []).append(((s, "v1"), v[:, 1]))
+        else:
+            groups.setdefault(v.dtype, []).append(((s, "v"), v))
+        groups.setdefault(jnp.dtype(bool), []).append(((s, "ok"), ok))
+    got: Dict[object, jnp.ndarray] = {}
+    for dt, items in groups.items():
+        if len(items) == 1:
+            key, arr = items[0]
+            got[key] = arr[idx]
+            continue
+        mat = jnp.stack([a for _, a in items], axis=1)
+        taken = mat[idx, :]
+        for i, (key, _) in enumerate(items):
+            got[key] = taken[:, i]
+    out: Dict[str, Lane] = {}
+    for s, (v, ok) in lanes.items():
+        okg = got[(s, "ok")]
+        if extra_ok is not None:
+            okg = okg & extra_ok
+        if v.ndim == 2:
+            out[s] = (
+                jnp.stack([got[(s, "v0")], got[(s, "v1")]], axis=-1), okg
+            )
+        else:
+            out[s] = (got[(s, "v")], okg)
+    return out

@@ -74,11 +74,11 @@ def merge_rank(sorted_build: jnp.ndarray, probe: jnp.ndarray, side: str):
         is_build = perm < nb
         probe_idx = jnp.where(is_build, m, perm - nb)
     cb = jnp.cumsum(is_build.astype(jnp.int64))
-    return (
-        jnp.zeros(m, dtype=jnp.int64)
-        .at[probe_idx]
-        .set(cb, mode="drop")
-    )
+    # route each cb back to its probe row by SORTING on probe_idx
+    # (probes get 0..m-1, build rows sink at m): a scatter here cost
+    # ~0.6s at 10M (XLA:TPU ~16M updates/s) vs ~0.15s for the sort
+    _, back = jax.lax.sort((probe_idx, cb), num_keys=1)
+    return back[:m]
 
 
 class LookupSource(NamedTuple):
@@ -122,11 +122,11 @@ def probe(
 def gather_build(
     build_cols: Dict[str, Lane], build_row: jnp.ndarray, matched: jnp.ndarray
 ) -> Dict[str, Lane]:
-    """Materialize build-side payload lanes for each probe row."""
-    out = {}
-    for name, (v, ok) in build_cols.items():
-        out[name] = (v[build_row], ok[build_row] & matched)
-    return out
+    """Materialize build-side payload lanes for each probe row (one
+    stacked row-gather per dtype — see filter_project.permute_lanes)."""
+    from .filter_project import permute_lanes
+
+    return permute_lanes(build_cols, build_row, extra_ok=matched)
 
 
 class MultiLookupSource(NamedTuple):
@@ -154,20 +154,27 @@ def probe_counts(
     v, ok = key
     pk = v.astype(jnp.int64)
     lo = merge_rank(source.sorted_keys, pk, side="left")
-    # hi = lo + the run length of the matching key (saves a second sort):
-    # run lengths of the sorted build keys via run-id segment sizes
+    # hi = lo + the run length of the matching key.  Run lengths come
+    # from two prefix scans over the SORTED build keys — a segment_sum
+    # at build-capacity here measured ~0.5s at 8M rows (XLA:TPU scatter
+    # ~16M updates/s), while the scan form is bandwidth-bound:
+    #   run_start[i] = index of i's run head   (cummax of boundary idx)
+    #   run_len[i]   = run_end[i] - run_start[i] + 1 (reverse cummin)
     nb = source.sorted_keys.shape[0]
     boundary = jnp.concatenate(
         [jnp.ones(1, bool),
          source.sorted_keys[1:] != source.sorted_keys[:-1]]
     )
-    run_id = jnp.cumsum(boundary.astype(jnp.int64)) - 1
-    run_sizes = jax.ops.segment_sum(
-        jnp.ones(nb, dtype=jnp.int64), run_id, num_segments=nb
+    idx = jnp.arange(nb, dtype=jnp.int64)
+    run_start = jax.lax.cummax(jnp.where(boundary, idx, 0))
+    nxt = jnp.concatenate([boundary[1:], jnp.ones(1, bool)])
+    run_end = jax.lax.cummin(
+        jnp.where(nxt, idx, nb - 1), reverse=True
     )
+    run_len = run_end - run_start + 1
     safe = jnp.clip(lo, 0, nb - 1)
     eq = source.sorted_keys[safe] == pk
-    hi = jnp.where(eq, lo + run_sizes[run_id[safe]], lo)
+    hi = jnp.where(eq, lo + run_len[safe], lo)
     lo = jnp.minimum(lo, source.nvalid)
     hi = jnp.minimum(hi, source.nvalid)
     counts = jnp.where(sel & ok, hi - lo, 0).astype(jnp.int64)

@@ -68,8 +68,9 @@ def _negate_for_desc(v: jnp.ndarray) -> jnp.ndarray:
 def apply_perm(
     lanes: Dict[str, Lane], perm: jnp.ndarray, sel: jnp.ndarray
 ) -> Tuple[Dict[str, Lane], jnp.ndarray]:
-    out = {n: (v[perm], ok[perm]) for n, (v, ok) in lanes.items()}
-    return out, sel[perm]
+    from .filter_project import permute_lanes
+
+    return permute_lanes(lanes, perm), sel[perm]
 
 
 # python int, not a jnp scalar: module-level jnp constants become

@@ -406,12 +406,7 @@ class _MeshTraceCtx(_TraceCtx):
                 wide_flags=self.lowering.overflow_flags,
                 force_wide=self.lowering.force_wide_mul,
             )
-            present_local = (
-                jax.ops.segment_sum(
-                    b.sel.astype(jnp.int64), gid, num_segments=cap
-                )
-                > 0
-            )
+            present_local = agg_ops._seg_count(b.sel, gid, cap) > 0
             # exchange: dense accumulators are psum-able (partial->final)
             accs = self._psum_accs(specs, accs)
             present = jax.lax.psum(present_local.astype(jnp.int32), AXIS) > 0
@@ -427,15 +422,18 @@ class _MeshTraceCtx(_TraceCtx):
             sorted_lanes = {
                 s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
             }
+            ss = agg_ops.SortedSegments(gid, cap)
             accs = agg_ops.accumulate(
                 specs, sorted_lanes, gid, sel_sorted, cap, step="partial",
                 overflow_flags=self.sum_overflow,
                 wide_flags=self.lowering.overflow_flags,
                 force_wide=self.lowering.force_wide_mul,
+                seg=ss,
             )
             present_local = jnp.arange(cap) < ngroups
             keys_local = agg_ops.group_keys_output(
-                [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap
+                [sorted_lanes[k] for k in node.keys], gid, sel_sorted, cap,
+                starts=ss.starts,
             )
             acc_lanes = {
                 name: (_agather(arr), jnp.ones(arr.shape[0] * self._ndev(), bool))
@@ -776,6 +774,7 @@ class _MeshTraceCtx(_TraceCtx):
             raise ExecutionError(
                 f"{node.kind.upper()} ALL not supported (DISTINCT only)"
             )
+        assert len(node.inputs) == 2
         batches = [self.visit(i) for i in node.inputs]
         if all(b.replicated for b in batches):
             saved_visit = self.visit
@@ -818,37 +817,11 @@ class _MeshTraceCtx(_TraceCtx):
         )
         self._note_capacity(mx, chunk, "join")
         tag2, _ = lanes2.pop("__tag__")
-        cap = sel2.shape[0]
-        key2 = [lanes2[s] for s in node.symbols]
-        perm, gid, ngroups = self._group_sort(key2, sel2, cap)
-        self._note_capacity(ngroups, cap)
-        sel_sorted = sel2[perm]
-        tag_sorted = tag2[perm]
-        side0 = (
-            jax.ops.segment_sum(
-                (sel_sorted & (tag_sorted == 0)).astype(jnp.int32), gid,
-                num_segments=cap,
-            )
-            > 0
+        out = self._setop_tag_reduce(
+            node, lanes2, sel2, tag2, sel2.shape[0]
         )
-        side1 = (
-            jax.ops.segment_sum(
-                (sel_sorted & (tag_sorted == 1)).astype(jnp.int32), gid,
-                num_segments=cap,
-            )
-            > 0
-        )
-        keep_group = (
-            side0 & side1 if node.kind == "intersect" else side0 & ~side1
-        )
-        boundary = jnp.concatenate(
-            [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
-        )
-        lanes3 = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes2.items()}
-        return Batch(
-            lanes3, sel_sorted & boundary & keep_group[gid],
-            replicated=False,
-        )
+        out.replicated = False
+        return out
 
     def _visit_setoperation(self, node: P.SetOperation) -> Batch:
         if node.kind in ("intersect", "except"):
