@@ -387,3 +387,37 @@ def test_mesh_intersect_except(session, mesh_exec):
         "except select c_custkey from customer where c_acctbal < 0 "
         "order by o_custkey",
     )
+
+
+def test_partitioned_semijoin(session, mesh_exec):
+    """Large filtering sides hash-repartition both semi sides instead
+    of broadcasting (partitioned SemiJoinNode distribution)."""
+    from trino_tpu.parallel import mesh_executor as me
+
+    calls = []
+    orig = me._MeshTraceCtx._partitioned_semijoin
+
+    def spy(self, *a):
+        calls.append(1)
+        return orig(self, *a)
+
+    me._MeshTraceCtx._partitioned_semijoin = spy
+    old_thresh = mesh_exec.config.get("broadcast_join_threshold_rows")
+    mesh_exec.config["broadcast_join_threshold_rows"] = 1
+    try:
+        run_both(
+            session, mesh_exec,
+            "select o_orderkey from orders where o_custkey in "
+            "(select c_custkey from customer where c_acctbal > 0) "
+            "order by o_orderkey limit 50",
+        )
+        run_both(
+            session, mesh_exec,
+            "select o_orderkey from orders where o_custkey not in "
+            "(select c_custkey from customer where c_acctbal > 5000) "
+            "order by o_orderkey limit 50",
+        )
+    finally:
+        me._MeshTraceCtx._partitioned_semijoin = orig
+        mesh_exec.config["broadcast_join_threshold_rows"] = old_thresh
+    assert calls, "partitioned semi join never engaged"

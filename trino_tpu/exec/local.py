@@ -425,10 +425,7 @@ class LocalExecutor:
                     for sv in sflag_vals:
                         if int(sv) > 0:
                             raise ExecutionError(
-                                "sum overflows the 18-digit decimal/"
-                                "bigint accumulator (decimal(38) storage "
-                                "is not implemented yet); rewrite with a "
-                                "smaller scale or pre-aggregate"
+                                "sum overflows the bigint accumulator"
                             )
                     break
                 if "group" in over_kinds:
@@ -653,7 +650,10 @@ class LocalExecutor:
         split identity)."""
         key = self._scan_keys.get(nid)
         if key is None:
-            return None
+            # keyless sources (RemoteSource without a streaming cache)
+            # still carry baked dictionaries: the fingerprint must stay
+            # in the component or executables could outlive dict drift
+            return (None, self._scan_dictfp.get(nid))
         no_splits = key[:4] + key[5:]
         return (no_splits, self._scan_dictfp.get(nid))
 
@@ -893,8 +893,9 @@ class _TraceCtx:
         self.capacity_checks: List[Tuple[jnp.ndarray, int]] = []
         self.dup_checks: List[Tuple[P.PlanNode, jnp.ndarray]] = []
         self.collision_checks: List[jnp.ndarray] = []
-        # int64 sum-accumulator overflow flags (no decimal(38) storage
-        # yet: wrap -> loud ExecutionError, never silent wrong sums)
+        # BIGINT sum-accumulator overflow flags (decimal sums are exact
+        # via wide chunk accumulators; bigint wrap raises loudly per SQL
+        # semantics, never silently)
         self.sum_overflow: List[jnp.ndarray] = []
         self.lowering = LoweringContext(ex.dicts)
         self.lowering.force_wide_mul = getattr(ex, 'force_wide_mul', False)
@@ -2013,7 +2014,9 @@ class _TraceCtx:
         boundary = jnp.concatenate(
             [jnp.ones(1, dtype=bool), gid[1:] != gid[:-1]]
         )
-        lanes = {s: (v[perm], ok[perm]) for s, (v, ok) in lanes0.items()}
+        from ..ops.filter_project import permute_lanes
+
+        lanes = permute_lanes(lanes0, perm)
         return Batch(lanes, sel_sorted & boundary & keep_group[gid])
 
     def _intersect_except(self, node: P.SetOperation) -> Batch:

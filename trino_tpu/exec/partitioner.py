@@ -54,6 +54,14 @@ def hash_rows(page: Page, keys: Sequence[str]) -> np.ndarray:
                 dict_hash[safe] if len(dict_hash) else np.uint64(0),
                 np.uint64(0),
             )
+        elif vals.ndim == 2:
+            # wide (two-limb) decimal: fold both limbs into one chunk
+            with np.errstate(over="ignore"):
+                ch = _mix64(
+                    vals[:, 0].astype(np.int64).view(np.uint64)
+                    ^ (vals[:, 1].astype(np.int64).view(np.uint64)
+                       * np.uint64(0x9E3779B97F4A7C15))
+                )
         elif vals.dtype.kind == "f":
             ch = _mix64(vals.view(np.uint64) if vals.dtype == np.float64
                         else vals.astype(np.float64).view(np.uint64))
@@ -207,8 +215,16 @@ class SkewedPartitionRebalancer:
         return bucket
 
     def partition_page(self, page: Page, keys: Sequence[str]) -> List[Page]:
-        bucket = self.assign(page, keys)
+        """Feed the page through assign() in rebalance_interval-sized
+        chunks so hot partitions can escalate to MULTIPLE extra buckets
+        within one large write (a single assign call would rebalance at
+        most once)."""
+        buckets = np.empty(page.count, dtype=np.int64)
+        step = self.rebalance_interval
+        for start in range(0, page.count, step):
+            idx = np.arange(start, min(start + step, page.count))
+            buckets[idx] = self.assign(take_rows(page, idx), keys)
         return [
-            take_rows(page, np.nonzero(bucket == b)[0])
+            take_rows(page, np.nonzero(buckets == b)[0])
             for b in range(self.nparts)
         ]

@@ -178,9 +178,7 @@ class MeshExecutor(LocalExecutor):
                 for sv in sflags:
                     if int(sv) > 0:
                         raise ExecutionError(
-                            "sum overflows the 18-digit decimal/bigint "
-                            "accumulator (decimal(38) storage is not "
-                            "implemented yet)"
+                            "sum overflows the bigint accumulator"
                         )
                 break
             if "group" in over_kinds:
@@ -419,9 +417,9 @@ class _MeshTraceCtx(_TraceCtx):
             perm, gid, ngroups = self._group_sort(key_lanes, b.sel, cap)
             self._note_capacity(ngroups, cap)
             sel_sorted = b.sel[perm]
-            sorted_lanes = {
-                s: (v[perm], ok[perm]) for s, (v, ok) in b.lanes.items()
-            }
+            from ..ops.filter_project import permute_lanes
+
+            sorted_lanes = permute_lanes(b.lanes, perm)
             ss = agg_ops.SortedSegments(gid, cap)
             accs = agg_ops.accumulate(
                 specs, sorted_lanes, gid, sel_sorted, cap, step="partial",
@@ -540,17 +538,7 @@ class _MeshTraceCtx(_TraceCtx):
             return True
         if node.distribution == "broadcast":
             return False
-        from ..config import BROADCAST_JOIN_THRESHOLD_ROWS
-
-        threshold = int(
-            self.ex.config.get(
-                "broadcast_join_threshold_rows",
-                BROADCAST_JOIN_THRESHOLD_ROWS,
-            )
-        )
-        # shape[0] is the per-device shard capacity; the threshold is total
-        # build rows, so broadcasting replicates ndev * shape[0] rows
-        return right.sel.shape[0] * self._ndev() >= threshold
+        return self._exceeds_broadcast_threshold(right)
 
     def _partitioned_join(self, node: P.Join, left: Batch, right: Batch):
         """HASH-HASH distribution: all-to-all both sides on the join keys,
@@ -590,6 +578,13 @@ class _MeshTraceCtx(_TraceCtx):
     def _visit_semijoin(self, node: P.SemiJoin) -> Batch:
         src = self.visit(node.source)
         filt = self.visit(node.filtering)
+        if (
+            not src.replicated
+            and not filt.replicated
+            and node.filter is None
+            and self._semi_use_partitioned(filt)
+        ):
+            return self._partitioned_semijoin(node, src, filt)
         if not filt.replicated:
             # broadcast the filtering side (dynamic-filter style exchange)
             filt = _gather_batch(filt)
@@ -597,6 +592,57 @@ class _MeshTraceCtx(_TraceCtx):
         lanes = dict(src.lanes)
         lanes[node.output] = (hit, jnp.ones(hit.shape, bool))
         return Batch(lanes, src.sel, src.ordered, src.replicated)
+
+    def _semi_use_partitioned(self, filt: Batch) -> bool:
+        return self._exceeds_broadcast_threshold(filt)
+
+    def _exceeds_broadcast_threshold(self, build: Batch) -> bool:
+        from ..config import BROADCAST_JOIN_THRESHOLD_ROWS
+
+        threshold = int(
+            self.ex.config.get(
+                "broadcast_join_threshold_rows",
+                BROADCAST_JOIN_THRESHOLD_ROWS,
+            )
+        )
+        # shape[0] is the per-device shard capacity; the threshold is
+        # total build rows, so broadcasting replicates ndev * shape[0]
+        return build.sel.shape[0] * self._ndev() >= threshold
+
+    def _partitioned_semijoin(
+        self, node: P.SemiJoin, src: Batch, filt: Batch
+    ) -> Batch:
+        """HASH-HASH semi join: repartition BOTH sides on the semi keys
+        and mark locally per hash range (the reference's partitioned
+        SemiJoinNode distribution).  NULL-key source rows route to a
+        stable device (they match nothing but must still emit their
+        mark=false row); the output stays distributed."""
+        ndev = self._ndev()
+        skeys = [src.lanes[k] for k in node.source_keys]
+        fkeys = [filt.lanes[k] for k in node.filtering_keys]
+        joint = join_ops.needs_verification(
+            skeys
+        ) or join_ops.needs_verification(fkeys)
+        sbuck, sok = shuffle.bucket_of(skeys, src.sel, ndev, joint)
+        fbuck, fok = shuffle.bucket_of(fkeys, filt.sel, ndev, joint)
+        sbuck = jnp.where(sok, sbuck, 0)
+        factor = getattr(self.ex, "join_factor", 1)
+        schunk = _shuffle_chunk(src.sel.shape[0], ndev, factor)
+        fchunk = _shuffle_chunk(filt.sel.shape[0], ndev, factor)
+        slanes, ssel, smax = shuffle.repartition(
+            src.lanes, src.sel, sbuck, src.sel, ndev, schunk, AXIS
+        )
+        flanes, fsel, fmax = shuffle.repartition(
+            filt.lanes, filt.sel, fbuck, filt.sel & fok, ndev, fchunk, AXIS
+        )
+        self._note_capacity(smax, schunk, "join")
+        self._note_capacity(fmax, fchunk, "join")
+        src2 = Batch(slanes, ssel, replicated=False)
+        filt2 = Batch(flanes, fsel, replicated=False)
+        hit = self._semi_hit(node, src2, filt2)
+        lanes = dict(src2.lanes)
+        lanes[node.output] = (hit, jnp.ones(hit.shape, bool))
+        return Batch(lanes, src2.sel, replicated=False)
 
     def _visit_scalarjoin(self, node: P.ScalarJoin) -> Batch:
         src = self.visit(node.source)
