@@ -285,7 +285,7 @@ def main():
         # device working set (host RAM is the exchange tier) — this
         # demonstrates no-OOM completion, not steady bandwidth (tiles
         # re-generate host-side every iteration)
-        s = tpch_session(10.0, query_max_memory_bytes=6 << 30)
+        s = tpch_session(10.0, query_max_memory_bytes=4 << 30)
         r = _time_config(s, Q3, _table_rows(s, "lineitem"), 1)
         _drop_session(s)
         return r

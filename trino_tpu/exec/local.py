@@ -350,18 +350,24 @@ class LocalExecutor:
                     # surface with their real message, not burn the ladder
                     jc = self.config.get("jit_cache")
                     retries = getattr(self, "_jit_fault_retries", 0)
+                    compile_flake = "remote_compile" in str(e)
                     transient = (
                         "INVALID_ARGUMENT" in str(e)
                         # remote compile service hiccups (HTTP 500 /
                         # truncated body) are infra flakes, not program
-                        # errors — retry them the same bounded way
-                        or "remote_compile" in str(e)
+                        # errors — retry them, with a backoff pause so a
+                        # briefly overloaded compile helper can recover
+                        or compile_flake
                     )
                     if (
                         use_jit
-                        and retries < 3  # at most three fault retries
+                        and retries < (5 if compile_flake else 3)
                         and transient
                     ):
+                        if compile_flake:
+                            import time as _time
+
+                            _time.sleep(3.0 * (retries + 1))
                         self._jit_fault_retries = retries + 1
                         if jc:
                             jc.pop(
