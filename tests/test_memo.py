@@ -1,0 +1,202 @@
+"""Memo/cost optimizer tests (IterativeOptimizer + Memo +
+CostCalculatorUsingExchanges analogs — plan/memo.py, plan/cost.py)."""
+import trino_tpu.plan.nodes as P
+from trino_tpu.plan import memo as M
+from trino_tpu.plan.cost import CostModel, StatsProvider, annotate
+from trino_tpu.session import tpcds_session, tpch_session
+
+Q3 = """
+select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+       o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING'
+  and c_custkey = o_custkey and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate limit 10
+"""
+
+DS_Q7_JOINS = """
+select i_item_id, avg(ss_quantity) agg1
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M' and d_year = 2000
+group by i_item_id
+"""
+
+
+def _joins(plan):
+    out = []
+
+    def walk(n):
+        if isinstance(n, P.Join):
+            out.append(n)
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    return out
+
+
+def _scans(plan):
+    out = []
+
+    def walk(n):
+        if isinstance(n, P.TableScan):
+            out.append(n.table)
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    return out
+
+
+def test_explain_carries_cost_estimates():
+    s = tpch_session(0.01)
+    text = "\n".join(
+        r[0] for r in s.execute("explain " + Q3).to_pylist()
+    )
+    assert "{rows:" in text and "cpu:" in text and "net:" in text
+
+
+def test_q3_fact_table_is_probe_side():
+    """The largest relation (lineitem) must anchor as the streaming
+    probe; dimensions join as builds."""
+    s = tpch_session(0.01)
+    plan = s.plan(Q3)
+    top = _joins(plan)[0]
+    assert "lineitem" in _scans(top.left)
+    assert "lineitem" not in _scans(top.right)
+
+
+def test_q7_star_probes_through_dimension_builds():
+    """Every join's build (right) side is a dimension relation, never the
+    fact-table subtree — commutation + cost must keep the star shape."""
+    s = tpcds_session(1.0)
+    plan = s.plan(DS_Q7_JOINS)
+    for j in _joins(plan):
+        assert "store_sales" not in _scans(j.right), P.plan_to_string(plan)
+        assert "store_sales" in _scans(j.left)
+
+
+def test_memo_dedups_and_explores():
+    s = tpch_session(0.01)
+    plan = s.plan(Q3)
+    chosen, info = M.explore(plan, s.metadata, s.properties)
+    assert info["alternatives"] > info["groups"]  # rules fired
+    assert info["cost_total"] > 0
+    # chosen plan is executable-equivalent: same output symbols
+    assert chosen.output_symbols() == plan.output_symbols()
+
+
+def test_distribution_cost_compared_on_mesh_plans():
+    """distributed=true: a big non-unique build goes partitioned, a tiny
+    dimension build stays broadcast (AddExchanges.java:138 decision made
+    by cost, not only by the row threshold)."""
+    s = tpch_session(1.0, distributed=True, num_devices=8)
+    # big-build self-join: both sides are the 6M-row fact table
+    big = s.plan(
+        "select a.l_orderkey from lineitem a, lineitem b "
+        "where a.l_orderkey = b.l_orderkey"
+    )
+    kinds = {j.distribution for j in _joins(big)}
+    assert "partitioned" in kinds, P.plan_to_string(big)
+    # dimension build stays broadcast
+    small = s.plan(
+        "select l_orderkey from lineitem, nation where l_suppkey = n_nationkey"
+    )
+    assert {j.distribution for j in _joins(small)} == {"broadcast"}
+
+
+def test_memo_off_round_trips_results():
+    s = tpch_session(0.01)
+    r1 = s.execute(Q3).to_pylist()
+    s.execute("set session memo_optimizer = false")
+    r2 = s.execute(Q3).to_pylist()
+    assert r1 == r2
+
+
+def test_expansion_penalty_prefers_unique_build():
+    """Cost model: with a unique-keyed side available, commutation keeps
+    it as the build even when row counts alone would flip it."""
+    s = tpcds_session(1.0)
+    plan = s.plan(
+        "select ss_quantity from store_sales, promotion "
+        "where ss_promo_sk = p_promo_sk"
+    )
+    (j,) = _joins(plan)
+    assert _scans(j.right) == ["promotion"]
+    assert not j.expansion
+
+
+def test_union_plans_survive_memo():
+    """SetOperation children live in a tuple field: _replace_sources must
+    rewrite them (review finding: memo silently disabled for unions)."""
+    s = tpch_session(0.01)
+    sql = (
+        "select o_orderkey k from orders, customer "
+        "where o_custkey = c_custkey and c_mktsegment = 'BUILDING' "
+        "union all select l_orderkey k from lineitem where l_quantity < 2"
+    )
+    plan = s.plan(sql)
+    chosen, info = M.explore(plan, s.metadata, s.properties)
+    assert info["alternatives"] >= info["groups"]
+    r1 = sorted(s.execute(sql).to_pylist())
+    s.execute("set session memo_optimizer = false")
+    r2 = sorted(s.execute(sql).to_pylist())
+    assert r1 == r2
+
+
+def test_rotation_keeps_residual_filters():
+    """A non-equi residual on the inner join must survive any memo
+    rotation (review finding: _rule_associate dropped it)."""
+    s = tpch_session(0.01)
+    sql = (
+        "select count(*) from customer, orders, lineitem "
+        "where c_custkey = o_custkey and l_orderkey = o_orderkey "
+        "and o_totalprice > c_acctbal and l_quantity < 10"
+    )
+    r1 = s.execute(sql).to_pylist()
+    s.execute("set session memo_optimizer = false")
+    r2 = s.execute(sql).to_pylist()
+    assert r1 == r2
+
+
+def test_cost_annotate_covers_every_node():
+    s = tpch_session(0.01)
+    plan = s.plan(Q3)
+    costs = annotate(plan, s.metadata, s.properties)
+
+    def walk(n):
+        assert id(n) in costs
+        for src in n.sources:
+            walk(src)
+
+    walk(plan)
+
+
+def test_stats_provider_range_selectivity():
+    """Range predicates use column min/max, not the 0.3 fallback."""
+    s = tpch_session(1.0)
+    plan = s.plan(
+        "select count(*) from lineitem where l_shipdate > date '1998-01-01'"
+    )
+    stats = StatsProvider(s.metadata)
+
+    def find_filter(n):
+        if isinstance(n, P.Filter):
+            return n
+        for src in n.sources:
+            f = find_filter(src)
+            if f is not None:
+                return f
+        return None
+
+    f = find_filter(plan)
+    assert f is not None
+    est = stats.estimate(f)
+    base = stats.estimate(f.source)
+    # late 1998 cut: a small tail of the 7-year shipdate span, far from
+    # the 0.3 fallback
+    assert est.rows < 0.2 * base.rows

@@ -133,6 +133,13 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "memo_optimizer",
+            "iterative Memo exploration with cost-compared alternatives "
+            "(join order/commutation/distribution); off keeps the greedy "
+            "single-pass choices",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "in_list_pushdown",
             "derive discrete-value TupleDomains from IN lists for "
             "connector split/row-group pruning",

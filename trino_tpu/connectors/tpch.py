@@ -667,7 +667,14 @@ class TpchMetadata(ConnectorMetadata):
         """Mirrors TpchMetadata's statistics support (plugin/trino-tpch
         .../statistics) — row counts and NDV estimates drive join ordering
         and unique-build-side detection.  Only true primary keys report
-        distinct_count == row_count."""
+        distinct_count == row_count.  Cached per table: the memo
+        optimizer reads these once per estimate across hundreds of
+        alternatives (sf is fixed per connector)."""
+        cache = getattr(self, "_stats_cache", None)
+        if cache is None:
+            cache = self._stats_cache = {}
+        if table in cache:
+            return cache[table]
         counts = _counts(self.sf)
         n = counts[table]
         pk = {
@@ -687,15 +694,55 @@ class TpchMetadata(ConnectorMetadata):
             "s_nationkey": 25.0,
             "n_regionkey": 5.0,
         }
+        # dbgen value-domain invariants (TPC-H spec 4.2.3: date windows,
+        # quantity/discount/tax ranges; stored-scale for decimal lanes) —
+        # range selectivities for the CBO (FilterStatsCalculator inputs)
+        okey_max = float(_orderkey(np.array([counts["orders"] - 1]))[0]) + 7
+        ranges = {
+            "o_orderdate": (8035.0, 10440.0),   # 1992-01-01..1998-08-02
+            "l_shipdate": (8036.0, 10561.0),    # orderdate+1..121
+            "l_commitdate": (8065.0, 10530.0),  # orderdate+30..90
+            "l_receiptdate": (8037.0, 10591.0),  # shipdate+1..30
+            "l_quantity": (100.0, 5000.0),      # 1..50 (x100 lanes)
+            "l_discount": (0.0, 10.0),          # 0.00..0.10 (x100)
+            "l_tax": (0.0, 8.0),                # 0.00..0.08 (x100)
+            "l_linenumber": (1.0, 7.0),
+            "o_orderkey": (1.0, okey_max),
+            "l_orderkey": (1.0, okey_max),
+            "o_custkey": (1.0, float(counts["customer"])),
+            "c_custkey": (1.0, float(counts["customer"])),
+            "p_partkey": (1.0, float(counts["part"])),
+            "l_partkey": (1.0, float(counts["part"])),
+            "ps_partkey": (1.0, float(counts["part"])),
+            "s_suppkey": (1.0, float(counts["supplier"])),
+            "l_suppkey": (1.0, float(counts["supplier"])),
+            "ps_suppkey": (1.0, float(counts["supplier"])),
+            "n_nationkey": (0.0, 24.0),
+            "c_nationkey": (0.0, 24.0),
+            "s_nationkey": (0.0, 24.0),
+            "r_regionkey": (0.0, 4.0),
+            "n_regionkey": (0.0, 4.0),
+        }
         cols: Dict[str, ColumnStatistics] = {}
         for c, t in SCHEMAS[table]:
+            lo, hi = ranges.get(c, (None, None))
             if c == pk:
-                cols[c] = ColumnStatistics(distinct_count=float(n))
+                cols[c] = ColumnStatistics(
+                    distinct_count=float(n), min_value=lo, max_value=hi
+                )
             elif c in fk_ndv:
-                cols[c] = ColumnStatistics(distinct_count=min(fk_ndv[c], n))
+                cols[c] = ColumnStatistics(
+                    distinct_count=min(fk_ndv[c], n),
+                    min_value=lo, max_value=hi,
+                )
             elif t.is_dictionary and c in _VOCABS:
-                cols[c] = ColumnStatistics(distinct_count=float(len(_VOCABS[c])))
-        return TableStatistics(float(n), cols)
+                cols[c] = ColumnStatistics(
+                    distinct_count=float(len(_VOCABS[c]))
+                )
+            elif lo is not None:
+                cols[c] = ColumnStatistics(min_value=lo, max_value=hi)
+        cache[table] = TableStatistics(float(n), cols)
+        return cache[table]
 
 
 class TpchSplitManager(SplitManager):

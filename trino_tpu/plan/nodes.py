@@ -667,10 +667,16 @@ def visit_plan(node: PlanNode, fn, depth=0):
         visit_plan(s, fn, depth + 1)
 
 
-def plan_to_string(node: PlanNode, stats: Optional[dict] = None) -> str:
+def plan_to_string(
+    node: PlanNode,
+    stats: Optional[dict] = None,
+    costs: Optional[dict] = None,
+) -> str:
     """EXPLAIN-style textual plan (PlanPrinter analog).  With `stats`
     (id(node) -> {rows, wall_s} from EXPLAIN ANALYZE instrumentation) each
-    line is annotated with output rows and exclusive wall time."""
+    line is annotated with output rows and exclusive wall time; with
+    `costs` (id(node) -> {rows, cpu, net, mem} from plan.cost.annotate)
+    each line carries the CBO's estimates (PlanPrinter 'Estimates:')."""
     lines: List[str] = []
 
     def fmt(n: PlanNode, d: int):
@@ -716,6 +722,12 @@ def plan_to_string(node: PlanNode, stats: Optional[dict] = None) -> str:
             extra = f" fragment={n.fragment_id}"
         elif isinstance(n, Output):
             extra = f" {list(n.names)}"
+        if costs is not None and id(n) in costs:
+            c = costs[id(n)]
+            extra += (
+                f"  {{rows: {c['rows']:.0f}, cpu: {c['cpu']:.2g}, "
+                f"net: {c['net']:.2g}, mem: {c['mem']:.2g}}}"
+            )
         if stats is not None and id(n) in stats:
             st = stats[id(n)]
             child_wall = sum(

@@ -54,6 +54,14 @@ def optimize(
             cur = sink_predicates(cur)
         cur = _choose_build_sides(cur, metadata)
         cur = _choose_join_distribution(cur, metadata, properties)
+        if prop("memo_optimizer"):
+            # iterative Memo exploration: cost-compared join orders,
+            # commutation, and broadcast-vs-partitioned alternatives
+            # (IterativeOptimizer/Memo/CostCalculatorUsingExchanges)
+            from .memo import memo_optimize
+
+            cur = memo_optimize(cur, metadata, properties)
+            cur = sink_predicates(cur)
     if prop("column_pruning"):
         cur = _prune_columns(cur)
     cur = _derive_scan_constraints(

@@ -432,6 +432,13 @@ class Session:
             if stmt.analyze:
                 return self._explain_analyze(stmt.query, query_id)
             plan = self._plan_stmt(stmt.query)
+            costs = None
+            try:
+                from .plan.cost import annotate
+
+                costs = annotate(plan, self.metadata, self.properties)
+            except Exception:
+                pass
             if stmt.plan_type == "distributed":
                 from .plan.fragment import fragment_plan
 
@@ -451,7 +458,7 @@ class Session:
                     )
                 text = "\n".join(parts)
             else:
-                text = P.plan_to_string(plan)
+                text = P.plan_to_string(plan, costs=costs)
             col = column_from_pylist(T.VARCHAR, text.split("\n"))
             return Page([col], len(text.split("\n")), ["Query Plan"])
         if isinstance(stmt, ast.CreateTable):
