@@ -128,3 +128,78 @@ def test_hive_in_list_row_group_pruning(tmp_path):
     # correctness end-to-end
     got = s.execute("select count(*) from t where x in (10, 21)").to_pylist()
     assert got == [(2,)]
+
+
+def test_fd_pruning_strict_uniqueness_refuses_fanout():
+    """_key_unique_strict: a join fans out its unique side when the other
+    side duplicates the join key — o_orderkey is NOT unique in
+    orders x lineitem, even though the heuristic _key_unique (build-side
+    selection, runtime-rechecked) says it is.  FD group-key pruning is a
+    result-correctness rewrite and must use the strict walker."""
+    import trino_tpu.plan.nodes as P
+    from trino_tpu.plan.optimizer import _key_unique, _key_unique_strict
+    from trino_tpu.session import tpch_session
+
+    s = tpch_session(0.01)
+
+    def find_join(n):
+        if isinstance(n, P.Join):
+            return n
+        for src in n.sources:
+            j = find_join(src)
+            if j is not None:
+                return j
+        return None
+
+    fanout = find_join(s.plan(
+        "select o_orderkey, l_quantity from orders, lineitem "
+        "where o_orderkey = l_orderkey"
+    ))
+    assert _key_unique(fanout, "o_orderkey", s.metadata)  # the heuristic
+    assert not _key_unique_strict(fanout, "o_orderkey", s.metadata)
+
+    preserved = find_join(s.plan(
+        "select o_orderkey, c_mktsegment from orders, customer "
+        "where o_custkey = c_custkey"
+    ))
+    assert _key_unique_strict(preserved, "o_orderkey", s.metadata)
+
+
+def test_fd_pruning_single_key_group_by_q3_shape():
+    """Q3's GROUP BY l_orderkey, o_orderdate, o_shippriority collapses to
+    one key (the others come back as arbitrary aggregates) and results
+    round-trip against the unpruned plan."""
+    import trino_tpu.plan.nodes as P
+    from trino_tpu.session import tpch_session
+
+    q3 = (
+        "select l_orderkey, sum(l_extendedprice * (1 - l_discount)) rev, "
+        "o_orderdate, o_shippriority "
+        "from customer, orders, lineitem "
+        "where c_mktsegment = 'BUILDING' and c_custkey = o_custkey "
+        "and l_orderkey = o_orderkey "
+        "and o_orderdate < date '1995-03-15' "
+        "and l_shipdate > date '1995-03-15' "
+        "group by l_orderkey, o_orderdate, o_shippriority "
+        "order by rev desc, o_orderdate limit 10"
+    )
+    s = tpch_session(0.01)
+
+    def find_agg(n):
+        if isinstance(n, P.Aggregate):
+            return n
+        for src in n.sources:
+            a = find_agg(src)
+            if a is not None:
+                return a
+        return None
+
+    agg = find_agg(s.plan(q3))
+    assert agg.keys == ("l_orderkey",)
+    assert sorted(a.kind for a in agg.aggs) == [
+        "arbitrary", "arbitrary", "sum"
+    ]
+    r1 = s.execute(q3).to_pylist()
+    s.execute("set session fd_group_key_pruning = false")
+    r2 = s.execute(q3).to_pylist()
+    assert r1 == r2

@@ -133,6 +133,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "fd_group_key_pruning",
+            "drop group-by keys functionally dependent (via unique-build "
+            "joins) on another key; they return as arbitrary() values",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "memo_optimizer",
             "iterative Memo exploration with cost-compared alternatives "
             "(join order/commutation/distribution); off keeps the greedy "

@@ -62,6 +62,8 @@ def optimize(
 
             cur = memo_optimize(cur, metadata, properties)
             cur = sink_predicates(cur)
+    if metadata is not None and prop("fd_group_key_pruning"):
+        cur = _prune_fd_group_keys(cur, metadata)
     if prop("column_pruning"):
         cur = _prune_columns(cur)
     cur = _derive_scan_constraints(
@@ -679,6 +681,141 @@ def _choose_join_distribution(
         return dataclasses.replace(n, distribution=dist)
 
     return walk(node)
+
+
+# --- functional-dependency group-key pruning ---------------------------
+
+
+def _key_unique_strict(node: P.PlanNode, symbol: str,
+                       metadata: Metadata) -> bool:
+    """PROVEN uniqueness of `symbol` in node's output — unlike
+    _key_unique (a build-side heuristic where a wrong guess only costs a
+    runtime dup-check retry), this feeds result-correctness rewrites, so
+    a Join only preserves uniqueness when the OTHER side cannot fan out:
+    it must itself be unique on its join key.  Anything unproven is
+    False."""
+    if isinstance(node, P.TableScan):
+        col = dict(node.assignments).get(symbol)
+        if col is None:
+            return False
+        stats = metadata.table_statistics(node.catalog, node.table)
+        cs = stats.columns.get(col)
+        return cs is not None and cs.distinct_count == stats.row_count
+    if isinstance(node, P.Filter):
+        return _key_unique_strict(node.source, symbol, metadata)
+    if isinstance(node, P.Project):
+        for s, e in node.assignments:
+            if s == symbol and isinstance(e, ir.ColumnRef):
+                return _key_unique_strict(node.source, e.name, metadata)
+        return False
+    if isinstance(node, P.Aggregate):
+        return len(node.keys) == 1 and symbol in node.keys
+    if isinstance(node, P.Join):
+        if node.kind not in ("inner", "left") or len(node.criteria) != 1:
+            return False
+        l, r = node.criteria[0]
+        left_has = symbol in node.left.output_symbols()
+        side, other = (
+            (node.left, node.right) if left_has else (node.right, node.left)
+        )
+        other_key = r if left_has else l
+        return _key_unique_strict(
+            side, symbol, metadata
+        ) and _key_unique_strict(other, other_key, metadata)
+    if isinstance(node, (P.SemiJoin, P.Sort, P.TopN, P.Limit)):
+        return _key_unique_strict(node.sources[0], symbol, metadata)
+    return False
+
+
+def _prune_fd_group_keys(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    """Group keys functionally dependent on another key drop out of the
+    hash and come back as `arbitrary` aggregates: GROUP BY l_orderkey,
+    o_orderdate, o_shippriority over a unique-build join on
+    o_orderkey collapses to a single-key group-by (TPC-H Q3's multi-key
+    hash-sort becomes one narrow-int grouping).
+
+    Reference analog: the CBO's unique-constraint reasoning
+    (sql/planner/optimizations/ + iterative rules that exploit
+    distinctness, e.g. RemoveRedundantDistinct / PruneDistinctAggregation
+    in core/trino-main/.../iterative/rule/).  Safety:
+      - the dependency comes from a SINGLE-column equi join whose build
+        side is stats-PROVEN unique on the join key (primary-key
+        distinct_count == row_count, not a heuristic) — probe rows with
+        equal keys then share one build row, so every build-side symbol
+        is a function of the probe key
+      - inner joins only, or left joins without residual filters (a
+        residual nulls build columns per-row and breaks the dependency)
+    """
+    node = _rewrite_sources(
+        node, tuple(_prune_fd_group_keys(s, metadata) for s in node.sources)
+    )
+    if not (
+        isinstance(node, P.Aggregate)
+        and node.step == "single"
+        and len(node.keys) > 1
+    ):
+        return node
+
+    # trace each group key down through identity projections/filters to
+    # the first join below the aggregate
+    def trace(sym: str):
+        cur = node.source
+        s = sym
+        while True:
+            if isinstance(cur, P.Filter):
+                cur = cur.source
+                continue
+            if isinstance(cur, P.Project):
+                nxt = None
+                for out, e in cur.assignments:
+                    if out == s:
+                        if isinstance(e, ir.ColumnRef):
+                            nxt = e.name
+                        break
+                if nxt is None:
+                    return None
+                s = nxt
+                cur = cur.source
+                continue
+            if isinstance(cur, P.Join):
+                return cur, s
+            return None
+
+    traces = {k: trace(k) for k in node.keys}
+    if any(t is None for t in traces.values()):
+        return node
+    # trace() walks the same source chain for every key, so all traces
+    # stop at the same first Join
+    j, _ = next(iter(traces.values()))
+    if not (
+        isinstance(j, P.Join)
+        and len(j.criteria) == 1
+        and (j.kind == "inner" or (j.kind == "left" and j.filter is None))
+    ):
+        return node
+    pk, bk = j.criteria[0]
+    if not _key_unique_strict(j.right, bk, metadata):
+        return node
+    build_syms = set(j.right.output_symbols())
+    anchor = [k for k, (_, s) in traces.items() if s == pk]
+    fd = [k for k, (_, s) in traces.items() if s in build_syms and s != pk]
+    if not anchor or not fd or len(anchor) + len(fd) != len(node.keys):
+        return node
+    import dataclasses as dc
+
+    types = node.source.output_types()
+    new_aggs = list(node.aggs) + [
+        P.AggInfo(
+            output=k, kind="arbitrary", arg=k, distinct=False,
+            input_type=types[k], output_type=types[k],
+        )
+        for k in fd
+    ]
+    return dc.replace(
+        node,
+        keys=tuple(k for k in node.keys if k not in fd),
+        aggs=tuple(new_aggs),
+    )
 
 
 # --- column pruning ----------------------------------------------------
