@@ -88,17 +88,19 @@ def order_operands(w: jnp.ndarray, descending: bool = False):
 
 
 def order_approx64(w: jnp.ndarray) -> jnp.ndarray:
-    """Monotone int64 approximation of 128-bit order (floor(v / 2^32),
-    saturated): distinct values may collapse to ties, never reorder.
-    TopN phase 1 counts encoded ties, so collapses are exactness-safe."""
+    """Monotone int64 approximation of 128-bit order: EXACT (= the low
+    limb) for values that fit int64, sign-saturated for genuinely wide
+    values.  Distinct wide values may collapse to the saturation ties,
+    never reorder; TopN phase 1 counts encoded ties, so collapses are
+    exactness-safe.  (The previous floor(v/2^32) form collapsed every
+    ordinary-magnitude decimal sum — e.g. all of TPC-H Q3's revenues —
+    into one tie, forcing the TopN ladder through 3 recompiles into a
+    full sort.)"""
     lo, hi = limbs(w)
-    lo_mid = (lo >> jnp.int64(32)) & _M32  # logical: lo is a bit pattern
-    in_range = (hi >= jnp.int64(-(1 << 31))) & (hi < jnp.int64(1 << 31))
-    mid = (hi << jnp.int64(32)) | lo_mid
     sat = jnp.where(
         hi < 0, jnp.int64(-(2**63)), jnp.int64(2**63 - 1)
     )
-    return jnp.where(in_range, mid, sat)
+    return jnp.where(fits_narrow(w), lo, sat)
 
 
 def compare(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
