@@ -57,9 +57,15 @@ def _local_session(args):
         if not args.tpu:
             trino_tpu.force_cpu()
         trino_tpu.enable_x64()
-        from .session import tpch_session
+        from .session import Session, tpch_session, tpcds_session
 
-        _SESSION = tpch_session(args.sf)
+        if args.catalog == "tpch":
+            _SESSION = tpch_session(args.sf)
+        elif args.catalog == "tpcds":
+            _SESSION = tpcds_session(args.sf)
+        else:
+            _SESSION = Session()
+            _SESSION.create_catalog(args.catalog, args.catalog, {})
     return _SESSION
 
 

@@ -133,6 +133,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "compaction",
+            "tighten survivors of selective filters/joins into a smaller "
+            "static capacity (downstream ops run at the reduced width)",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "fd_group_key_pruning",
             "drop group-by keys functionally dependent (via unique-build "
             "joins) on another key; they return as arbitrary() values",

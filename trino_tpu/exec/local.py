@@ -81,6 +81,21 @@ class Batch:
     replicated: bool = False  # identical on every mesh device (mesh exec)
 
 
+def _single_row_plan(n: P.PlanNode) -> bool:
+    """Does this plan emit at most one row, statically?  (Global
+    aggregates and LIMIT<=1, through projections/filters — filters may
+    drop the row, which cross-join semantics must and do preserve.)"""
+    if isinstance(n, P.Aggregate):
+        return not n.keys and n.step in ("single", "final")
+    if isinstance(n, P.Limit):
+        return n.count <= 1 or _single_row_plan(n.sources[0])
+    if isinstance(n, P.Values):
+        return len(n.rows) <= 1
+    if isinstance(n, (P.Project, P.Filter)):
+        return _single_row_plan(n.sources[0])
+    return False
+
+
 def _contains(plan: P.PlanNode, node_type, pred=None) -> bool:
     if isinstance(plan, node_type) and (pred is None or pred(plan)):
         return True
@@ -271,6 +286,7 @@ class LocalExecutor:
                 self.config.get("group_capacity", DEFAULT_GROUP_CAPACITY)
             )
             self.join_factor = 1
+            self.compact_factor = 1
             # join nodes whose build side turned out to hold duplicate (or
             # hash-colliding) keys: re-traced with the expansion kernel
             # (HashBuilderOperator never assumes uniqueness; we learn it)
@@ -288,7 +304,8 @@ class LocalExecutor:
             hint = hints.get(id(plan)) if hints is not None else None
             if hint is not None:
                 (self.group_capacity, self.join_factor, self.topn_factor,
-                 self.force_wide_mul, forced, _) = hint
+                 self.force_wide_mul, forced, _) = hint[:6]
+                self.compact_factor = hint[6] if len(hint) > 6 else 1
                 self.force_expansion = set(forced)
             else:
                 est = self._estimate_group_capacity(plan, counts)
@@ -350,9 +367,28 @@ class LocalExecutor:
                     # surface with their real message, not burn the ladder
                     jc = self.config.get("jit_cache")
                     retries = getattr(self, "_jit_fault_retries", 0)
-                    compile_flake = "remote_compile" in str(e)
+                    msg = str(e)
+                    compile_flake = "remote_compile" in msg
+                    # a compile-side HBM OOM is PERMANENT: XLA's buffer
+                    # assignment proved the monolithic program cannot fit
+                    # the chip — but the tunnel surfaces it as the same
+                    # HTTP 500 a transient helper crash produces, and the
+                    # OOM detail lives only in the tunnel's own log
+                    # stream.  So: on an explicit OOM signature, or on
+                    # the SECOND consecutive 500 for the same program,
+                    # try the streaming tiled fallback; if the plan is
+                    # untileable, keep the old backoff-retry resilience
+                    # (5 attempts) for genuine helper flakes.
+                    compile_oom = (
+                        "Ran out of memory" in msg
+                        or "permanent error" in msg
+                    )
+                    if compile_flake and (compile_oom or retries >= 1):
+                        stream_page = self._try_forced_streaming(plan)
+                        if stream_page is not None:
+                            return stream_page
                     transient = (
-                        "INVALID_ARGUMENT" in str(e)
+                        "INVALID_ARGUMENT" in msg
                         # remote compile service hiccups (HTTP 500 /
                         # truncated body) are infra flakes, not program
                         # errors — retry them, with a backoff pause so a
@@ -361,6 +397,7 @@ class LocalExecutor:
                     )
                     if (
                         use_jit
+                        and not compile_oom
                         and retries < (5 if compile_flake else 3)
                         and transient
                     ):
@@ -440,6 +477,11 @@ class LocalExecutor:
                     self.join_factor *= 8
                 if "topn" in over_kinds:
                     self.topn_factor *= 8
+                if "compact" in over_kinds:
+                    # x8 rapidly reaches the input width, where
+                    # _maybe_compact becomes a no-op — a bad estimate
+                    # costs at most a couple of recompiles, never a loop
+                    self.compact_factor *= 8
             else:
                 raise ExecutionError("group capacity overflow after retries")
 
@@ -449,6 +491,7 @@ class LocalExecutor:
                     self.group_capacity, self.join_factor,
                     self.topn_factor, self.force_wide_mul,
                     frozenset(self.force_expansion), plan,
+                    self.compact_factor,
                 )
                 for k in list(hints)[:-512]:
                     hints.pop(k, None)
@@ -456,6 +499,32 @@ class LocalExecutor:
         finally:
             if pool is not None:
                 pool.free(self.query_id, self.scan_bytes)
+
+    # ------------------------------------------------------------------
+    def _try_forced_streaming(self, plan) -> Optional[Page]:
+        """Compile-OOM fallback: re-run the query through the streaming
+        tiled executor even though the scan-bytes gate did not trigger —
+        XLA already proved the monolithic program exceeds HBM.  Returns
+        None when the plan is untileable or streaming itself fails (the
+        caller then surfaces the ORIGINAL compile error)."""
+        limit = self.config.get("memory_limit_bytes")
+        if not (limit and self.config.get("spill_enabled", True)):
+            return None
+        if not isinstance(plan, P.Output):
+            return None
+        from . import streaming
+
+        try:
+            frags = streaming.plan_streaming(
+                self, plan, int(limit), force=True
+            )
+            if frags is None:
+                return None
+            return streaming.execute_streaming(
+                self, plan, frags, int(limit)
+            )
+        except Exception:
+            return None
 
     # ------------------------------------------------------------------
     def _execute_write(self, w: P.TableWriter) -> Page:
@@ -791,6 +860,7 @@ class LocalExecutor:
         key = (
             id(plan), self.group_capacity, self.join_factor,
             getattr(self, "topn_factor", 1),
+            getattr(self, "compact_factor", 1),
             getattr(self, "group_salt", 0),
             getattr(self, "force_wide_mul", False),
             frozenset(getattr(self, "force_expansion", ())),
@@ -991,11 +1061,46 @@ class _TraceCtx:
         keep = u < node.fraction
         return Batch(b.lanes, b.sel & keep, b.ordered, b.replicated)
 
+    # single-device trace: compaction capacities are global row counts;
+    # mesh shards see 1/ndev of the rows, so _MeshTraceCtx disables this
+    allow_compaction = True
+
+    def _maybe_compact(self, b: Batch, node) -> Batch:
+        """Tighten survivors into a smaller static capacity (the
+        optimizer's compact_rows estimate, grown by the ladder's
+        compact_factor).  One jnp.nonzero + one stacked row-gather;
+        every downstream sort/gather then runs at the tightened width
+        and the fragment's HBM peak shrinks with it.  Exactness: the
+        true survivor count rides the capacity checks — overflow re-runs
+        with a wider (eventually input-width, i.e. no-op) capacity."""
+        est = getattr(node, "compact_rows", None)
+        if (
+            est is None
+            or not self.allow_compaction
+            or b.ordered
+            or b.replicated
+        ):
+            return b
+        factor = getattr(self.ex, "compact_factor", 1)
+        cap = _pad_capacity(int(est * 1.3) * factor)
+        n = b.sel.shape[0]
+        if cap >= n:
+            return b
+        from ..ops.filter_project import permute_lanes
+
+        total = b.sel.sum()
+        idx = jnp.nonzero(b.sel, size=cap, fill_value=0)[0]
+        self._note_capacity(total, cap, "compact")
+        lanes = permute_lanes(b.lanes, idx)
+        sel = jnp.arange(cap) < total
+        return Batch(lanes, sel, b.ordered, b.replicated)
+
     def _visit_filter(self, node: P.Filter) -> Batch:
         b = self.visit(node.source)
         f = compile_expr(node.predicate, self.lowering)
         v, ok = f(b.lanes)
-        return Batch(b.lanes, b.sel & v & ok, b.ordered, b.replicated)
+        out = Batch(b.lanes, b.sel & v & ok, b.ordered, b.replicated)
+        return self._maybe_compact(out, node)
 
     def _visit_project(self, node: P.Project) -> Batch:
         b = self.visit(node.source)
@@ -1474,7 +1579,10 @@ class _TraceCtx:
     def _visit_join(self, node: P.Join) -> Batch:
         left = self.visit(node.left)
         right = self.visit(node.right)
-        return self._join_batches(node, left, right)
+        out = self._join_batches(node, left, right)
+        if node.kind == "inner":
+            out = self._maybe_compact(out, node)
+        return out
 
     def _join_batches(self, node: P.Join, left: Batch, right: Batch) -> Batch:
         if node.kind == "cross":
@@ -1678,6 +1786,14 @@ class _TraceCtx:
                 )
 
     def _cross_join(self, node: P.Join, left: Batch, right: Batch) -> Batch:
+        # a side whose PLAN guarantees at most one row (global aggregate,
+        # LIMIT 1) broadcasts instead of repeat/tile — the scalar-ratio
+        # query shape (TPC-DS Q90's amc/pmc) stays capacity-lean no
+        # matter how wide the other side padded
+        if _single_row_plan(node.right):
+            return self._scalar_cross(left, right)
+        if _single_row_plan(node.left):
+            return self._scalar_cross(right, left)
         # only small-right cross joins (scalar-ish); replicate rows
         rcap = right.sel.shape[0]
         lcap = left.sel.shape[0]
@@ -1694,6 +1810,21 @@ class _TraceCtx:
             lanes[s] = (v[ri], ok[ri])
         sel = left.sel[li] & right.sel[ri]
         return Batch(lanes, sel)
+
+    def _scalar_cross(self, keep: Batch, single: Batch) -> Batch:
+        """Cross join against a ≤1-row side: broadcast its first selected
+        row onto the kept side (empty single side = empty result, exactly
+        the cross-join semantics)."""
+        first = jnp.argmax(single.sel)
+        has = single.sel.sum() > 0
+        n = keep.sel.shape[0]
+        lanes = dict(keep.lanes)
+        for s, (v, ok) in single.lanes.items():
+            lanes[s] = (
+                jnp.broadcast_to(v[first], (n,) + v.shape[1:]),
+                jnp.broadcast_to(ok[first] & has, (n,)),
+            )
+        return Batch(lanes, keep.sel & has)
 
     def _visit_semijoin(self, node: P.SemiJoin) -> Batch:
         src = self.visit(node.source)

@@ -83,11 +83,15 @@ def estimate_plan_scan_bytes(executor, plan: P.PlanNode) -> float:
     )
 
 
-def plan_streaming(executor, plan: P.Output, memory_limit: int):
+def plan_streaming(executor, plan: P.Output, memory_limit: int,
+                   force: bool = False):
     """Decide whether to stream: the estimated total scan working set
     exceeds the memory limit and the plan fragments cleanly.  Returns
-    the fragment list or None."""
-    if estimate_plan_scan_bytes(executor, plan) <= memory_limit:
+    the fragment list or None.  `force` skips the scan-bytes gate — the
+    compile-OOM fallback path already KNOWS the monolithic program does
+    not fit (XLA's buffer assignment said so), whatever the scans sum
+    to."""
+    if not force and estimate_plan_scan_bytes(executor, plan) <= memory_limit:
         return None
     # cache the fragment DAG per plan object: fragment roots key the jit
     # cache by identity, so re-fragmenting would recompile every tile

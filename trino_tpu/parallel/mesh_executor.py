@@ -315,6 +315,10 @@ class MeshExecutor(LocalExecutor):
 class _MeshTraceCtx(_TraceCtx):
     """Trace context inside shard_map: exchange points become collectives."""
 
+    # compaction capacities are GLOBAL row estimates; a mesh shard holds
+    # 1/ndev of the rows (and skew could overflow a shard-scaled guess)
+    allow_compaction = False
+
     def __init__(self, ex: MeshExecutor, scans, counts):
         super().__init__(ex, scans, counts)
         self.capacity_limits: List[int] = []

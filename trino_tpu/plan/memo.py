@@ -177,6 +177,8 @@ def _rule_associate(node: P.PlanNode, ctx) -> List[P.PlanNode]:
     """Left-deep rotation: (A ⋈ B) ⋈ C  →  (A ⋈ C) ⋈ B when the top
     join's criteria connect C to A alone — the two orders ReorderJoins
     would cost against each other inside one region."""
+    if not ctx.reorder:
+        return []
     if not (isinstance(node, P.Join) and node.kind == "inner"
             and node.criteria):
         return []
@@ -237,6 +239,10 @@ class _Context:
             distributed = bool(properties.get("distributed"))
         self.forced_distribution = mode
         self.distributed = distributed
+        self.reorder = (
+            bool(properties.get("reorder_joins"))
+            if properties is not None else True
+        )
 
     def unique(self, node: P.PlanNode, keys) -> bool:
         from .optimizer import _key_unique
@@ -409,14 +415,19 @@ def memo_optimize(
 
     from .optimizer import _choose_build_sides, _choose_join_distribution
 
-    try:
-        plan = best_region(plan)
-        # region rebuilds mint fresh Join nodes: re-derive the physical
-        # flags (expansion kernel, default distribution) before exploring
-        plan = _choose_build_sides(plan, metadata)
-        plan = _choose_join_distribution(plan, metadata, properties)
-    except Exception:
-        pass  # ordering must never lose a query; explore the seed as-is
+    reorder = True
+    if properties is not None:
+        reorder = bool(properties.get("reorder_joins"))
+    if reorder:
+        try:
+            plan = best_region(plan)
+            # region rebuilds mint fresh Join nodes: re-derive the
+            # physical flags (expansion kernel, default distribution)
+            # before exploring
+            plan = _choose_build_sides(plan, metadata)
+            plan = _choose_join_distribution(plan, metadata, properties)
+        except Exception:
+            pass  # ordering must never lose a query; explore the seed
 
     # 2. memo exploration for side/distribution/rotation alternatives
     try:

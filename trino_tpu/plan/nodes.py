@@ -208,6 +208,14 @@ class Sample(PlanNode):
 class Filter(PlanNode):
     source: PlanNode
     predicate: ir.Expr
+    # stats-estimated output rows, set by the optimizer when the filter
+    # is selective enough that the executor should COMPACT survivors into
+    # a smaller static capacity (cumsum+gather) — every downstream
+    # sort/gather then runs at the tightened width.  None = keep the
+    # input capacity.  Exactness: the executor checks the true survivor
+    # count against the compacted capacity and the retry ladder widens
+    # on overflow.
+    compact_rows: Optional[int] = None
 
     @property
     def sources(self):
@@ -400,6 +408,10 @@ class Join(PlanNode):
     # hash-repartitions BOTH sides on the join keys (all-to-all); None means
     # executors use their own capacity heuristic
     distribution: Optional[str] = None
+    # stats-estimated output rows for post-join compaction (see
+    # Filter.compact_rows): selective inner joins tighten the surviving
+    # rows into a smaller static capacity before downstream operators
+    compact_rows: Optional[int] = None
 
     @property
     def sources(self):

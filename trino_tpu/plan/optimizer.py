@@ -64,6 +64,8 @@ def optimize(
             cur = sink_predicates(cur)
     if metadata is not None and prop("fd_group_key_pruning"):
         cur = _prune_fd_group_keys(cur, metadata)
+    if metadata is not None and prop("compaction"):
+        cur = _annotate_compaction(cur, metadata, properties)
     if prop("column_pruning"):
         cur = _prune_columns(cur)
     cur = _derive_scan_constraints(
@@ -227,7 +229,7 @@ def _derive_scan_constraints(
             for c, (lo, hi) in sorted(ranges.items())
         ),
     )
-    return P.Filter(new_scan, node.predicate)
+    return P.Filter(new_scan, node.predicate, node.compact_rows)
 
 
 # --- predicate pushdown ------------------------------------------------
@@ -683,6 +685,67 @@ def _choose_join_distribution(
     return walk(node)
 
 
+# --- compaction annotation ---------------------------------------------
+
+# compact only when the estimate says at most this fraction survives
+# (padding + the safety margin eat the benefit above it)
+_COMPACT_SELECTIVITY = 0.6
+# below this input-row estimate the copy costs more than it saves
+_COMPACT_MIN_ROWS = 1 << 20
+
+
+def _annotate_compaction(
+    node: P.PlanNode, metadata: Metadata, properties
+) -> P.PlanNode:
+    """Mark selective Filters and inner Joins with their estimated output
+    rows so the executor tightens survivors into a smaller static
+    capacity.  TPU-first rationale: every operator here is a fixed-shape
+    XLA program over padded lanes, so a 50%-selective filter otherwise
+    drags dead lanes through every downstream sort/gather — and the
+    whole-fragment program's HBM peak (the q3_sf5 compile-OOM) scales
+    with those widths.  The reference's row-oriented operators get this
+    for free by materializing only survivors
+    (ScanFilterAndProjectOperator); here it is an explicit cumsum+gather
+    whose capacity the retry ladder verifies."""
+    from .cost import StatsProvider
+
+    stats = StatsProvider(metadata)
+    import dataclasses as dc
+
+    def walk(n: P.PlanNode) -> P.PlanNode:
+        n = _rewrite_sources(n, tuple(walk(s) for s in n.sources))
+        if isinstance(n, P.Filter):
+            try:
+                est = stats.estimate(n).rows
+                base = stats.estimate(n.source).rows
+            except Exception:
+                return n
+            if (
+                base >= _COMPACT_MIN_ROWS
+                and est <= base * _COMPACT_SELECTIVITY
+            ):
+                return dc.replace(n, compact_rows=int(est) + 1)
+            return n
+        if isinstance(n, P.Join) and n.kind == "inner" and n.criteria:
+            try:
+                est = stats.estimate(n).rows
+                base = max(
+                    stats.estimate(n.left).rows,
+                    stats.estimate(n.right).rows,
+                )
+            except Exception:
+                return n
+            if (
+                base >= _COMPACT_MIN_ROWS
+                and est <= base * _COMPACT_SELECTIVITY
+            ):
+                return dc.replace(n, compact_rows=int(est) + 1)
+            return n
+        return n
+
+    return walk(node)
+
+
 # --- functional-dependency group-key pruning ---------------------------
 
 
@@ -869,7 +932,9 @@ def _prune_columns(root: P.PlanNode) -> P.PlanNode:
             return P.Project(prune(node.source, need), kept)
         if isinstance(node, P.Filter):
             need = set(required) | set(ir.referenced_columns(node.predicate))
-            return P.Filter(prune(node.source, need), node.predicate)
+            return P.Filter(
+                prune(node.source, need), node.predicate, node.compact_rows
+            )
         if isinstance(node, P.Aggregate):
             kept_aggs = tuple(a for a in node.aggs if a.output in required)
             need = (
