@@ -421,3 +421,82 @@ def test_partitioned_semijoin(session, mesh_exec):
         me._MeshTraceCtx._partitioned_semijoin = orig
         mesh_exec.config["broadcast_join_threshold_rows"] = old_thresh
     assert calls, "partitioned semi join never engaged"
+
+
+def test_skew_hints_size_shuffle_without_ladder():
+    """A heavily skewed join key must complete in ONE mesh compile: the
+    host-side skew pre-pass sizes the shuffle chunk from the measured
+    bucket load instead of discovering overflow by recompile rungs."""
+    from trino_tpu.session import Session
+
+    s = Session(config={"join_distribution_type": "partitioned"})
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table skewed (k bigint, v bigint)")
+    # 90% of rows share one key
+    rows = ", ".join(
+        f"({1 if i % 10 else i}, {i})" for i in range(2000)
+    )
+    s.execute(f"insert into skewed values {rows}")
+    s.execute("create table dim (k bigint, name bigint)")
+    s.execute(
+        "insert into dim values "
+        + ", ".join(f"({i}, {i * 2})" for i in range(2000))
+    )
+    sql = (
+        "select count(*), sum(d.name) from skewed f, dim d "
+        "where f.k = d.k"
+    )
+    local = s.execute(sql).to_pylist()
+
+    me = MeshExecutor(s.catalogs, default_mesh(8), {
+        "jit_fragments": True,
+        "broadcast_join_threshold_rows": 1,  # force partitioned
+    })
+    import trino_tpu.parallel.mesh_executor as MX
+
+    compiles = []
+    orig = jax.jit
+
+    def spy(fn, *a, **k):
+        compiles.append(1)
+        return orig(fn, *a, **k)
+
+    MX.jax.jit = spy
+    try:
+        plan = s.plan(sql)
+        dist = me.execute(plan).to_pylist()
+    finally:
+        MX.jax.jit = orig
+    assert dist == local
+    assert me.shuffle_hints, "skew pre-pass produced no hints"
+    assert len(compiles) == 1, f"ladder retried: {len(compiles)} compiles"
+
+
+def test_partitioned_full_join_on_mesh():
+    """FULL JOIN (planned as left + null-extended anti union) runs on the
+    mesh with both sides hash-partitioned (missing #6: every join type
+    partitions; null keys route to a stable device and still emit)."""
+    from trino_tpu.session import Session
+
+    s = Session(config={"join_distribution_type": "partitioned"})
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table fa (k bigint, a bigint)")
+    s.execute("create table fb (k bigint, b bigint)")
+    s.execute(
+        "insert into fa values "
+        + ", ".join(f"({i}, {i})" for i in range(0, 1500, 2))
+    )
+    s.execute(
+        "insert into fb values "
+        + ", ".join(f"({i}, {i * 3})" for i in range(0, 1500, 3))
+    )
+    sql = (
+        "select fa.k, fb.k, a, b from fa full join fb on fa.k = fb.k"
+    )
+    local = sorted(map(repr, s.execute(sql).to_pylist()))
+    me = MeshExecutor(s.catalogs, default_mesh(8), {
+        "jit_fragments": True,
+        "broadcast_join_threshold_rows": 1,  # partition every join
+    })
+    dist = sorted(map(repr, me.execute(s.plan(sql)).to_pylist()))
+    assert dist == local

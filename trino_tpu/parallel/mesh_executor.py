@@ -24,7 +24,7 @@ places with AddExchanges (optimizations/AddExchanges.java:138).  Batch
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,7 @@ from ..exec.local import (
     _pad_capacity,
     _TraceCtx,
 )
+from ..expr import ir
 from ..expr.lower import compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
@@ -110,6 +111,13 @@ class MeshExecutor(LocalExecutor):
         ndev = self.mesh.devices.size
         scan_args, counts_args, dicts = self._load_sharded_scans(plan, ndev)
         self.dicts = dicts
+        # skew pre-pass: measure each partitioned join key's real bucket
+        # load on the HOST arrays before tracing, so the shuffle chunk is
+        # sized for the observed skew up front instead of discovered by
+        # whole-fragment recompile rungs (weak #8: the recompile spiral)
+        self.shuffle_hints = self._skew_shuffle_hints(
+            plan, scan_args, counts_args, ndev
+        )
         self.group_capacity = int(self.config.get("group_capacity", 4096))
         self.join_factor = 1
         self.force_expansion = set()
@@ -191,6 +199,115 @@ class MeshExecutor(LocalExecutor):
             raise ExecutionError("group capacity overflow after retries")
 
         return self._materialize(plan, out_lanes, sel, ctx.ordered_out)
+
+    # ------------------------------------------------------------------
+    def _skew_shuffle_hints(self, plan, scans, counts, ndev):
+        """Per (join-node, side) shuffle-chunk capacities measured on the
+        host scan arrays: bucket every traceable single-column join key
+        with the SAME splitmix the device shuffle uses and record the
+        worst per-(sender, destination) load.  Filters below the join
+        only remove rows, so the measurement is a safe overestimate; the
+        capacity ladder remains the backstop for untraceable keys.
+
+        Reference analog: SkewedPartitionRebalancer's observed-load
+        sizing, applied to the mesh all_to_all instead of writer tasks."""
+        from .shuffle import mix64_np
+
+        hints: Dict[Tuple[int, str], int] = {}
+
+        def scan_col(node, sym):
+            while True:
+                if isinstance(node, P.Filter):
+                    node = node.source
+                    continue
+                if isinstance(node, P.Project):
+                    nxt = None
+                    for s, e in node.assignments:
+                        if s == sym:
+                            if isinstance(e, ir.ColumnRef):
+                                nxt = e.name
+                            break
+                    if nxt is None:
+                        return None
+                    sym, node = nxt, node.source
+                    continue
+                if isinstance(node, P.TableScan):
+                    return node, sym
+                return None
+
+        def measure(side, sym):
+            t = scan_col(side, sym)
+            if t is None:
+                return None
+            scan_node, ssym = t
+            merged = scans.get(str(id(scan_node)))
+            if merged is None or ssym not in merged:
+                return None
+            arr = merged[ssym]
+            lens = counts.get(str(id(scan_node)))
+            if arr.ndim != 2 or arr.dtype.kind not in "iu":
+                return None
+            worst = 0
+            for d in range(arr.shape[0]):
+                n = int(lens[d]) if lens is not None else arr.shape[1]
+                # count EVERY row, null keys included: the device buckets
+                # by the residual value lane regardless of validity (and
+                # sides that drop nulls before shuffling just make this a
+                # safe overestimate)
+                v = arr[d, :n]
+                if len(v) == 0:
+                    continue
+                b = (mix64_np(v.astype(np.int64)) % np.uint64(ndev))
+                worst = max(worst, int(np.bincount(
+                    b.astype(np.int64), minlength=ndev
+                ).max()))
+            if worst == 0:
+                return None
+            return _pad_capacity(max(128, int(worst * 1.3)))
+
+        def _wide_key(node, sym):
+            t = node.output_types().get(sym)
+            return bool(getattr(t, "wide", False))
+
+        def walk(n):
+            if (
+                isinstance(n, P.Join)
+                and len(n.criteria) == 1
+                # only the partitioned path reads the hint; measuring
+                # broadcast joins would put O(rows) host hashing on the
+                # critical path for nothing
+                and n.distribution == "partitioned"
+            ):
+                l, r = n.criteria[0]
+                # wide (two-limb) keys force JOINT composite hashing on
+                # the device — a raw-value host measurement would use a
+                # different bucket permutation
+                if not (_wide_key(n.left, l) or _wide_key(n.right, r)):
+                    h = measure(n.left, l)
+                    if h is not None:
+                        hints[(id(n), "l")] = h
+                    h = measure(n.right, r)
+                    if h is not None:
+                        hints[(id(n), "r")] = h
+            if isinstance(n, P.SemiJoin) and len(n.source_keys) == 1:
+                if not (
+                    _wide_key(n.source, n.source_keys[0])
+                    or _wide_key(n.filtering, n.filtering_keys[0])
+                ):
+                    h = measure(n.source, n.source_keys[0])
+                    if h is not None:
+                        hints[(id(n), "l")] = h
+                    h = measure(n.filtering, n.filtering_keys[0])
+                    if h is not None:
+                        hints[(id(n), "r")] = h
+            for s in n.sources:
+                walk(s)
+
+        try:
+            walk(plan)
+        except Exception:
+            return {}
+        return hints
 
     # ------------------------------------------------------------------
     def _load_sharded_scans(self, plan: P.PlanNode, ndev: int):
@@ -526,6 +643,17 @@ class _MeshTraceCtx(_TraceCtx):
         out.replicated = left.replicated
         return out
 
+    def _hinted_chunk(self, node, side, cap, ndev, factor):
+        """Shuffle-chunk capacity: the host-measured skew hint when one
+        exists (grown by the ladder factor as the backstop), else the
+        2x-slack default."""
+        h = getattr(self.ex, "shuffle_hints", {}).get((id(node), side))
+        if h is not None:
+            return min(
+                _pad_capacity(h * factor), _pad_capacity(max(128, cap))
+            )
+        return _shuffle_chunk(cap, ndev, factor)
+
     def _use_partitioned(self, node: P.Join, left: Batch, right: Batch):
         """The DetermineJoinDistributionType decision at execution time:
         honor the optimizer's choice when present, else fall back to a
@@ -561,8 +689,10 @@ class _MeshTraceCtx(_TraceCtx):
         rbuck, rok = shuffle.bucket_of(rkeys, right.sel, ndev, joint)
         lkeep = left.sel & (lok | (node.kind == "left"))
         rkeep = right.sel & rok
-        lchunk = _shuffle_chunk(left.sel.shape[0], ndev, factor)
-        rchunk = _shuffle_chunk(right.sel.shape[0], ndev, factor)
+        lchunk = self._hinted_chunk(node, "l", left.sel.shape[0], ndev,
+                                    factor)
+        rchunk = self._hinted_chunk(node, "r", right.sel.shape[0], ndev,
+                                    factor)
         llanes, lsel, lmax = shuffle.repartition(
             left.lanes, left.sel, lbuck, lkeep, ndev, lchunk, AXIS
         )
@@ -631,8 +761,10 @@ class _MeshTraceCtx(_TraceCtx):
         fbuck, fok = shuffle.bucket_of(fkeys, filt.sel, ndev, joint)
         sbuck = jnp.where(sok, sbuck, 0)
         factor = getattr(self.ex, "join_factor", 1)
-        schunk = _shuffle_chunk(src.sel.shape[0], ndev, factor)
-        fchunk = _shuffle_chunk(filt.sel.shape[0], ndev, factor)
+        schunk = self._hinted_chunk(node, "l", src.sel.shape[0], ndev,
+                                    factor)
+        fchunk = self._hinted_chunk(node, "r", filt.sel.shape[0], ndev,
+                                    factor)
         slanes, ssel, smax = shuffle.repartition(
             src.lanes, src.sel, sbuck, src.sel, ndev, schunk, AXIS
         )

@@ -34,6 +34,19 @@ def _mix64(x: jnp.ndarray) -> jnp.ndarray:
     return x ^ (x >> jnp.uint64(31))
 
 
+def mix64_np(x):
+    """Host (numpy) mirror of _mix64 — the skew pre-pass must land rows
+    in exactly the buckets the device shuffle will."""
+    import numpy as np
+
+    m = np.uint64(0xFFFFFFFFFFFFFFFF)
+    x = x.astype(np.uint64)
+    with np.errstate(over="ignore"):
+        x = ((x ^ (x >> np.uint64(30))) * np.uint64(_M1)) & m
+        x = ((x ^ (x >> np.uint64(27))) * np.uint64(_M2)) & m
+    return x ^ (x >> np.uint64(31))
+
+
 def bucket_of(
     key_lanes, sel, ndev: int, force_hash: bool = False
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:

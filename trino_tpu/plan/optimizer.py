@@ -712,8 +712,28 @@ def _annotate_compaction(
     stats = StatsProvider(metadata)
     import dataclasses as dc
 
-    def walk(n: P.PlanNode) -> P.PlanNode:
-        n = _rewrite_sources(n, tuple(walk(s) for s in n.sources))
+    # compaction pays only when a WIDTH-SENSITIVE operator consumes the
+    # tightened lanes downstream (joins/sorts/grouping run at input
+    # width); a filter feeding only a global aggregate would pay the
+    # cumsum+gather for nothing (measured: a plain scan+filter+sum went
+    # 0.065s -> 0.58s with an unconditional compact).  Aggregates/TopN
+    # reset the width for everything above them.
+    _consumers = (P.Join, P.SemiJoin, P.Sort, P.TopN, P.Window, P.Distinct)
+
+    def walk(n: P.PlanNode, width_sensitive_above: bool) -> P.PlanNode:
+        child_flag = (
+            isinstance(n, _consumers)
+            or (isinstance(n, P.Aggregate) and bool(n.keys))
+            or (
+                width_sensitive_above
+                and not isinstance(n, (P.Aggregate, P.TopN))
+            )
+        )
+        n = _rewrite_sources(
+            n, tuple(walk(s, child_flag) for s in n.sources)
+        )
+        if not width_sensitive_above:
+            return n
         if isinstance(n, P.Filter):
             try:
                 est = stats.estimate(n).rows
@@ -743,7 +763,7 @@ def _annotate_compaction(
             return n
         return n
 
-    return walk(node)
+    return walk(node, False)
 
 
 # --- functional-dependency group-key pruning ---------------------------
