@@ -133,6 +133,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "direct_address_joins",
+            "probe stats-proven-unique dense integer build keys through "
+            "a direct-address table (one gather) instead of sort-merge",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "compaction",
             "tighten survivors of selective filters/joins into a smaller "
             "static capacity (downstream ops run at the reduced width)",

@@ -291,6 +291,11 @@ class LocalExecutor:
             # hash-colliding) keys: re-traced with the expansion kernel
             # (HashBuilderOperator never assumes uniqueness; we learn it)
             self.force_expansion = set()
+            # direct-address joins whose domain proof failed at runtime
+            # (stale stats): first rung retries the sorted UNIQUE kernel
+            # (still exact for a unique key outside its claimed domain);
+            # only a genuine duplicate then escalates to expansion
+            self.force_no_direct = set()
             self.group_salt = 0
             self.topn_factor = int(
                 self.config.get("topn_initial_factor") or 1
@@ -306,6 +311,9 @@ class LocalExecutor:
                 (self.group_capacity, self.join_factor, self.topn_factor,
                  self.force_wide_mul, forced, _) = hint[:6]
                 self.compact_factor = hint[6] if len(hint) > 6 else 1
+                self.force_no_direct = (
+                    set(hint[7]) if len(hint) > 7 else set()
+                )
                 self.force_expansion = set(forced)
             else:
                 est = self._estimate_group_capacity(plan, counts)
@@ -439,9 +447,19 @@ class LocalExecutor:
                 fell_back = False
                 for (join_node, _), dup in zip(dups, dup_vals):
                     if int(dup) > 0:
-                        # duplicate (or colliding) build keys: re-trace with
-                        # the many-to-many expansion kernel for this join
-                        self.force_expansion.add(id(join_node))
+                        if (
+                            getattr(join_node, "direct_domain", None)
+                            is not None
+                            and id(join_node) not in self.force_no_direct
+                        ):
+                            # direct-table domain/dup proof failed: retry
+                            # on the sorted unique kernel first
+                            self.force_no_direct.add(id(join_node))
+                        else:
+                            # duplicate (or colliding) build keys:
+                            # re-trace with the many-to-many expansion
+                            # kernel for this join
+                            self.force_expansion.add(id(join_node))
                         fell_back = True
                 for cv in coll_vals:
                     if int(cv) > 0:
@@ -492,6 +510,7 @@ class LocalExecutor:
                     self.topn_factor, self.force_wide_mul,
                     frozenset(self.force_expansion), plan,
                     self.compact_factor,
+                    frozenset(self.force_no_direct),
                 )
                 for k in list(hints)[:-512]:
                     hints.pop(k, None)
@@ -864,6 +883,7 @@ class LocalExecutor:
             getattr(self, "group_salt", 0),
             getattr(self, "force_wide_mul", False),
             frozenset(getattr(self, "force_expansion", ())),
+            frozenset(getattr(self, "force_no_direct", ())),
             # a compiled program is a pure function of (plan, capacities,
             # padded lane shapes, BAKED dictionary contents) — NOT of
             # which splits produced the rows.  The per-scan component is
@@ -1602,9 +1622,24 @@ class _TraceCtx:
         ) or join_ops.needs_verification(lkeys)
         bkey = join_ops.composite_key(rkeys, right.sel, need_verify)
         pkey = join_ops.composite_key(lkeys, left.sel, need_verify)
-        src = join_ops.build_unique(bkey, right.sel)
-        self.dup_checks.append((node, src.dup_count))
-        row, matched = join_ops.probe(src, pkey, left.sel)
+        if (
+            node.direct_domain is not None
+            and not need_verify
+            and id(node) not in getattr(self.ex, "force_no_direct", ())
+        ):
+            # dense-domain direct addressing: one scatter builds, one
+            # gather probes; a violation/duplicate count retries on the
+            # sorted unique kernel (then expansion if genuinely dup)
+            lo, hi = node.direct_domain
+            dsrc = join_ops.build_direct(
+                bkey, right.sel, lo, hi - lo + 1
+            )
+            self.dup_checks.append((node, dsrc.violations))
+            row, matched = join_ops.probe_direct(dsrc, pkey, left.sel)
+        else:
+            src = join_ops.build_unique(bkey, right.sel)
+            self.dup_checks.append((node, src.dup_count))
+            row, matched = join_ops.probe(src, pkey, left.sel)
         if need_verify:
             # exact equality on the real key columns: a 64-bit locator
             # collision must reject the candidate, not return a wrong row

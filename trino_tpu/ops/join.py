@@ -129,6 +129,67 @@ def gather_build(
     return permute_lanes(build_cols, build_row, extra_ok=matched)
 
 
+class DirectLookupSource(NamedTuple):
+    """Dense-domain build table: rowid+1 scattered at (key - lo), 0 =
+    empty slot.  Collision-FREE addressing (no hash, no verification);
+    usable only when the planner PROVED the build key unique (strict
+    stats walker) and bounded its domain — the runtime still counts
+    out-of-domain build keys and reroutes the join to the sorted kernels
+    when the proof was wrong (stale stats), so results stay exact.
+
+    Reference analog: the array-based lookup source the generated
+    JoinCompiler emits for dense integer keys
+    (operator/join/ArrayPositionLinks / PagesHash fast path); TPU-first
+    shape: one scatter to build, ONE random gather per probe row —
+    measured 0.09s vs the sort-merge rank's 0.21s at 4M probes
+    (MICRO_probe.json)."""
+
+    table: jnp.ndarray  # [domain] int32: build row + 1, 0 = empty
+    lo: int
+    violations: jnp.ndarray  # scalar: live build keys outside the domain
+
+
+def build_direct(key: Lane, sel: jnp.ndarray, lo: int, domain: int
+                 ) -> DirectLookupSource:
+    v, ok = key
+    live = sel & ok
+    kv = v.astype(jnp.int64) - lo
+    in_dom = (kv >= 0) & (kv < domain)
+    viol = jnp.sum(live & ~in_dom).astype(jnp.int64)
+    idx = jnp.where(live & in_dom, kv, domain)  # dropped writes
+    n = v.shape[0]
+    rowid1 = jnp.arange(1, n + 1, dtype=jnp.int32)
+    table = (
+        jnp.zeros(domain, dtype=jnp.int32)
+        .at[idx]
+        .max(rowid1, mode="drop")
+    )
+    # duplicate detector: each live row gathers its slot back — with a
+    # truly unique key every row reads its own write; an overwritten row
+    # reads a different rowid.  One cheap gather over the BUILD side, so
+    # exactness never rests on the planner's stats being right.
+    readback = table[jnp.clip(kv, 0, domain - 1)]
+    dups = jnp.sum(
+        live & in_dom & (readback != rowid1)
+    ).astype(jnp.int64)
+    return DirectLookupSource(table, lo, viol + dups)
+
+
+def probe_direct(
+    source: DirectLookupSource, key: Lane, sel: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One gather: build row index + matched mask per probe row.
+    Out-of-domain probe keys match nothing — exact, because the build
+    violation counter guarantees every live build key IS in-domain."""
+    v, ok = key
+    kv = v.astype(jnp.int64) - source.lo
+    domain = source.table.shape[0]
+    in_dom = (kv >= 0) & (kv < domain)
+    slot = source.table[jnp.clip(kv, 0, domain - 1)]
+    matched = sel & ok & in_dom & (slot > 0)
+    return (slot - 1).astype(jnp.int64), matched
+
+
 class MultiLookupSource(NamedTuple):
     """Build side with duplicate keys allowed (the general PagesHash)."""
 

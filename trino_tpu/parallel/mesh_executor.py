@@ -121,6 +121,7 @@ class MeshExecutor(LocalExecutor):
         self.group_capacity = int(self.config.get("group_capacity", 4096))
         self.join_factor = 1
         self.force_expansion = set()
+        self.force_no_direct = set()
         self.group_salt = 0
         self.topn_factor = 1
         self.force_wide_mul = False
@@ -162,9 +163,17 @@ class MeshExecutor(LocalExecutor):
             fell_back = False
             for (join_node, _), d in zip(ctx.dup_checks, dups):
                 if int(d) > 0:
-                    # duplicate/colliding build keys: re-trace this join
-                    # with the many-to-many expansion kernel
-                    self.force_expansion.add(id(join_node))
+                    if (
+                        getattr(join_node, "direct_domain", None)
+                        is not None
+                        and id(join_node) not in self.force_no_direct
+                    ):
+                        # direct-table proof failed: sorted unique first
+                        self.force_no_direct.add(id(join_node))
+                    else:
+                        # duplicate/colliding build keys: re-trace this
+                        # join with the many-to-many expansion kernel
+                        self.force_expansion.add(id(join_node))
                     fell_back = True
             for cv in colls:
                 if int(cv) > 0:

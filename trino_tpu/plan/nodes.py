@@ -412,6 +412,12 @@ class Join(PlanNode):
     # Filter.compact_rows): selective inner joins tighten the surviving
     # rows into a smaller static capacity before downstream operators
     compact_rows: Optional[int] = None
+    # (lo, hi) build-key value bounds for the direct-address (dense
+    # domain) lookup table — set by the optimizer when the build key is
+    # a stats-proven-unique narrow integer with a bounded domain; the
+    # executor probes with ONE gather instead of sort-merge ranks and
+    # self-verifies (ops/join.build_direct)
+    direct_domain: Optional[Tuple[int, int]] = None
 
     @property
     def sources(self):
@@ -718,6 +724,8 @@ def plan_to_string(
             extra = f" {n.kind} on={list(n.criteria)}"
             if n.distribution:
                 extra += f" dist={n.distribution}"
+            if n.direct_domain:
+                extra += f" direct=[{n.direct_domain[0]},{n.direct_domain[1]}]"
         elif isinstance(n, (TopN,)):
             extra = f" n={n.count} keys={[k.column for k in n.keys]}"
         elif isinstance(n, Limit):

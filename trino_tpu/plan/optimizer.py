@@ -64,6 +64,8 @@ def optimize(
             cur = sink_predicates(cur)
     if metadata is not None and prop("fd_group_key_pruning"):
         cur = _prune_fd_group_keys(cur, metadata)
+    if metadata is not None and prop("direct_address_joins"):
+        cur = _annotate_direct_joins(cur, metadata)
     if metadata is not None and prop("compaction"):
         cur = _annotate_compaction(cur, metadata, properties)
     if prop("column_pruning"):
@@ -683,6 +685,102 @@ def _choose_join_distribution(
         return dataclasses.replace(n, distribution=dist)
 
     return walk(node)
+
+
+# --- direct-address join annotation ------------------------------------
+
+# biggest dense-domain lookup table the executor may allocate (i32
+# entries: 64M = 256 MB HBM) and how sparse the domain may be relative
+# to the build rows before the table wastes more than it saves
+_DIRECT_MAX_DOMAIN = 64 << 20
+_DIRECT_SPARSITY = 16
+
+
+def _scan_minmax(node: P.PlanNode, symbol: str, metadata: Metadata):
+    """(lo, hi) value bounds for `symbol`, traced through identity
+    projections/filters to its scan column's statistics."""
+    while True:
+        if isinstance(node, P.Filter):
+            node = node.source
+            continue
+        if isinstance(node, P.Project):
+            nxt = None
+            for s, e in node.assignments:
+                if s == symbol:
+                    if isinstance(e, ir.ColumnRef):
+                        nxt = e.name
+                    break
+            if nxt is None:
+                return None
+            symbol, node = nxt, node.source
+            continue
+        if isinstance(node, P.Join):
+            side = (
+                node.left
+                if symbol in node.left.output_symbols() else node.right
+            )
+            node = side
+            continue
+        if isinstance(node, P.TableScan):
+            col = dict(node.assignments).get(symbol)
+            if col is None:
+                return None
+            cs = metadata.table_statistics(
+                node.catalog, node.table
+            ).columns.get(col)
+            if cs is None or cs.min_value is None or cs.max_value is None:
+                return None
+            return int(cs.min_value), int(cs.max_value)
+        return None
+
+
+def _annotate_direct_joins(node: P.PlanNode, metadata: Metadata) -> P.PlanNode:
+    """Dense-domain build keys probe through a direct-address table (one
+    scatter + one gather) instead of sort-merge ranks — measured 2.3x on
+    the locate step at 4M probes (MICRO_probe.json), and the build sort
+    disappears.  Requirements (ops/join.DirectLookupSource): build key
+    strict-proven unique, narrow integer, bounded domain from column
+    stats.  The runtime self-verifies (violation + duplicate counters
+    reroute to the sorted kernels), so stale stats cost a retry, never a
+    wrong row.
+
+    Reference analog: JoinCompiler's array-based lookup source for dense
+    integer keys (operator/join/PagesHash + ArrayPositionLinks)."""
+    import dataclasses as dc
+
+    node = _rewrite_sources(
+        node,
+        tuple(_annotate_direct_joins(s, metadata) for s in node.sources),
+    )
+    if not (
+        isinstance(node, P.Join)
+        and node.kind in ("inner", "left")
+        and len(node.criteria) == 1
+        and not node.expansion
+    ):
+        return node
+    pk, bk = node.criteria[0]
+    types = node.right.output_types()
+    bt = types.get(bk)
+    pt = node.left.output_types().get(pk)
+    for t in (bt, pt):
+        if t is None or getattr(t, "wide", False):
+            return node
+        if t.name not in ("bigint", "integer", "date"):
+            return node
+    if not _key_unique_strict(node.right, bk, metadata):
+        return node
+    mm = _scan_minmax(node.right, bk, metadata)
+    if mm is None:
+        return node
+    lo, hi = mm
+    domain = hi - lo + 1
+    if domain < 1 or domain > _DIRECT_MAX_DOMAIN:
+        return node
+    rows = _estimate_rows(node.right, metadata)
+    if domain > max(_DIRECT_SPARSITY * rows, 1 << 20):
+        return node
+    return dc.replace(node, direct_domain=(lo, hi))
 
 
 # --- compaction annotation ---------------------------------------------
