@@ -203,3 +203,59 @@ def test_speculation_off_waits_for_straggler(runner, oracle_conn):
     page = fte.run(plan, qid)
     elapsed = time.time() - t0
     assert page.count and elapsed >= stall * 0.9
+
+
+def test_adaptive_replanning_flips_misoriented_join(runner, oracle_conn):
+    """AdaptivePlanner analog: a downstream fragment's inner join whose
+    static orientation put the BIG input on the build side gets
+    re-oriented from the observed spool bytes of the committed upstream
+    stages; the swap is recorded and results stay exact."""
+    import dataclasses
+
+    nm = runner.coordinator.coordinator.node_manager
+    sql = (
+        "select count(*) c, sum(l_quantity) q "
+        "from orders, lineitem where o_orderkey = l_orderkey"
+    )
+    plan = runner.session._plan_stmt(parse(sql))
+
+    # inject the mis-estimate: force the join the planner oriented
+    # (build = orders, the smaller side) into the WRONG orientation
+    import trino_tpu.plan.nodes as P
+
+    def swap(n):
+        srcs = tuple(swap(s) for s in n.sources)
+        if srcs and any(a is not b for a, b in zip(srcs, n.sources)):
+            from trino_tpu.plan.memo import _replace_sources
+
+            n = _replace_sources(n, srcs)
+        if isinstance(n, P.Join) and n.kind == "inner" and n.criteria:
+            return P.Join(
+                "inner", n.right, n.left,
+                tuple((r, l) for l, r in n.criteria), n.filter,
+                expansion=True,
+            )
+        return n
+
+    bad = swap(plan)
+    fte = FaultTolerantScheduler(
+        runner.session.catalogs, nm,
+        properties={"group_capacity": 4096},
+    )
+    page = fte.run(bad, "q_adaptive_on")
+    expected = oracle_conn.execute(oracle_dialect(sql)).fetchall()
+    assert_rows_match(page.to_pylist(), expected, tol=2e-2, ordered=False)
+    assert any(
+        a["action"] == "swap_join_sides"
+        and a["observed_right_bytes"] > a["observed_left_bytes"]
+        for a in fte.adaptive_actions
+    ), fte.adaptive_actions
+
+    # adaptive off: same (slower) plan still answers correctly, no actions
+    fte_off = FaultTolerantScheduler(
+        runner.session.catalogs, nm,
+        properties={"group_capacity": 4096, "adaptive_replanning": False},
+    )
+    page2 = fte_off.run(bad, "q_adaptive_off")
+    assert_rows_match(page2.to_pylist(), expected, tol=2e-2, ordered=False)
+    assert fte_off.adaptive_actions == []

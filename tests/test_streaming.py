@@ -74,16 +74,23 @@ def test_q3_streams_exact(free):
     assert _streamed(Q3) == free.execute(Q3).to_pylist()
 
 
-def test_count_distinct_falls_back_loudly(free):
-    """count(DISTINCT) needs raw rows colocated (not partializable):
-    streaming refuses and the memory limit surfaces loudly rather than
-    silently wrong — the hash-partitioned distinct is the mesh path."""
+def test_count_distinct_streams_under_memory_limit(free):
+    """Round 3 refused this (raw rows gathered to one task); the
+    decomposed plan (count over hash-partitioned Distinct) now tiles —
+    and with the rewrite disabled the limit still surfaces LOUDLY rather
+    than silently wrong."""
     from trino_tpu.utils.memory import ExceededMemoryLimitError
 
     q = "select count(distinct l_suppkey) from lineitem"
+    ref = tpch_session(0.05).execute(q).to_pylist()
     s = tpch_session(0.05, query_max_memory_bytes=1_000_000)
+    assert s.execute(q).to_pylist() == ref
+    raw = tpch_session(
+        0.05, query_max_memory_bytes=1_000_000,
+        distinct_agg_rewrite=False,
+    )
     with pytest.raises(ExceededMemoryLimitError):
-        s.execute(q)
+        raw.execute(q)
 
 
 def test_multiple_tiles_used(free):
@@ -120,3 +127,41 @@ def test_pure_sort_falls_back_to_spill():
     finally:
         streaming.execute_streaming = orig
     assert not refused, "streaming engaged for a non-reducing sort plan"
+
+
+def test_global_count_distinct_streams_instead_of_refusing():
+    """count(DISTINCT x) over an oversized scan used to refuse streaming
+    (raw rows gathered to one task); the decomposed plan (count over a
+    hash-partitioned Distinct) tiles the scan and dedups per tile."""
+    from trino_tpu.session import tpch_session
+
+    s = tpch_session(0.05)
+    sql = "select count(distinct l_orderkey) from lineitem"
+    expected = s.execute(sql).to_pylist()
+    # tiny budget: the lineitem scan cannot be device-resident at once
+    tiny = tpch_session(0.05, query_max_memory_bytes=1 << 20)
+    got = tiny.execute(sql).to_pylist()
+    assert got == expected
+
+
+def test_count_distinct_rewrite_plan_shape_and_parity():
+    import trino_tpu.plan.nodes as P
+    from trino_tpu.session import tpch_session
+
+    s = tpch_session(0.01)
+    sql = "select count(distinct l_suppkey) c from lineitem where l_quantity < 10"
+    plan = s.plan(sql)
+    found = []
+
+    def walk(n):
+        if isinstance(n, P.Distinct):
+            found.append(n)
+        for x in n.sources:
+            walk(x)
+
+    walk(plan)
+    assert found, P.plan_to_string(plan)
+    r1 = s.execute(sql).to_pylist()
+    s.execute("set session distinct_agg_rewrite = false")
+    r2 = s.execute(sql).to_pylist()
+    assert r1 == r2

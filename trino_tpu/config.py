@@ -133,6 +133,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "distinct_agg_rewrite",
+            "decompose global count(DISTINCT x) into count over a "
+            "hash-partitionable Distinct (scales out / tiles)",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "direct_address_joins",
             "probe stats-proven-unique dense integer build keys through "
             "a direct-address table (one gather) instead of sort-merge",

@@ -56,6 +56,18 @@ class FragmentExecutor(LocalExecutor):
         self.df_rows_pruned = 0
 
     # ------------------------------------------------------------------
+    def preload(self, plan: P.PlanNode) -> None:
+        """Load this tile's host arrays ahead of time (background
+        thread): split generation / parquet decode overlaps the previous
+        tile's device compute — the double-buffered host->HBM pipeline
+        (SURVEY §7 hard part 6).  Host-only: device uploads still happen
+        on the execute thread."""
+        scans: Dict[int, dict] = {}
+        dicts: Dict[str, np.ndarray] = {}
+        counts: Dict[int, int] = {}
+        self._load_scans(plan, scans, dicts, counts)
+        self._preloaded = (plan, scans, dicts, counts)
+
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
         self._scan_idx = 0
         self._load_walk(node, scans, dicts, counts)

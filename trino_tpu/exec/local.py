@@ -273,11 +273,18 @@ class LocalExecutor:
             sp = spill.plan_window_spill(self, plan, int(limit))
             if sp is not None:
                 return spill.execute_spilled_window(self, plan, *sp)
-        # 1. host side: load scans, collect dictionaries
-        scans: Dict[int, Dict[str, np.ndarray]] = {}
-        dicts: Dict[str, np.ndarray] = {}
-        counts: Dict[int, int] = {}
-        self._load_scans(plan, scans, dicts, counts)
+        # 1. host side: load scans, collect dictionaries — or adopt the
+        # arrays a streaming prefetcher loaded on a background thread
+        # while the previous tile computed on-device (double buffering)
+        pre = getattr(self, "_preloaded", None)
+        if pre is not None and pre[0] is plan:
+            _, scans, dicts, counts = pre
+            self._preloaded = None
+        else:
+            scans = {}
+            dicts = {}
+            counts = {}
+            self._load_scans(plan, scans, dicts, counts)
         self._account_memory(scans, limit)
         pool = self.config.get("memory_pool")
         try:

@@ -66,6 +66,8 @@ def optimize(
         cur = _prune_fd_group_keys(cur, metadata)
     if metadata is not None and prop("direct_address_joins"):
         cur = _annotate_direct_joins(cur, metadata)
+    if prop("distinct_agg_rewrite"):
+        cur = _rewrite_global_count_distinct(cur)
     if metadata is not None and prop("compaction"):
         cur = _annotate_compaction(cur, metadata, properties)
     if prop("column_pruning"):
@@ -685,6 +687,46 @@ def _choose_join_distribution(
         return dataclasses.replace(n, distribution=dist)
 
     return walk(node)
+
+
+# --- global count(DISTINCT) decomposition ------------------------------
+
+
+def _rewrite_global_count_distinct(node: P.PlanNode) -> P.PlanNode:
+    """count(DISTINCT x) with no GROUP BY -> count(x) over
+    Distinct(Project x).  The Distinct hash-partitions across tasks/mesh
+    devices and tiles under the streaming executor (its partial step
+    dedups locally), so an oversized distinct no longer needs every raw
+    row gathered to one task — the reference reaches the same shape via
+    MultipleDistinctAggregationToMarkDistinct + partial aggregation
+    (iterative/rule/, PushPartialAggregationThroughExchange)."""
+    import dataclasses as dc
+
+    node = _rewrite_sources(
+        node,
+        tuple(_rewrite_global_count_distinct(s) for s in node.sources),
+    )
+    if not (
+        isinstance(node, P.Aggregate)
+        and node.step == "single"
+        and not node.keys
+        and len(node.aggs) == 1
+        and node.aggs[0].distinct
+        and node.aggs[0].kind == "count"
+        and node.aggs[0].arg is not None
+    ):
+        return node
+    a = node.aggs[0]
+    x = a.arg
+    xt = node.source.output_types().get(x)
+    if xt is None:
+        return node
+    proj = P.Project(node.source, ((x, ir.ColumnRef(xt, x)),))
+    return dc.replace(
+        node,
+        source=P.Distinct(proj),
+        aggs=(dc.replace(a, distinct=False),),
+    )
 
 
 # --- direct-address join annotation ------------------------------------

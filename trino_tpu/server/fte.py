@@ -59,6 +59,13 @@ SPECULATION_MIN_S = 0.75
 MEMORY_GROWTH_FACTOR = 2
 
 
+def _count_scans(n: P.PlanNode) -> int:
+    total = 1 if isinstance(n, P.TableScan) else 0
+    for s in n.sources:
+        total += _count_scans(s)
+    return total
+
+
 def _median(xs: List[float]) -> float:
     s = sorted(xs)
     return s[len(s) // 2]
@@ -116,6 +123,11 @@ class FaultTolerantScheduler:
         # committed spool dirs: fragment -> [task_index -> SpoolHandle path]
         committed: Dict[int, List[str]] = {}
         self._created_tasks: List[Tuple[str, str]] = []  # (uri, task_id)
+        # observed spool bytes per completed fragment (the
+        # OutputStatsEstimator role) + the adaptive actions taken from
+        # them (surfaced for tests/observability)
+        self.output_stats: Dict[int, int] = {}
+        self.adaptive_actions: List[dict] = []
         try:
             order = sorted(
                 (f for f in fragments if f.id != 0), key=lambda f: f.id
@@ -124,6 +136,10 @@ class FaultTolerantScheduler:
                 committed[f.id] = self._run_stage(
                     query_id, f, width, committed, by_id, consumer
                 )
+                if bool(self.properties.get("adaptive_replanning", True)):
+                    self.output_stats[f.id] = self._spool_bytes(
+                        committed[f.id]
+                    )
             from ..exchange.filesystem import SpoolHandle
 
             root_pages = read_spool_pages(
@@ -183,7 +199,8 @@ class FaultTolerantScheduler:
             else 1
         )
         per_task_splits = assign_splits(self.catalogs, f, ntasks)
-        frag_json = plan_to_json(f.root)
+        root = self._adapt_fragment(f)
+        frag_json = plan_to_json(root)
         from concurrent.futures import ThreadPoolExecutor
 
         sibling_times: List[float] = []  # completed task durations (stage)
@@ -197,6 +214,131 @@ class FaultTolerantScheduler:
                 for i in range(ntasks)
             ]
             return [fut.result() for fut in futures]
+
+    def _spool_bytes(self, spool_dirs: List[str]) -> int:
+        """Total committed UNCOMPRESSED output bytes of a stage, read
+        from the page-frame headers only (serde.pages_stats) — the
+        observed stat the adaptive planner consumes.  Compressed file
+        sizes would misrank sides (zstd flattens monotone int columns
+        ~10x)."""
+        import os
+
+        from ..serde import pages_stats
+
+        total = 0
+        for d in spool_dirs:
+            try:
+                for base, _dirs, files in os.walk(d):
+                    for name in files:
+                        p = os.path.join(base, name)
+                        try:
+                            with open(p, "rb") as fh:
+                                _rows, ub = pages_stats(fh.read())
+                            total += ub
+                        except Exception:
+                            total += os.path.getsize(p)
+            except OSError:
+                pass
+        return total
+
+    def _adapt_fragment(self, f: PlanFragment) -> P.PlanNode:
+        """Adaptive replanning between stages (AdaptivePlanner.java +
+        OutputStatsEstimator, scoped to the structure-preserving action):
+        a not-yet-run fragment's inner join whose sides read committed
+        upstream spools is RE-ORIENTED when the observed bytes contradict
+        the planner's static choice — the build (right) side must be the
+        smaller input.  Exchange topology, task widths, and buffer
+        addressing are untouched; the in-fragment executor re-derives
+        kernel flags (dup self-checks make a wrong uniqueness guess a
+        retry, not an error)."""
+        if not self.output_stats or not bool(
+            self.properties.get("adaptive_replanning", True)
+        ):
+            return f.root
+
+        stats = self.output_stats
+
+        def observed(n: P.PlanNode) -> Optional[int]:
+            """Bytes entering this subtree: committed spool sizes for
+            RemoteSources (the real observation), connector-stats
+            estimates for fragment-local scans (rows x cols x 8 — both
+            sides must be comparable even when only one crossed an
+            exchange)."""
+            total = 0
+            found = False
+            bad = False
+
+            def walk(x):
+                nonlocal total, found, bad
+                if isinstance(x, P.RemoteSource):
+                    found = True
+                    if x.fragment_id in stats:
+                        total += stats[x.fragment_id]
+                    else:
+                        bad = True
+                    return
+                if isinstance(x, P.TableScan):
+                    found = True
+                    try:
+                        md = self.catalogs.get(x.catalog).metadata()
+                        rows = md.get_table_statistics(x.table).row_count
+                        total += int(rows) * 8 * max(
+                            len(x.assignments), 1
+                        )
+                    except Exception:
+                        bad = True
+                    return
+                for s in x.sources:
+                    walk(s)
+
+            walk(n)
+            if not found or bad:
+                return None
+            return total
+
+        import dataclasses as dc
+
+        def adapt(n: P.PlanNode) -> P.PlanNode:
+            srcs = tuple(adapt(s) for s in n.sources)
+            if srcs and any(a is not b for a, b in zip(srcs, n.sources)):
+                from ..plan.memo import _replace_sources
+
+                n = _replace_sources(n, srcs)
+            if not (
+                isinstance(n, P.Join)
+                and n.kind == "inner"
+                and n.criteria
+            ):
+                return n
+            lb = observed(n.left)
+            rb = observed(n.right)
+            if lb is None or rb is None or rb <= lb * 2:
+                return n
+            # swapping must not disturb the fragment's TableScan preorder:
+            # split assignment and dynamic filters address scans by index
+            # (fragment.scan_tables), so only swap when at most one side
+            # holds scans
+            if _count_scans(n.left) and _count_scans(n.right):
+                return n
+            self.adaptive_actions.append({
+                "action": "swap_join_sides",
+                "fragment": f.id,
+                "observed_left_bytes": lb,
+                "observed_right_bytes": rb,
+            })
+            return P.Join(
+                "inner", n.right, n.left,
+                tuple((r, l) for l, r in n.criteria),
+                n.filter,
+                expansion=False,  # runtime dup checks re-derive exactly
+                distribution=n.distribution,
+                compact_rows=n.compact_rows,
+            )
+
+        try:
+            return adapt(f.root)
+        except Exception:
+            return f.root
 
     def _start_attempt(
         self, query_id, f, task_index, attempt, frag_json, splits,
