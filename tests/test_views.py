@@ -1,0 +1,123 @@
+"""CREATE/DROP VIEW + expansion tests.
+
+Reference parity: StatementAnalyzer.java:1027 (visitCreateView),
+metadata/ViewDefinition.java:28 (stored originalSql + columns), view
+expansion in the analyzer's table branch; information_schema-style
+listing via system.metadata.views.
+"""
+import sqlite3
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from trino_tpu.session import tpch_session
+
+SF = 0.001
+
+
+@pytest.fixture()
+def session():
+    return tpch_session(SF)
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["orders", "customer"])
+    return conn
+
+
+def rows(s, sql):
+    return s.execute(sql).to_pylist()
+
+
+def test_view_round_trip(session, oracle_conn):
+    session.execute(
+        "create view big_orders as "
+        "select o_orderkey, o_totalprice from orders "
+        "where o_totalprice > 100000"
+    )
+    sql = "select count(*), sum(o_orderkey) from big_orders"
+    assert_rows_match(
+        rows(session, sql),
+        oracle_conn.execute(
+            "select count(*), sum(o_orderkey) from (select o_orderkey, "
+            "o_totalprice from orders where o_totalprice > 100000)"
+        ).fetchall(),
+    )
+    session.execute("drop view big_orders")
+    with pytest.raises(Exception):
+        session.execute("select * from big_orders")
+
+
+def test_view_over_join(session, oracle_conn):
+    session.execute(
+        "create view ord_cust as "
+        "select o_orderkey, c_name, o_totalprice from orders "
+        "join customer on o_custkey = c_custkey"
+    )
+    sql = (
+        "select c_name, count(*), sum(o_totalprice) from ord_cust "
+        "where o_totalprice > 50000 group by c_name order by c_name limit 20"
+    )
+    oracle_sql = sql.replace(
+        "ord_cust",
+        "(select o_orderkey, c_name, o_totalprice from orders "
+        "join customer on o_custkey = c_custkey)",
+    )
+    assert_rows_match(
+        rows(session, sql), oracle_conn.execute(oracle_sql).fetchall()
+    )
+
+
+def test_create_or_replace_and_show(session):
+    session.execute("create view v as select 1 as x")
+    assert rows(session, "select * from v") == [(1,)]
+    session.execute("create or replace view v as select 2 as y")
+    assert rows(session, "select * from v") == [(2,)]
+    (ddl,) = rows(session, "show create view v")[0]
+    assert "select 2 as y" in ddl
+    cols = rows(session, "show columns from v")
+    assert cols == [("y", "bigint")]
+    tables = [r[0] for r in rows(session, "show tables")]
+    assert "v" in tables
+    listed = rows(
+        session,
+        "select table_name, view_definition from system.metadata.views",
+    )
+    assert ("v", "select 2 as y") in listed
+    session.execute("drop view v")
+    assert "v" not in [r[0] for r in rows(session, "show tables")]
+
+
+def test_view_duplicate_and_if_exists(session):
+    session.execute("create view dup as select 1 as x")
+    with pytest.raises(Exception, match="already exists"):
+        session.execute("create view dup as select 2 as x")
+    session.execute("drop view dup")
+    with pytest.raises(Exception, match="not found"):
+        session.execute("drop view dup")
+    session.execute("drop view if exists dup")  # no error
+
+
+def test_view_cannot_shadow_table(session):
+    with pytest.raises(Exception, match="already exists"):
+        session.execute("create view orders as select 1 as x")
+
+
+def test_view_over_view(session):
+    session.execute("create view v1 as select o_orderkey k from orders")
+    session.execute("create view v2 as select k + 1 as k1 from v1")
+    n = rows(session, "select count(*) from orders")[0][0]
+    assert rows(session, "select count(*) from v2") == [(n,)]
+    got = rows(session, "select min(k1) from v2")
+    base = rows(session, "select min(o_orderkey) + 1 from orders")
+    assert got == base
+
+
+def test_view_over_memory_table(session):
+    session.create_catalog("memory", "memory", {})
+    session.execute("create table memory.default.t (a bigint, b bigint)")
+    session.execute("insert into memory.default.t values (1, 2)")
+    session.execute("create view tv as select * from memory.default.t")
+    assert rows(session, "select * from tv") == [(1, 2)]

@@ -175,3 +175,46 @@ def test_varchar_partition_key(session, oracle_conn):
         "row_number() over (partition by o_orderpriority order by o_orderkey) rn "
         "from orders order by o_orderpriority, o_orderkey limit 100",
     )
+
+
+def test_rows_frame_sliding_minmax(session, oracle_conn):
+    """Sliding (bounded both ends) min/max frames — the binary-lifting
+    range reduction (ops/window._range_extreme; the reference computes
+    these per-frame in operator/window/)."""
+    check(
+        session, oracle_conn,
+        "select o_custkey, o_orderkey, "
+        "min(o_totalprice) over (partition by o_custkey order by o_orderkey "
+        "  rows between 3 preceding and current row) mn, "
+        "max(o_totalprice) over (partition by o_custkey order by o_orderkey "
+        "  rows between 2 preceding and 1 following) mx "
+        "from orders order by o_custkey, o_orderkey limit 200",
+    )
+
+
+def test_rows_frame_sliding_minmax_following_only(session, oracle_conn):
+    check(
+        session, oracle_conn,
+        "select o_orderkey, "
+        "min(o_totalprice) over (order by o_orderkey "
+        "  rows between 1 following and 3 following) mn, "
+        "max(o_totalprice) over (order by o_orderkey "
+        "  rows between current row and 2 following) mx "
+        "from orders order by o_orderkey limit 200",
+    )
+
+
+def test_sliding_minmax_empty_frames_null(session):
+    """Frames that are empty (entirely past the partition edge) must
+    yield NULL, matching the reference's empty-frame semantics."""
+    from trino_tpu.session import Session
+
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table ef (o bigint, v bigint)")
+    s.execute("insert into ef values (1, 10), (2, 20), (3, 30)")
+    got = s.execute(
+        "select o, max(v) over (order by o "
+        "rows between 2 following and 3 following) from ef order by o"
+    ).to_pylist()
+    assert got == [(1, 30), (2, None), (3, None)]

@@ -6,9 +6,23 @@ connector/CatalogManager + DefaultCatalogFactory (etc/catalog/*.properties
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import dataclasses
+from typing import Dict, List, Optional, Tuple
 
 from .spi import Connector, ConnectorFactory, TableSchema, TableStatistics
+
+
+@dataclasses.dataclass(frozen=True)
+class ViewDefinition:
+    """Stored CREATE VIEW definition (metadata/ViewDefinition.java:28:
+    originalSql + column list, expanded at analysis time by the
+    StatementAnalyzer's view branch — here Analyzer._plan_table)."""
+
+    catalog: str
+    name: str
+    original_sql: str  # the view's query text, as written
+    query: object  # parsed ast.Node of the query
+    columns: Tuple[Tuple[str, str], ...]  # (name, type text) at creation
 
 
 class CatalogManager:
@@ -37,6 +51,54 @@ class Metadata:
 
     def __init__(self, catalogs: CatalogManager):
         self.catalogs = catalogs
+        # session-lived view registry keyed (catalog, view_name); the
+        # reference delegates durability to connector metastores, which
+        # the memory-connector-style store mirrors for every catalog
+        self.views: Dict[Tuple[str, str], ViewDefinition] = {}
+
+    def _qualify(self, parts, default_catalog: Optional[str]):
+        if len(parts) == 3:
+            return parts[0], parts[2]
+        if len(parts) == 2:
+            return default_catalog, parts[1]
+        return default_catalog, parts[0]
+
+    def lookup_view(
+        self, parts, default_catalog: Optional[str]
+    ) -> Optional[ViewDefinition]:
+        catalog, name = self._qualify(parts, default_catalog)
+        if catalog is None:
+            return None
+        return self.views.get((catalog, name.lower()))
+
+    def create_view(self, view: ViewDefinition, replace: bool):
+        key = (view.catalog, view.name.lower())
+        if not replace and key in self.views:
+            raise ValueError(f"view already exists: {view.name}")
+        # a view must not shadow a real table (the reference raises
+        # TABLE_ALREADY_EXISTS at analysis)
+        try:
+            tables = self.catalogs.get(view.catalog).metadata().list_tables()
+        except (KeyError, NotImplementedError):
+            tables = []
+        if view.name.lower() in tables:
+            raise ValueError(
+                f"table with that name already exists: {view.name}"
+            )
+        self.views[key] = view
+
+    def drop_view(self, parts, default_catalog, if_exists: bool):
+        catalog, name = self._qualify(parts, default_catalog)
+        key = (catalog, name.lower())
+        if key not in self.views:
+            if if_exists:
+                return False
+            raise KeyError(f"view not found: {'.'.join(parts)}")
+        del self.views[key]
+        return True
+
+    def list_views(self, catalog: str) -> List[str]:
+        return sorted(n for c, n in self.views if c == catalog)
 
     def resolve_table(
         self, parts, default_catalog: Optional[str]

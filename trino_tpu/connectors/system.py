@@ -52,6 +52,11 @@ SCHEMAS: Dict[str, List] = {
         ("http_uri", T.VARCHAR),
         ("state", T.VARCHAR),
     ],
+    "views": [
+        ("table_catalog", T.VARCHAR),
+        ("table_name", T.VARCHAR),
+        ("view_definition", T.VARCHAR),
+    ],
     "session_properties": [
         ("name", T.VARCHAR),
         ("value", T.VARCHAR),
@@ -85,7 +90,19 @@ class _SystemSource:
                         tabs.append(t)
                 except NotImplementedError:
                     pass
+            for (c, v) in sorted(getattr(s.metadata, "views", {})):
+                cats.append(c)
+                tabs.append(v)
             return {"table_catalog": cats, "table_name": tabs}
+        if table == "views":
+            views = sorted(
+                getattr(s.metadata, "views", {}).items()
+            )
+            return {
+                "table_catalog": [c for (c, _n), _v in views],
+                "table_name": [n for (_c, n), _v in views],
+                "view_definition": [v.original_sql for _k, v in views],
+            }
         if table == "columns":
             out = {"table_catalog": [], "table_name": [],
                    "column_name": [], "data_type": []}

@@ -403,6 +403,42 @@ def sort_group_ids(
     return perm, gid, ngroups, collisions
 
 
+def distinct_first_mask(
+    gid: jnp.ndarray, lane: Lane, live: jnp.ndarray
+) -> jnp.ndarray:
+    """First-occurrence mask per (group, value) over live rows, returned
+    in the CALLER's row order (MarkDistinctOperator analog,
+    /root/reference/core/trino-main/src/main/java/io/trino/operator/
+    MarkDistinctOperator.java:34 — but as one sort by (liveness, gid,
+    value-bits) + adjacent-first flags + an inverse-permutation scatter,
+    instead of a row-at-a-time hash table).  Any aggregate then runs its
+    NORMAL accumulator over `live & mask` — sum/avg/stddev(DISTINCT) and
+    multi-distinct all reduce to this one mask per (agg, input) pair
+    (DistinctAccumulatorFactory.java:36)."""
+    v, _ok = lane
+    n = gid.shape[0]
+    bit_lanes = list(_key_bit_lanes(v))
+    dead = jnp.logical_not(live)
+    ops = (dead, gid, *bit_lanes, jnp.arange(n, dtype=jnp.int64))
+    res = jax.lax.sort(ops, num_keys=2 + len(bit_lanes))
+    d2, g2 = res[0], res[1]
+    perm = res[-1]
+    neq = g2[1:] != g2[:-1]
+    for b in res[2:-1]:
+        neq = neq | (b[1:] != b[:-1])
+    first = jnp.concatenate([jnp.ones(1, bool), neq]) & jnp.logical_not(d2)
+    # perm is a permutation (unique indices): one n-sized scatter back
+    return jnp.zeros(n, dtype=bool).at[perm].set(first)
+
+
+# DISTINCT is semantically a no-op for these kinds (duplicates cannot
+# change an extremum / boolean fold / arbitrary pick)
+_DISTINCT_NOOP = ("min", "max", "bool_and", "bool_or", "arbitrary",
+                  "approx_distinct")
+# kinds whose accumulators correctly consume a dedup-refined live mask
+_DISTINCT_MASKED = ("sum", "avg", "count_if", "geometric_mean") + MOMENT_KINDS
+
+
 def distinct_count(
     gid: jnp.ndarray, lane: Lane, sel: jnp.ndarray, capacity: int
 ) -> jnp.ndarray:
@@ -743,9 +779,9 @@ def accumulate(
 
     for s in specs:
         o = s.output
-        if getattr(s, "distinct", False):
-            if s.kind != "count":
-                raise NotImplementedError(f"{s.kind}(DISTINCT) not supported")
+        if getattr(s, "distinct", False) and s.kind == "count":
+            # count(DISTINCT x): specialized one-sort path (the mask
+            # route would spend an extra scatter for the same answer)
             out[f"{o}$count"] = distinct_count(gid, lanes[s.input], sel, cap)
             continue
         if s.kind == "count_star":
@@ -753,6 +789,17 @@ def accumulate(
             continue
         v, ok = lanes[s.input]
         live = sel & ok
+        if getattr(s, "distinct", False) and s.kind not in _DISTINCT_NOOP:
+            if s.kind not in _DISTINCT_MASKED:
+                raise NotImplementedError(
+                    f"{s.kind}(DISTINCT) not supported"
+                )
+            if step != "single":
+                raise NotImplementedError(
+                    "DISTINCT aggregates are non-decomposable: the "
+                    "planner must not split them PARTIAL/FINAL"
+                )
+            live = live & distinct_first_mask(gid, (v, ok), live)
         if s.kind == "count":
             out[f"{o}$count"] = seg_cnt(live)
         elif s.kind == "count_if":

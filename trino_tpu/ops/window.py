@@ -317,6 +317,51 @@ def _segscan(v: jnp.ndarray, reset: jnp.ndarray, op, reverse: bool):
     return out
 
 
+def _range_extreme(
+    masked: jnp.ndarray, start: jnp.ndarray, end: jnp.ndarray, op,
+    identity,
+) -> jnp.ndarray:
+    """Per-row range reduction masked[start[i]..end[i]] for ARBITRARY
+    per-row ranges (the sliding-frame case the reference covers with
+    per-frame re-aggregation, operator/window/).
+
+    TPU-first design: a sparse-table (binary-lifting) reduction instead
+    of van Herk's fixed-width two-scan — van Herk needs one block width
+    for every row, but RANGE frames and partition-clipped ROWS frames
+    give each row its own [start, end].  Level k holds
+    T_k[i] = op(masked[i .. i+2^k-1]) built by a static shift+combine;
+    any range of width w is two overlapping 2^k blocks where
+    k = floor(log2(w)), so each level answers its rows with two gathers.
+    O(n log n) combines, static shapes, no sort, empty ranges keep the
+    op identity (the caller's count masks them to NULL)."""
+    n = masked.shape[0]
+    width = jnp.maximum(end - start + 1, 0)
+    # floor(log2(width)) per row (width < 1 never queried: out stays id)
+    lev = jnp.where(
+        width > 0,
+        jnp.int64(63) - jnp.int64(jax.lax.clz(
+            jnp.maximum(width, 1).astype(jnp.int64))),
+        jnp.int64(-1),
+    )
+    out = jnp.full(n, identity, dtype=masked.dtype)
+    tbl = masked
+    levels = max(1, (n - 1).bit_length()) if n > 1 else 1
+    s_clip = jnp.clip(start, 0, n - 1)
+    for k in range(levels):
+        hit = lev == k
+        # two overlapping 2^k blocks: [s, s+2^k-1] and [e-2^k+1, e]
+        second = jnp.clip(end - (1 << k) + 1, 0, n - 1)
+        cand = op(tbl[s_clip], tbl[second])
+        out = jnp.where(hit, cand, out)
+        # next level: T_{k+1}[i] = op(T_k[i], T_k[i + 2^k]) (tail rows
+        # keep their shorter suffix block — never queried past n-1)
+        step = 1 << k
+        if step < n:
+            shifted = jnp.concatenate([tbl[step:], tbl[n - step:]])
+            tbl = op(tbl, shifted)
+    return out
+
+
 def framed_minmax(
     lane: Lane,
     sel: jnp.ndarray,
@@ -350,5 +395,6 @@ def framed_minmax(
         running = _segscan(masked, nb, op, reverse=True)
         out = running[jnp.clip(start, 0, b.n - 1)]
     else:
-        raise NotImplementedError("sliding min/max frame")
+        # sliding frame (bounded both ends): per-row range reduction
+        out = _range_extreme(masked, start, end, op, sentinel)
     return out, cnt

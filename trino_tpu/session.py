@@ -198,7 +198,7 @@ class Session:
         if isinstance(stmt, (
             ast.Prepare, ast.Deallocate, ast.CreateFunction,
             ast.DropFunction, ast.CreateTable, ast.DropTable, ast.Use,
-            ast.SetSession,
+            ast.SetSession, ast.CreateView, ast.DropView,
         )):
             # statements that change planning state invalidate cached plans
             # and compiled fragments; read-only EXECUTE/SHOW/EXPLAIN keep
@@ -251,11 +251,68 @@ class Session:
                     "description": [r[3] for r in rows],
                 },
             )
+        if isinstance(stmt, ast.CreateView):
+            self.access_control.check_can_execute_query(identity)
+            from .catalog import ViewDefinition
+            from .sql.analyzer import Analyzer
+
+            catalog, name = self.metadata.resolve_new_table(
+                stmt.name, self.default_catalog
+            )
+            # plan the query now: validates it and captures the view's
+            # declared column names/types (ViewDefinition column list)
+            analyzer = Analyzer(self.metadata, self.default_catalog,
+                                self.sql_functions)
+            plan = analyzer.plan_statement(stmt.query)
+            types = plan.source.output_types()
+            cols = tuple(
+                (n, str(types[s]))
+                for n, s in zip(plan.names, plan.symbols)
+            )
+            seen = set()
+            for n, _t in cols:
+                if n.lower() in seen:
+                    raise ValueError(f"duplicate view column name {n}")
+                seen.add(n.lower())
+            self.metadata.create_view(
+                ViewDefinition(catalog, name, stmt.query_sql, stmt.query,
+                               cols),
+                stmt.replace,
+            )
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.DropView):
+            self.metadata.drop_view(
+                stmt.name, self.default_catalog, stmt.if_exists
+            )
+            return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
+        if isinstance(stmt, ast.ShowCreateView):
+            view = self.metadata.lookup_view(stmt.name, self.default_catalog)
+            if view is None:
+                raise KeyError(f"view not found: {'.'.join(stmt.name)}")
+            ddl = (
+                f"CREATE VIEW {view.catalog}.{view.name} AS\n"
+                f"{view.original_sql}"
+            )
+            return page_from_pydict(
+                [("create_view", T.VARCHAR)], {"create_view": [ddl]}
+            )
         if isinstance(stmt, ast.ShowTables):
             conn = self.catalogs.get(self.default_catalog)
-            tables = sorted(conn.metadata().list_tables())
+            tables = sorted(
+                set(conn.metadata().list_tables())
+                | set(self.metadata.list_views(self.default_catalog))
+            )
             return page_from_pydict([("table", T.VARCHAR)], {"table": tables})
         if isinstance(stmt, ast.ShowColumns):
+            view = self.metadata.lookup_view(stmt.table, self.default_catalog)
+            if view is not None:
+                return page_from_pydict(
+                    [("column", T.VARCHAR), ("type", T.VARCHAR)],
+                    {
+                        "column": [c for c, _ in view.columns],
+                        "type": [t for _, t in view.columns],
+                    },
+                )
             _, schema = self.metadata.resolve_table(
                 stmt.table, self.default_catalog
             )

@@ -1237,17 +1237,10 @@ class Analyzer:
                 args = (as_symbol(v),)
                 in_t = v.type
                 out_t = _agg_output_type(kind, in_t)
-                if kind in ("min", "max"):
-                    if in_t.is_dictionary:
-                        raise SemanticError(
-                            f"window {kind}(varchar) is not supported"
-                        )
-                    if (frame.start_kind != "unbounded_preceding"
-                            and frame.end_kind != "unbounded_following"):
-                        raise SemanticError(
-                            f"window {kind} requires a frame unbounded at "
-                            "one end"
-                        )
+                if kind in ("min", "max") and in_t.is_dictionary:
+                    raise SemanticError(
+                        f"window {kind}(varchar) is not supported"
+                    )
         else:
             raise SemanticError(f"unknown window function: {kind}")
         return P.WindowFunc(ph, kind, args, constants, frame, in_t, out_t)
@@ -1670,6 +1663,34 @@ class Analyzer:
             fields = [
                 Field(t.alias or name, c.lower(), f.symbol, f.type)
                 for c, f in zip(cols, rp.scope.fields)
+            ]
+            return RelationPlan(rp.root, Scope(fields))
+        view = self.metadata.lookup_view(t.name, self.default_catalog)
+        if view is not None:
+            # view expansion (StatementAnalyzer.java visitTable view
+            # branch): plan the stored query in place, renaming output
+            # fields to the view's declared columns
+            vkey = (view.catalog, view.name.lower())
+            expanding = getattr(self, "_expanding_views", None)
+            if expanding is None:
+                expanding = self._expanding_views = set()
+            if vkey in expanding:
+                raise SemanticError(
+                    f"view is recursive: {view.catalog}.{view.name}"
+                )
+            expanding.add(vkey)
+            try:
+                rp, _names = self.plan_query(view.query)
+            finally:
+                expanding.discard(vkey)
+            if len(view.columns) != len(rp.scope.fields):
+                raise SemanticError(
+                    f"view {view.name} is stale: column count changed"
+                )
+            qual = t.alias or view.name
+            fields = [
+                Field(qual, c.lower(), f.symbol, f.type)
+                for (c, _t), f in zip(view.columns, rp.scope.fields)
             ]
             return RelationPlan(rp.root, Scope(fields))
         catalog, schema = self.metadata.resolve_table(

@@ -445,6 +445,30 @@ class DropTable(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateView(Node):
+    """CREATE [OR REPLACE] VIEW v AS query (StatementAnalyzer.java:1027
+    visitCreateView analog).  `query_sql` keeps the original text for
+    SHOW CREATE VIEW / information_schema, as ViewDefinition.java:28
+    stores originalSql."""
+
+    name: Tuple[str, ...]
+    query: Node
+    query_sql: str
+    replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Node):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowCreateView(Node):
+    name: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
 class Parameter(Node):
     """Positional ? parameter in a prepared statement."""
 

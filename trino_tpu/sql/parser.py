@@ -188,6 +188,10 @@ class Parser:
                 self._finish()
                 return ast.ShowStats(name)
             if self.accept_kw("create"):
+                if self.accept_soft("view"):
+                    name = self.qualified_name()
+                    self._finish()
+                    return ast.ShowCreateView(name)
                 self.expect_kw("table")
                 name = self.qualified_name()
                 self._finish()
@@ -272,6 +276,14 @@ class Parser:
                 replace = True
             if self.accept_soft("function"):
                 return self._create_function(replace)
+            if self.accept_soft("view"):
+                name = self.qualified_name()
+                self.expect_kw("as")
+                qpos = self.peek().pos
+                q = self.parse_query()
+                qtext = self.sql[qpos:].strip().rstrip(";").strip()
+                self._finish()
+                return ast.CreateView(name, q, qtext, replace)
             self.expect_kw("table")
             ine = False
             if self.accept_soft("if"):
@@ -391,6 +403,14 @@ class Parser:
                 name = self.ident()
                 self._finish()
                 return ast.DropFunction(name, ie)
+            if self.accept_soft("view"):
+                ie = False
+                if self.accept_soft("if"):
+                    self.expect_kw("exists")
+                    ie = True
+                name = self.qualified_name()
+                self._finish()
+                return ast.DropView(name, ie)
             self.expect_kw("table")
             ie = False
             if self.accept_soft("if"):
