@@ -110,7 +110,10 @@ def test_dynamic_filter_collection_and_pruning():
 
     conn = s.catalogs.get("tpch")
     splits = conn.split_manager().get_splits("lineitem", 1)
-    ex = FragmentExecutor(s.catalogs, {}, {0: splits}, remote, dfs)
+    # host-array pruning path: device generation would skip it (the
+    # join drops the rows on device instead)
+    ex = FragmentExecutor(s.catalogs, {"device_generation": False},
+                          {0: splits}, remote, dfs)
     page = ex.execute(plan)
     assert ex.df_rows_pruned > 0
     # every surviving probe key is in the build domain
@@ -144,7 +147,10 @@ def test_dynamic_filter_empty_build_prunes_all():
     dfs = collect_dynamic_filters(plan, remote)
     conn = s.catalogs.get("tpch")
     splits = conn.split_manager().get_splits("lineitem", 1)
-    ex = FragmentExecutor(s.catalogs, {}, {0: splits}, remote, dfs)
+    # host-array pruning path: device generation would skip it (the
+    # join drops the rows on device instead)
+    ex = FragmentExecutor(s.catalogs, {"device_generation": False},
+                          {0: splits}, remote, dfs)
     page = ex.execute(plan)
     assert page.count == 0
 

@@ -121,3 +121,25 @@ def test_view_over_memory_table(session):
     session.execute("insert into memory.default.t values (1, 2)")
     session.execute("create view tv as select * from memory.default.t")
     assert rows(session, "select * from tv") == [(1, 2)]
+
+
+def test_view_expansion_uses_creation_catalog(session):
+    """Unqualified names inside a view resolve against the catalog the
+    view was created under, not the querying session's current catalog
+    (ViewDefinition stores the creation context)."""
+    session.execute("create view vo as select count(*) c from orders")
+    n = rows(session, "select * from vo")
+    session.create_catalog("memory", "memory", {})
+    session.execute("use memory")
+    assert rows(session, "select * from tpch.default.vo") == n
+
+
+def test_create_table_cannot_shadow_view(session):
+    session.create_catalog("memory", "memory", {})
+    session.execute("use memory")
+    session.execute("create view v as select 1 as x")
+    import pytest as _p
+    with _p.raises(Exception, match="already exists"):
+        session.execute("create table v (a bigint)")
+    with _p.raises(Exception, match="already exists"):
+        session.execute("create table v as select 2 as y")

@@ -218,3 +218,24 @@ def test_sliding_minmax_empty_frames_null(session):
         "rows between 2 following and 3 following) from ef order by o"
     ).to_pylist()
     assert got == [(1, 30), (2, None), (3, None)]
+
+
+def test_sliding_frame_spans_whole_batch():
+    """Width == padded batch size queries the TOP lifting level
+    (regression: an off-by-one in the level count silently returned the
+    sentinel for frames spanning the entire power-of-two batch)."""
+    from trino_tpu.session import Session
+
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table wb (o bigint, v bigint)")
+    n = 128  # pads to exactly one 128-lane tile: width can hit n
+    vals = [(i, (i * 7919) % 1000) for i in range(n)]
+    s.execute("insert into wb values "
+              + ", ".join(f"({o},{v})" for o, v in vals))
+    got = s.execute(
+        "select o, max(v) over (order by o rows between 200 preceding "
+        "and 200 following) from wb order by o"
+    ).to_pylist()
+    mx = max(v for _, v in vals)
+    assert got == [(o, mx) for o, _ in vals]

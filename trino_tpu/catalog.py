@@ -23,6 +23,11 @@ class ViewDefinition:
     original_sql: str  # the view's query text, as written
     query: object  # parsed ast.Node of the query
     columns: Tuple[Tuple[str, str], ...]  # (name, type text) at creation
+    # session default catalog when the view was created: unqualified
+    # names inside the view resolve against THIS, not whatever catalog
+    # the querying session happens to have selected (the reference's
+    # ViewDefinition stores catalog+schema for the same reason)
+    context_catalog: Optional[str] = None
 
 
 class CatalogManager:

@@ -187,6 +187,12 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "device_generation",
+            "materialize counter-based generator scans (tpch) directly "
+            "in HBM instead of host numpy + upload",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "client_page_rows",
             "rows per protocol result page (client paging chunk)",
             int, 10000,

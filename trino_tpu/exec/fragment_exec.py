@@ -148,6 +148,15 @@ class FragmentExecutor(LocalExecutor):
         n = counts[id(node)]
         if n == 0:
             return
+        from .local import _LazyDeviceLane
+
+        if any(
+            isinstance(v, _LazyDeviceLane) for v, _ok in arrays.values()
+        ):
+            # device-generated scan: no host arrays to prune — the join
+            # itself still drops non-matching rows (dynamic filtering is
+            # an optimization, never a correctness requirement)
+            return
         keep = np.ones(n, bool)
         for sym, doms in doms_by_sym.items():
             v, ok = arrays[sym]

@@ -117,6 +117,17 @@ def _pad_capacity(n: int) -> int:
     return max(128, ((n + 127) // 128) * 128)
 
 
+class _LazyDeviceLane:
+    """Placeholder for a scan column that will be GENERATED on-device
+    (no host array exists).  Carries the estimated byte size so memory
+    accounting sees the eventual HBM footprint."""
+
+    __slots__ = ("nbytes",)
+
+    def __init__(self, nbytes: int):
+        self.nbytes = int(nbytes)
+
+
 def merge_pages_to_arrays(pages, symbols, types, dicts):
     """Concatenate pages column-wise into host arrays; varchar dictionaries
     from different producers (splits / exchange tasks) are merged with codes
@@ -240,6 +251,9 @@ class LocalExecutor:
         self._scan_nodes: Dict[int, P.TableScan] = {}
         # scan-node id -> dictionary-content fingerprint (jit-key part)
         self._scan_dictfp: Dict[int, int] = {}
+        # scan-node id -> on-device generation spec (connector-provided;
+        # lanes materialize in HBM, no host arrays exist)
+        self._devgen: Dict[int, dict] = {}
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
@@ -685,10 +699,18 @@ class LocalExecutor:
                 self._scan_keys[id(node)] = key
                 self._scan_nodes[id(node)] = node
                 self._scan_dictfp[id(node)] = hit.get("dictfp", 0)
+                if hit.get("devgen") is not None:
+                    # device-generated scan: keep the recipe so cleared
+                    # dev arrays (graveyard retirement) can regenerate
+                    self._devgen[id(node)] = hit["devgen"]
                 return
         conn = self.catalogs.get(node.catalog)
         cols = [c for _, c in node.assignments]
         self._scan_nodes[id(node)] = node
+        if self._try_device_generation(
+            conn, node, cols, splits, key, cache, scans, dicts, counts
+        ):
+            return
         provider = conn.page_source_provider()
         tmap = dict(node.types)
         sym_of = {c: self._sym_for(node, c) for c in cols}
@@ -758,6 +780,79 @@ class LocalExecutor:
         no_splits = key[:4] + key[5:]
         return (no_splits, self._scan_dictfp.get(nid))
 
+    def _try_device_generation(
+        self, conn, node, cols, splits, key, cache, scans, dicts, counts
+    ) -> bool:
+        """On-device scan materialization: when the connector can produce
+        every requested column as a pure function of the row index
+        (counter-based generators — connectors/tpch_device.py), skip host
+        arrays entirely; _device_lanes runs the generator program straight
+        into HBM.  The reference's TPCH connector likewise generates rows
+        in-process during the scan (TpchPageSourceProvider) — here the
+        'process' is the chip."""
+        devgen_fn = getattr(conn, "device_generation", None)
+        if devgen_fn is None or not self.config.get(
+            "device_generation", True
+        ):
+            return False
+        try:
+            spec = devgen_fn(node.table, cols, splits)
+        except Exception:  # noqa: BLE001 — any trouble: host path
+            spec = None
+        if spec is None:
+            return False
+        sym_of = {c: self._sym_for(node, c) for c in cols}
+        count = int(spec["count"])
+        merged = {
+            sym_of[c]: (
+                _LazyDeviceLane(count * spec["widths"].get(c, 8)), None
+            )
+            for c in cols
+        }
+        tmap = dict(node.types)
+        for c, d in spec["dicts"].items():
+            dicts[sym_of[c]] = d
+        for c in cols:
+            s = sym_of[c]
+            if tmap[s].is_dictionary and s not in dicts:
+                dicts[s] = np.array([], dtype=object)
+        scans[id(node)] = merged
+        counts[id(node)] = count
+        self._scan_keys[id(node)] = key
+        symbols = [sym_of[c] for c in cols]
+        fp = dict_fingerprint(dicts, symbols)
+        self._scan_dictfp[id(node)] = fp
+        self._devgen[id(node)] = spec
+        if cache is not None and key is not None:
+            col_of = {s: c for s, c in node.assignments}
+            cache.put(
+                key,
+                {
+                    "merged": {col_of[s]: merged[s] for s in merged},
+                    "dicts": dict(spec["dicts"]),
+                    "total": count, "dev": {}, "dictfp": fp,
+                    "devgen": spec,
+                },
+                sum(lane[0].nbytes for lane in merged.values()),
+            )
+        return True
+
+    def _generate_device_scan(self, spec: dict, syms, sym_to_col, cap):
+        """Run the connector's on-device generator for one scan at padded
+        capacity `cap`; returns {symbol: (values, ok)} resident in HBM."""
+        from ..connectors import tpch_device
+
+        cols = [sym_to_col.get(s, s) for s in syms]
+        span = max(int(spec["hi"]) - int(spec["lo"]), 1)
+        lanes = tpch_device.device_lanes(
+            spec["table"], cols, int(spec["lo"]), int(spec["hi"]), cap,
+            float(spec["sf"]), int(spec["count"]),
+            cap_orders=(
+                _pad_capacity(span) if spec["table"] == "lineitem" else None
+            ),
+        )
+        return {s: lanes[c] for s, c in zip(syms, cols)}
+
     def _device_lanes(self, node: P.TableScan, arrays, count, nid=None):
         """Pad + upload one scan's host arrays to device lanes, reusing
         cached device arrays when the scan is version-cacheable (the
@@ -781,10 +876,27 @@ class LocalExecutor:
             s: c for s, c in getattr(node, "assignments", None) or ()
         }
         lanes = {}
+        gen_out = None
         for sym, (arr, valid) in arrays.items():
             col = sym_to_col.get(sym, sym)
             if entry is not None and col in entry["dev"]:
                 lanes[sym] = entry["dev"][col]
+                continue
+            if isinstance(arr, _LazyDeviceLane):
+                if gen_out is None:
+                    spec = self._devgen.get(nid)
+                    lazy_syms = [
+                        s for s, (a, _v) in arrays.items()
+                        if isinstance(a, _LazyDeviceLane)
+                        and not (entry is not None
+                                 and sym_to_col.get(s, s) in entry["dev"])
+                    ]
+                    gen_out = self._generate_device_scan(
+                        spec, lazy_syms, sym_to_col, cap
+                    )
+                lanes[sym] = gen_out[sym]
+                if entry is not None:
+                    entry["dev"][col] = gen_out[sym]
                 continue
             if arr.shape[0] < cap:
                 pad = np.zeros(

@@ -83,6 +83,40 @@ def estimate_plan_scan_bytes(executor, plan: P.PlanNode) -> float:
     )
 
 
+def _wide_agg_count(plan: P.PlanNode) -> int:
+    """Aggregates whose accumulation runs 128-bit chunked math at input
+    width (decimal sums/avgs): each adds full-width u32 chunk-lane
+    temporaries to the compiled program's HBM peak."""
+    n = 0
+
+    def walk(node: P.PlanNode):
+        nonlocal n
+        if isinstance(node, P.Aggregate):
+            for a in node.aggs:
+                try:
+                    if a.to_spec()._wide_sum:
+                        n += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        for s in node.sources:
+            walk(s)
+
+    walk(plan)
+    return n
+
+
+def estimate_program_bytes(executor, plan: P.PlanNode) -> float:
+    """Estimated HBM peak of the MONOLITHIC compiled program: scan lanes
+    plus wide-decimal accumulation temporaries.  Calibrated against the
+    one measured data point — Q1 SF20 (scan est 7.1 GB, 7 wide aggs)
+    compiled to a 20.6 GB buffer assignment (r04's q1_sf20 hard error:
+    XLA's own message, reproduced 2026-07-31) — so the gate streams
+    BEFORE submitting a compile whose OOM would crash the TPU worker
+    process and poison the tunnel for the fallback."""
+    scan = estimate_plan_scan_bytes(executor, plan)
+    return scan * (1.0 + 0.28 * _wide_agg_count(plan))
+
+
 def plan_streaming(executor, plan: P.Output, memory_limit: int,
                    force: bool = False):
     """Decide whether to stream: the estimated total scan working set

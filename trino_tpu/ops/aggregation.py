@@ -758,6 +758,9 @@ def accumulate(
     always-exact (slower) chunked default via force_wide=True."""
     out: Dict[str, jnp.ndarray] = {}
     cap = capacity
+    # one dedup mask per DISTINCT input column, shared across specs
+    # (sum(DISTINCT x) + avg(DISTINCT x) sort once, not twice)
+    distinct_masks: Dict[str, jnp.ndarray] = {}
 
     # Scatter-free sorted-run reductions when the caller's gid is sorted
     # (hash-sort grouping).  Integer-only for sums: float range-diffs
@@ -799,7 +802,12 @@ def accumulate(
                     "DISTINCT aggregates are non-decomposable: the "
                     "planner must not split them PARTIAL/FINAL"
                 )
-            live = live & distinct_first_mask(gid, (v, ok), live)
+            m = distinct_masks.get(s.input)
+            if m is None:
+                m = distinct_masks[s.input] = distinct_first_mask(
+                    gid, (v, ok), live
+                )
+            live = live & m
         if s.kind == "count":
             out[f"{o}$count"] = seg_cnt(live)
         elif s.kind == "count_if":

@@ -345,7 +345,9 @@ def _range_extreme(
     )
     out = jnp.full(n, identity, dtype=masked.dtype)
     tbl = masked
-    levels = max(1, (n - 1).bit_length()) if n > 1 else 1
+    # levels must include k = floor(log2(n)): a frame spanning the whole
+    # batch has width n and queries that top level
+    levels = max(1, n.bit_length())
     s_clip = jnp.clip(start, 0, n - 1)
     for k in range(levels):
         hit = lev == k

@@ -124,6 +124,9 @@ class Session:
         exec_config["jit_fragments"] = bool(
             self.properties.get("jit_fragments")
         )
+        exec_config["device_generation"] = bool(
+            self.properties.get("device_generation")
+        )
         exec_config["broadcast_join_threshold_rows"] = self.properties.get(
             "broadcast_join_threshold_rows"
         )
@@ -252,12 +255,17 @@ class Session:
                 },
             )
         if isinstance(stmt, ast.CreateView):
-            self.access_control.check_can_execute_query(identity)
             from .catalog import ViewDefinition
             from .sql.analyzer import Analyzer
 
             catalog, name = self.metadata.resolve_new_table(
                 stmt.name, self.default_catalog
+            )
+            # views are named schema objects: the create-table rule
+            # governs them (the reference has a dedicated
+            # checkCanCreateView with the same default policy)
+            self.access_control.check_can_create_table(
+                identity, catalog, name
             )
             # plan the query now: validates it and captures the view's
             # declared column names/types (ViewDefinition column list)
@@ -276,11 +284,17 @@ class Session:
                 seen.add(n.lower())
             self.metadata.create_view(
                 ViewDefinition(catalog, name, stmt.query_sql, stmt.query,
-                               cols),
+                               cols, context_catalog=self.default_catalog),
                 stmt.replace,
             )
             return page_from_pydict([("result", T.BOOLEAN)], {"result": [True]})
         if isinstance(stmt, ast.DropView):
+            catalog, name = self.metadata.resolve_new_table(
+                stmt.name, self.default_catalog
+            )
+            self.access_control.check_can_drop_table(
+                identity, catalog, name
+            )
             self.metadata.drop_view(
                 stmt.name, self.default_catalog, stmt.if_exists
             )
@@ -527,6 +541,10 @@ class Session:
             self.access_control.check_can_create_table(
                 identity, catalog, table
             )
+            if self.metadata.lookup_view(stmt.table, self.default_catalog):
+                raise ValueError(
+                    f"view with that name already exists: {table}"
+                )
             md = self.catalogs.get(catalog).metadata()
             if stmt.if_not_exists and table in md.list_tables():
                 return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})

@@ -240,6 +240,10 @@ class Analyzer:
         catalog, table = self.metadata.resolve_new_table(
             stmt.table, self.default_catalog
         )
+        if self.metadata.lookup_view(stmt.table, self.default_catalog):
+            raise SemanticError(
+                f"view with that name already exists: {table}"
+            )
         rp, names = self.plan_query(stmt.query)
         seen = set()
         for n in names:
@@ -1679,9 +1683,13 @@ class Analyzer:
                     f"view is recursive: {view.catalog}.{view.name}"
                 )
             expanding.add(vkey)
+            saved_catalog = self.default_catalog
+            if view.context_catalog is not None:
+                self.default_catalog = view.context_catalog
             try:
                 rp, _names = self.plan_query(view.query)
             finally:
+                self.default_catalog = saved_catalog
                 expanding.discard(vkey)
             if len(view.columns) != len(rp.scope.fields):
                 raise SemanticError(
