@@ -363,16 +363,56 @@ def _cfg_anchors(sf=1.0):
 # --- CPU-backend probe (vs_baseline denominator) -------------------------
 
 
+def _probe_fingerprint() -> dict:
+    """What the cached CPU number is a measurement OF: the host, its CPU
+    model, and the engine commit.  A cached denominator from a different
+    machine or engine build silently skews every vs_baseline ratio, so a
+    fingerprint mismatch invalidates the cache instead of trusting it."""
+    import platform
+
+    cpu_model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    cpu_model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        cpu_model = platform.processor() or platform.machine()
+    commit = ""
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5,
+        ).stdout.strip()
+    except Exception:
+        pass
+    return {
+        "hostname": platform.node(),
+        "cpu_model": cpu_model,
+        "engine_commit": commit,
+    }
+
+
 def _cpu_probe(iters, budget_left) -> dict:
     """Measured CPU-backend Q6 SF1 rows/s of this same engine, via a
     JAX_PLATFORMS=cpu subprocess; cached on disk between runs so the
-    bench never re-spends minutes re-measuring a stable denominator."""
+    bench never re-spends minutes re-measuring a stable denominator.
+    The cache is keyed by a host/engine fingerprint: a number measured
+    on another machine or commit is re-measured, not reused."""
     refresh = os.environ.get("BENCH_REFRESH_CPU") == "1"
+    fp = _probe_fingerprint()
     if not refresh and os.path.exists(CPU_FILE):
         try:
             with open(CPU_FILE) as f:
                 d = json.load(f)
-            if d.get("value", 0) > 0:
+            cached_fp = d.get("fingerprint")
+            if d.get("value", 0) > 0 and (
+                cached_fp is None or cached_fp == fp
+            ):
+                # legacy caches (no fingerprint) stay valid; stamped
+                # caches must match the current host + engine commit
                 d["cached"] = True
                 return d
         except Exception:
@@ -396,7 +436,8 @@ def _cpu_probe(iters, budget_left) -> dict:
                     return {"value": 0.0,
                             "error": "probe escaped to TPU backend"}
                 d = {"value": float(d["value"]), "backend": "cpu",
-                     "measured_at": time.strftime("%Y-%m-%d")}
+                     "measured_at": time.strftime("%Y-%m-%d"),
+                     "fingerprint": fp}
                 with open(CPU_FILE, "w") as f:
                     json.dump(d, f)
                 return d

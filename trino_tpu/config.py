@@ -30,8 +30,8 @@ def _bool(s: str) -> bool:
 
 def _retry_policy(s: str) -> str:
     v = str(s).strip().lower()
-    if v not in ("none", "task"):
-        raise ValueError(f"retry_policy must be none|task, got: {s}")
+    if v not in ("none", "task", "query"):
+        raise ValueError(f"retry_policy must be none|task|query, got: {s}")
     return v
 
 
@@ -123,8 +123,33 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
         ),
         PropertyMetadata(
             "retry_policy",
-            "failure recovery: none (pipelined) | task (FTE over spool)",
+            "failure recovery: none (pipelined) | task (FTE over spool) "
+            "| query (whole-query re-dispatch on retriable failure)",
             _retry_policy, "none",
+        ),
+        PropertyMetadata(
+            "query_retry_attempts",
+            "retry_policy=query: whole-query re-dispatches before the "
+            "failure is surfaced (query-retry-attempts analog)",
+            int, 2,
+        ),
+        PropertyMetadata(
+            "exchange_retry_attempts",
+            "transient exchange-fetch tries per failure streak before "
+            "the upstream worker is declared dead",
+            int, 3,
+        ),
+        PropertyMetadata(
+            "exchange_retry_budget_s",
+            "wall-clock budget for one exchange-fetch failure streak "
+            "(exchange.max-error-duration analog, seconds)",
+            float, 5.0,
+        ),
+        PropertyMetadata(
+            "fault_injection",
+            "seeded fault-injection spec (JSON: {seed, site: rule...}) "
+            "threaded to workers for chaos testing; empty = off",
+            str, "",
         ),
         PropertyMetadata(
             "reorder_joins",

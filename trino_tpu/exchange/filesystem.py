@@ -21,7 +21,23 @@ import tempfile
 from typing import Dict, List, Optional
 
 from ..page import Page
-from ..serde import deserialize_page
+from ..serde import PageIntegrityError, deserialize_page
+
+
+class SpoolCorruptionError(RuntimeError):
+    """A committed spool buffer failed frame-length or CRC validation.
+
+    Carries the offending file path so the FTE scheduler can retire
+    exactly the corrupt attempt (decommit + producer re-run) instead of
+    failing the query — the trino-exchange-filesystem analog of treating
+    a bad spooled page as a task failure, not a query failure.  The
+    quoted-path message format is part of the contract: it survives the
+    worker's FAILED task error string back to the scheduler."""
+
+    def __init__(self, path: str, detail: str):
+        super().__init__(f"spool corruption at '{path}': {detail}")
+        self.path = path
+        self.detail = detail
 
 
 class SpoolHandle:
@@ -48,21 +64,49 @@ class SpoolHandle:
     def committed(self) -> bool:
         return os.path.exists(os.path.join(self.path, "_COMMIT"))
 
+    def decommit(self):
+        """Retire this attempt: drop the commit marker first (so a
+        concurrent reader can't see a half-deleted attempt as committed),
+        then the data.  Used by the FTE scheduler when a committed
+        attempt turns out to be corrupt."""
+        try:
+            os.remove(os.path.join(self.path, "_COMMIT"))
+        except FileNotFoundError:
+            pass
+        shutil.rmtree(self.path, ignore_errors=True)
+
     def buffer_file(self, buffer_id: int) -> str:
         return os.path.join(self.path, f"buffer_{buffer_id}.bin")
 
 
 def read_spool_pages(path: str) -> List[Page]:
-    """Read one committed buffer file back into pages."""
+    """Read one committed buffer file back into pages, validating frame
+    lengths and per-frame CRCs; any structural damage raises
+    SpoolCorruptionError (a *retriable* fault to the FTE scheduler)."""
     with open(path, "rb") as f:
         data = f.read()
+    if len(data) < 4:
+        raise SpoolCorruptionError(path, f"file truncated ({len(data)}B)")
     (n,) = struct.unpack_from("<I", data, 0)
     off = 4
     pages = []
-    for _ in range(n):
+    for i in range(n):
+        if off + 4 > len(data):
+            raise SpoolCorruptionError(
+                path, f"truncated at frame {i}/{n} (offset {off})"
+            )
         (ln,) = struct.unpack_from("<I", data, off)
         off += 4
-        pages.append(deserialize_page(data[off : off + ln]))
+        if off + ln > len(data):
+            raise SpoolCorruptionError(
+                path,
+                f"frame {i}/{n} length {ln} overruns file "
+                f"({len(data) - off} bytes left)",
+            )
+        try:
+            pages.append(deserialize_page(data[off : off + ln]))
+        except PageIntegrityError as e:
+            raise SpoolCorruptionError(path, str(e)) from e
         off += ln
     return pages
 

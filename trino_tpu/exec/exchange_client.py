@@ -6,14 +6,22 @@ pollPage:221) and HttpPageBufferClient.java:98 — async long-poll GET of
 upstream failure propagation.  Here the pull loop is synchronous per source
 with concurrent sources fetched on a small thread pool (the sliding-window
 prefetch collapses to "fetch all, fragments are monolithic XLA programs").
+
+Transient-failure handling mirrors HttpPageBufferClient's backoff
+(exchange.max-error-duration role): token-addressed result fetches are
+idempotent — re-GETting the same /{token} re-reads the same frame — so a
+dropped connection or refused socket retries with exponential backoff +
+jitter inside a bounded budget before the upstream is declared dead.
+410/deleted-task semantics are NOT retried: those are authoritative.
 """
 from __future__ import annotations
 
+import random
 import time
 import urllib.error
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ..page import Page
 from ..serde import deserialize_page
@@ -28,20 +36,40 @@ class ExchangeTimeout(RuntimeError):
 
 
 CREATE_WAIT = 30.0  # max time to wait for an upstream task to appear
+RETRY_ATTEMPTS = 3  # transient-error tries per contiguous failure streak
+RETRY_BUDGET_S = 5.0  # wall-clock budget for one failure streak
+RETRY_BASE_S = 0.1  # first backoff; doubles per consecutive failure
 
 
-def _fetch_buffer(uri: str, task: str, buffer: int, timeout: float) -> List[Page]:
+def _fetch_buffer(
+    uri: str,
+    task: str,
+    buffer: int,
+    timeout: float,
+    retries: int = RETRY_ATTEMPTS,
+    retry_budget_s: float = RETRY_BUDGET_S,
+    injector=None,
+) -> List[Page]:
     """Poll one upstream (task, buffer) until complete; returns its pages."""
     pages: List[Page] = []
     token = 0
     seen_task = False
     deadline = time.time() + timeout
     create_deadline = time.time() + CREATE_WAIT
+    transient = 0  # consecutive transient failures in the current streak
+    streak_deadline = 0.0
     while True:
         url = f"{uri}/v1/task/{task}/results/{buffer}/{token}"
         try:
+            if injector is not None and injector.fires(
+                "exchange_fetch", key=url
+            ):
+                raise urllib.error.URLError(
+                    "injected transient exchange failure"
+                )
             with urllib.request.urlopen(url, timeout=10.0) as resp:
                 seen_task = True
+                transient = 0
                 state = resp.headers.get("X-Task-State", "RUNNING")
                 if resp.status == 200:
                     body = resp.read()
@@ -70,7 +98,19 @@ def _fetch_buffer(uri: str, task: str, buffer: int, timeout: float) -> List[Page
                 )
             # 404 before first contact: task not created yet — keep polling
         except (urllib.error.URLError, ConnectionError, OSError) as e:
-            raise RemoteTaskError(f"upstream worker {uri} unreachable: {e}")
+            transient += 1
+            if transient == 1:
+                streak_deadline = time.time() + retry_budget_s
+            if transient > retries or time.time() > min(
+                deadline, streak_deadline
+            ):
+                raise RemoteTaskError(
+                    f"upstream worker {uri} unreachable after "
+                    f"{transient} tries: {e}"
+                )
+            backoff = RETRY_BASE_S * (2 ** (transient - 1))
+            time.sleep(min(backoff * (1.0 + random.random()), 2.0))
+            continue
         if time.time() > deadline:
             raise ExchangeTimeout(f"exchange timeout on {url}")
         time.sleep(0.02)
@@ -79,16 +119,30 @@ def _fetch_buffer(uri: str, task: str, buffer: int, timeout: float) -> List[Page
 class ExchangeClient:
     """Fetches all pages for a task's remote sources."""
 
-    def __init__(self, timeout: float = 300.0, concurrency: int = 8):
+    def __init__(
+        self,
+        timeout: float = 300.0,
+        concurrency: int = 8,
+        retries: Optional[int] = None,
+        retry_budget_s: Optional[float] = None,
+        fault_injector=None,
+    ):
         self.timeout = timeout
         self.concurrency = concurrency
+        self.retries = RETRY_ATTEMPTS if retries is None else int(retries)
+        self.retry_budget_s = (
+            RETRY_BUDGET_S if retry_budget_s is None else float(retry_budget_s)
+        )
+        self.fault_injector = fault_injector
 
     def fetch_sources(
         self, sources: Dict[int, List[dict]]
     ) -> Dict[int, List[Page]]:
         """sources: fragment_id -> list of locations, each either a live
         task buffer {uri, task, buffer} (pipelined mode) or a committed
-        spool file {path} (fault-tolerant mode)."""
+        spool file {path} (fault-tolerant mode).  Spool corruption
+        propagates as SpoolCorruptionError so the hosting task FAILS and
+        the FTE retry loop owns the recovery."""
         out: Dict[int, List[Page]] = {}
         flat = [
             (fid, loc) for fid, locs in sources.items() for loc in locs
@@ -98,11 +152,21 @@ class ExchangeClient:
 
         def fetch(loc: dict) -> List[Page]:
             if "path" in loc:
-                from ..exchange.filesystem import read_spool_pages
+                from ..exchange.filesystem import (
+                    SpoolCorruptionError,
+                    read_spool_pages,
+                )
 
+                if self.fault_injector is not None and (
+                    self.fault_injector.fires("spool_read", key=loc["path"])
+                ):
+                    raise SpoolCorruptionError(
+                        loc["path"], "injected spool read fault"
+                    )
                 return read_spool_pages(loc["path"])
             return _fetch_buffer(
-                loc["uri"], loc["task"], int(loc["buffer"]), self.timeout
+                loc["uri"], loc["task"], int(loc["buffer"]), self.timeout,
+                self.retries, self.retry_budget_s, self.fault_injector,
             )
 
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:

@@ -125,7 +125,14 @@ def plan_streaming(executor, plan: P.Output, memory_limit: int,
     compile-OOM fallback path already KNOWS the monolithic program does
     not fit (XLA's buffer assignment said so), whatever the scans sum
     to."""
-    if not force and estimate_plan_scan_bytes(executor, plan) <= memory_limit:
+    # gate on the COMPILED program's peak, not just the scan working set:
+    # wide-decimal accumulators inflate XLA's buffer assignment well past
+    # the scan bytes (the Q1 SF20 calibration point), and the whole point
+    # of the gate is streaming before a compile-OOM can kill the worker
+    if not force and max(
+        estimate_plan_scan_bytes(executor, plan),
+        estimate_program_bytes(executor, plan),
+    ) <= memory_limit:
         return None
     # cache the fragment DAG per plan object: fragment roots key the jit
     # cache by identity, so re-fragmenting would recompile every tile

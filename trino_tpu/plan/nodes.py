@@ -549,31 +549,6 @@ class Sort(PlanNode):
 
 
 @dataclasses.dataclass(frozen=True)
-class MergeSorted(PlanNode):
-    """Streaming sorted-merge of pre-sorted producer runs
-    (operator/MergeOperator.java:46 / LocalMergeSourceOperator): each
-    upstream task ships its output ALREADY ordered by `keys`; the
-    consumer k-way-merges the runs instead of re-sorting the gathered
-    whole.  The merge itself is host-side row-compare work in the
-    reference too — here a vectorized numpy pairwise merge at page-load
-    time (exec/merge.py), so the device never materializes an unsorted
-    concatenation and the root pays O(n log k), not a full sort."""
-
-    source: PlanNode  # RemoteSource whose fragment emits sorted runs
-    keys: Tuple[SortKey, ...]
-
-    @property
-    def sources(self):
-        return (self.source,)
-
-    def output_symbols(self):
-        return self.source.output_symbols()
-
-    def output_types(self):
-        return self.source.output_types()
-
-
-@dataclasses.dataclass(frozen=True)
 class TopN(PlanNode):
     source: PlanNode
     keys: Tuple[SortKey, ...]

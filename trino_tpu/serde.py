@@ -3,10 +3,17 @@
 Reference parity:
   - Page frame layout mirrors execution/buffer/PagesSerdeUtil.java:48-52:
     ``positionCount | codecMarkers | uncompressedSize | compressedSize |
-    payload`` where payload is blockCount + per-block encodings
+    checksum | payload`` where payload is blockCount + per-block encodings
     (PagesSerdeUtil.writeRawPage:64 / readRawPage:72).  Compression is
     applied only when the ratio beats 0.8 (PageSerializer.java:100); we use
     zstandard where the reference offers LZ4/ZSTD (CompressionCodec.java:18).
+    The checksum plays PagesSerde's XXH64 role (PageSerializer.java:116 /
+    PageDeserializer checksum verification) with CRC32: `TPG2` frames carry
+    a CRC of header fields + body and `deserialize_page` raises a typed
+    `PageIntegrityError` on any mismatch, so a flipped bit anywhere on the
+    data plane is tamper-evident instead of silently wrong answers.
+    Legacy `TPG1` frames (no checksum) stay readable for mixed-version
+    spools.
   - Plan JSON mirrors the reference's Jackson-serialized PlanFragment
     shipped in TaskUpdateRequest (server/remotetask/HttpRemoteTask.java:722):
     every plan node / expression / type is a dataclass encoded by class name.
@@ -21,6 +28,7 @@ import dataclasses
 import io
 import json
 import struct
+import zlib
 from decimal import Decimal
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -58,10 +66,21 @@ from .page import Column, Page
 from .plan import nodes as P
 from .spi import Split
 
-MAGIC = b"TPG1"
+MAGIC = b"TPG2"  # checksummed frames (CRC32 of header fields + body)
+MAGIC_V1 = b"TPG1"  # legacy read-compat: same layout, no checksum
 MARKER_COMPRESSED = 1
 MIN_COMPRESS_BYTES = 4096
 COMPRESS_RATIO = 0.8
+_HEADER_FIELDS = struct.Struct("<iBII")
+HEADER_V2 = 4 + _HEADER_FIELDS.size + 4  # magic + fields + crc32
+HEADER_V1 = 4 + _HEADER_FIELDS.size
+
+
+class PageIntegrityError(RuntimeError):
+    """A page frame failed structural or checksum validation (the
+    reference's PagesSerde checksum mismatch -> GENERIC_INTERNAL_ERROR
+    "Checksum verification failure").  Typed so schedulers can treat it
+    as retriable: the bytes are wrong, not the query."""
 
 # ---------------------------------------------------------------------------
 # Page binary serde (the data plane)
@@ -117,14 +136,42 @@ def serialize_page(page: Page) -> bytes:
         if len(comp) < len(raw) * COMPRESS_RATIO:
             markers |= MARKER_COMPRESSED
             body = comp
-    head = MAGIC + struct.pack("<iBII", n, markers, len(raw), len(body))
-    return head + body
+    fields = _HEADER_FIELDS.pack(n, markers, len(raw), len(body))
+    crc = zlib.crc32(body, zlib.crc32(fields)) & 0xFFFFFFFF
+    return MAGIC + fields + struct.pack("<I", crc) + body
 
 
 def deserialize_page(frame: bytes) -> Page:
-    assert frame[:4] == MAGIC, "bad page frame"
-    n, markers, usize, csize = struct.unpack_from("<iBII", frame, 4)
-    body = frame[17 : 17 + csize]
+    magic = bytes(frame[:4])
+    if magic == MAGIC:
+        if len(frame) < HEADER_V2:
+            raise PageIntegrityError(
+                f"truncated page frame: {len(frame)} bytes < header"
+            )
+        fields = bytes(frame[4 : 4 + _HEADER_FIELDS.size])
+        n, markers, usize, csize = _HEADER_FIELDS.unpack(fields)
+        (crc,) = struct.unpack_from("<I", frame, 4 + _HEADER_FIELDS.size)
+        body = bytes(frame[HEADER_V2 : HEADER_V2 + csize])
+        if len(body) != csize:
+            raise PageIntegrityError(
+                f"truncated page frame body: {len(body)}/{csize} bytes"
+            )
+        actual = zlib.crc32(body, zlib.crc32(fields)) & 0xFFFFFFFF
+        if actual != crc:
+            raise PageIntegrityError(
+                f"page frame CRC mismatch: stored {crc:#010x}, "
+                f"computed {actual:#010x}"
+            )
+    elif magic == MAGIC_V1:
+        # mixed-version spools fail soft: accept the unchecksummed layout
+        n, markers, usize, csize = _HEADER_FIELDS.unpack_from(frame, 4)
+        body = bytes(frame[HEADER_V1 : HEADER_V1 + csize])
+        if len(body) != csize:
+            raise PageIntegrityError(
+                f"truncated TPG1 frame body: {len(body)}/{csize} bytes"
+            )
+    else:
+        raise PageIntegrityError(f"bad page frame magic {magic!r}")
     if markers & MARKER_COMPRESSED:
         body = _decompressor().decompress(body, max_output_size=usize)
     mv = memoryview(body)
@@ -182,9 +229,13 @@ def pages_stats(data: bytes) -> Tuple[int, int]:
     ubytes = 0
     for _ in range(n):
         frame, off = _r_bytes(mv, off)
-        cnt, _markers, usize, _csize = struct.unpack_from(
-            "<iBII", frame, 4
-        )
+        # TPG1 and TPG2 share the field layout at offset 4; TPG2 only
+        # appends the CRC32 after the fields, which stats never read
+        if frame[:4] not in (MAGIC, MAGIC_V1):
+            raise PageIntegrityError(
+                f"bad page frame magic {bytes(frame[:4])!r}"
+            )
+        cnt, _markers, usize, _csize = _HEADER_FIELDS.unpack_from(frame, 4)
         rows += cnt
         ubytes += usize
     return rows, ubytes

@@ -35,6 +35,12 @@ from .discovery import HeartbeatFailureDetector, NodeManager
 from .resource_groups import QueryQueueFullError, ResourceGroupManager
 
 PAGE_ROWS = 4096
+# retry-policy=query backoff (QueryRetryPolicy / RetryingQueryRunner role):
+# first retry waits BASE, doubling per attempt — long enough for the
+# failure detector (~0.5-0.75s EMA decay) to drop a dead worker from the
+# alive set before placement is re-chosen
+QUERY_RETRY_BASE_S = 1.0
+QUERY_RETRY_ATTEMPTS = 2
 
 
 class QueryExecution:
@@ -48,6 +54,7 @@ class QueryExecution:
         self.group = None  # resource group holding our slot
         self.state = "QUEUED"
         self.error: Optional[str] = None
+        self.retry_count = 0  # whole-query re-runs under retry_policy=query
         self.page: Optional[Page] = None
         self.types = None
         self.created = time.time()
@@ -155,6 +162,11 @@ class Coordinator:
                         props.get("fte_speculation_factor"),
                     "fte_speculation_min_s":
                         props.get("fte_speculation_min_s"),
+                    "fault_injection": props.get("fault_injection"),
+                    "exchange_retry_attempts":
+                        props.get("exchange_retry_attempts"),
+                    "exchange_retry_budget_s":
+                        props.get("exchange_retry_budget_s"),
                 }
                 if props.get("retry_policy") == "task":
                     from .fte import FaultTolerantScheduler
@@ -164,6 +176,10 @@ class Coordinator:
                         properties=task_props,
                     )
                     return fte.run(plan, q.query_id)
+                if props.get("retry_policy") == "query":
+                    return self._run_with_query_retries(
+                        q, plan, workers, task_props, props
+                    )
                 sched = DistributedScheduler(
                     self.session.catalogs, workers, task_props
                 )
@@ -172,6 +188,63 @@ class Coordinator:
                 q.task_stats = getattr(sched, "last_task_stats", [])
                 return page
         return self.session.execute(q.sql, user=q.user)
+
+    def _run_with_query_retries(
+        self, q: QueryExecution, plan, workers, task_props, props
+    ) -> Page:
+        """retry-policy=QUERY (the pre-Tardigrade fault tolerance level):
+        the pipelined scheduler streams between live tasks with no spool,
+        so any mid-flight failure poisons the whole run — recovery is a
+        bounded whole-query re-dispatch against a REFRESHED alive-worker
+        set, with backoff long enough for the failure detector to retire
+        the dead node first.  Each attempt runs under a suffixed query id
+        so worker task state from the doomed attempt can never collide
+        with (or be idempotently returned to) the retry."""
+        from ..exec.exchange_client import RemoteTaskError
+        from ..serde import PageIntegrityError
+        from .scheduler import DistributedScheduler, SchedulerError
+
+        max_retries = int(
+            props.get("query_retry_attempts") or QUERY_RETRY_ATTEMPTS
+        )
+        last_error: Optional[Exception] = None
+        for attempt in range(max_retries + 1):
+            if attempt:
+                q.retry_count = attempt
+                time.sleep(QUERY_RETRY_BASE_S * (2 ** (attempt - 1)))
+                # re-resolve placement: the failed worker must be gone
+                # from (or back in) the alive set before we re-dispatch
+                deadline = time.time() + 10.0
+                while time.time() < deadline:
+                    workers = self.node_manager.alive()
+                    if workers:
+                        break
+                    time.sleep(0.1)
+                if not workers:
+                    raise SchedulerError(
+                        "NO_NODES_AVAILABLE: no alive workers for "
+                        f"query retry {attempt}"
+                    )
+            qid = (
+                q.query_id if attempt == 0 else f"{q.query_id}-r{attempt}"
+            )
+            try:
+                sched = DistributedScheduler(
+                    self.session.catalogs, workers, task_props
+                )
+                page = sched.run(plan, qid)
+                q.task_stats = getattr(sched, "last_task_stats", [])
+                return page
+            except (
+                SchedulerError, RemoteTaskError, PageIntegrityError,
+                OSError,  # URLError/ConnectionError: task POST hit a
+                          # dead worker before any error translation ran
+            ) as e:
+                last_error = e
+        raise SchedulerError(
+            f"query failed after {max_retries} whole-query retries: "
+            f"{last_error}"
+        )
 
     def cancel(self, query_id: str):
         q = self.queries.get(query_id)
@@ -370,6 +443,8 @@ class _Handler(BaseHTTPRequestHandler):
                         ((q.finished or time.time()) - q.created) * 1000
                     ),
                     "outputRows": q.page.count if q.page else None,
+                    # whole-query re-dispatches under retry_policy=query
+                    "retryCount": q.retry_count,
                     # per-task rollup (OperatorStats->TaskStats->QueryStats
                     # hierarchy analog): totals + the per-task detail
                     "stats": {

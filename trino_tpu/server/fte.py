@@ -13,10 +13,21 @@ barrier between producer and consumer (no streaming overlap), so a worker
 death or injected task failure only costs the retried task, never the query
 (retry-policy=TASK).  Worker loss between stages is tolerated by re-picking
 placement from the currently-alive node set per attempt.
+
+Spool integrity: committed attempts are trusted only as far as their CRCs.
+When a consumer task (or the root read) hits SpoolCorruptionError, the
+scheduler treats the *producer's* committed attempt as a late task failure:
+decommit the corrupt attempt, re-run that one producer task under a fresh
+attempt number, splice the new spool path into the committed map, and let
+the consumer retry — a flipped bit on the exchange costs one task re-run,
+never the query (the Project-Tardigrade contract extended to data at rest).
 """
 from __future__ import annotations
 
 import json
+import os
+import re
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -24,7 +35,12 @@ import uuid
 from typing import Dict, List, Optional, Tuple
 
 from ..catalog import CatalogManager
-from ..exchange.filesystem import FileSystemExchangeManager, read_spool_pages
+from ..exchange.filesystem import (
+    FileSystemExchangeManager,
+    SpoolCorruptionError,
+    SpoolHandle,
+    read_spool_pages,
+)
 from ..exec.partitioner import concat_pages
 from ..page import Page
 from ..plan import nodes as P
@@ -57,6 +73,16 @@ SPECULATION_MIN_S = 0.75
 # failed attempts retry with exponentially grown memory
 # (ExponentialGrowthPartitionMemoryEstimator)
 MEMORY_GROWTH_FACTOR = 2
+
+# the quoted-path marker SpoolCorruptionError embeds in task error
+# strings; parsing it back out is how a consumer's FAILED state names
+# the corrupt PRODUCER attempt to heal
+_SPOOL_ERR_RE = re.compile(r"spool corruption at '([^']+)'")
+
+
+def _corrupt_spool_path(err) -> Optional[str]:
+    m = _SPOOL_ERR_RE.search(str(err))
+    return m.group(1) if m else None
 
 
 def _count_scans(n: P.PlanNode) -> int:
@@ -123,6 +149,11 @@ class FaultTolerantScheduler:
         # committed spool dirs: fragment -> [task_index -> SpoolHandle path]
         committed: Dict[int, List[str]] = {}
         self._created_tasks: List[Tuple[str, str]] = []  # (uri, task_id)
+        # per-stage (frag_json, per-task splits, out_buffers) so a corrupt
+        # committed attempt can be healed by re-running just its producer
+        self._stage_ctx: Dict[int, tuple] = {}
+        self._heal_lock = threading.RLock()  # heals can nest across stages
+        self.heal_actions: List[dict] = []  # observability for chaos tests
         # observed spool bytes per completed fragment (the
         # OutputStatsEstimator role) + the adaptive actions taken from
         # them (surfaced for tests/observability)
@@ -140,11 +171,24 @@ class FaultTolerantScheduler:
                     self.output_stats[f.id] = self._spool_bytes(
                         committed[f.id]
                     )
-            from ..exchange.filesystem import SpoolHandle
-
-            root_pages = read_spool_pages(
-                SpoolHandle(committed[0][0]).buffer_file(0)
-            )
+            for _ in range(self.max_attempts):
+                try:
+                    root_pages = read_spool_pages(
+                        SpoolHandle(committed[0][0]).buffer_file(0)
+                    )
+                    break
+                except SpoolCorruptionError as e:
+                    # the ROOT attempt itself is corrupt: heal it like any
+                    # other producer (decommit + re-run) and re-read
+                    if not self._heal_corrupt_spool(
+                        query_id, e.path, committed, by_id
+                    ):
+                        raise
+            else:
+                raise SchedulerError(
+                    "root spool still corrupt after "
+                    f"{self.max_attempts} heal attempts"
+                )
             if not root_pages:
                 raise SchedulerError("root stage produced no pages")
             return concat_pages(root_pages)
@@ -171,8 +215,6 @@ class FaultTolerantScheduler:
     ) -> Dict[str, list]:
         """Spool-file locations of the committed upstream attempts (same
         buffer routing as the pipelined scheduler, different location shape)."""
-        from ..exchange.filesystem import SpoolHandle
-
         sources: Dict[str, list] = {}
         for sf in f.source_fragments:
             src = by_id[sf]
@@ -201,6 +243,9 @@ class FaultTolerantScheduler:
         per_task_splits = assign_splits(self.catalogs, f, ntasks)
         root = self._adapt_fragment(f)
         frag_json = plan_to_json(root)
+        # retained so a later-detected corrupt committed attempt can be
+        # healed by re-running exactly one producer task of this stage
+        self._stage_ctx[f.id] = (frag_json, per_task_splits, out_buffers)
         from concurrent.futures import ThreadPoolExecutor
 
         sibling_times: List[float] = []  # completed task durations (stage)
@@ -391,21 +436,22 @@ class FaultTolerantScheduler:
 
     def _run_task_with_retries(
         self, query_id, f, task_index, frag_json, splits, out_buffers,
-        committed, by_id, sibling_times=None, pool=None,
+        committed, by_id, sibling_times=None, pool=None, attempt_base=0,
     ) -> str:
         """Primary attempts with failover + at most one speculative backup
         per primary attempt; FIRST COMMITTED ATTEMPT WINS, the loser is
         aborted.  Backups run on daemon threads so neither the stage pool
-        nor the retry loop ever blocks on a slow backup."""
-        import threading
-
+        nor the retry loop ever blocks on a slow backup.  attempt_base > 0
+        is the heal path re-running a producer whose earlier attempts'
+        numbers (task ids + spool dirs) must never be reused."""
         speculate = bool(self.properties.get("speculative_execution", True))
         last_error = None
         # Monotonic attempt allocator: EVERY launched attempt (primary or
         # backup, finished or not) consumes a number, so a task_id / spool
         # dir {task}.{attempt} is never reused — a timed-out-but-running
         # backup can never collide with a later primary.
-        next_attempt = 0
+        next_attempt = attempt_base
+        max_attempt = attempt_base + self.max_attempts
         backups: List[dict] = []  # {'done','path','duration','uri','task'}
 
         def backup_winner():
@@ -414,7 +460,7 @@ class FaultTolerantScheduler:
                     return b
             return None
 
-        while next_attempt < self.max_attempts:
+        while next_attempt < max_attempt:
             attempt = next_attempt
             next_attempt += 1
             try:
@@ -456,7 +502,7 @@ class FaultTolerantScheduler:
                     if (
                         speculate
                         and not launched_backup
-                        and next_attempt < self.max_attempts
+                        and next_attempt < max_attempt
                         and sibling_times
                         and time.time() - t0
                         > max(
@@ -512,6 +558,15 @@ class FaultTolerantScheduler:
                 win = backup_winner()
                 if win is not None:
                     return win["path"]
+                corrupt = _corrupt_spool_path(e)
+                if corrupt is not None:
+                    # an UPSTREAM committed attempt failed its CRCs: this
+                    # consumer cannot succeed until the producer is healed
+                    # — decommit + re-run it, then retry the consumer
+                    # against the spliced-in fresh spool path
+                    self._heal_corrupt_spool(
+                        query_id, corrupt, committed, by_id
+                    )
                 # never block on a pending backup — it stays in the race;
                 # the next primary draws a fresh number from next_attempt
                 continue
@@ -531,6 +586,64 @@ class FaultTolerantScheduler:
             f"task {query_id}.{f.id}.{task_index} failed after "
             f"{self.max_attempts} attempts: {last_error}"
         )
+
+    def _heal_corrupt_spool(
+        self,
+        query_id: str,
+        path: str,
+        committed: Dict[int, List[str]],
+        by_id: Dict[int, PlanFragment],
+    ) -> bool:
+        """Retire the corrupt committed attempt owning `path` and re-run
+        its producer task under fresh attempt numbers, splicing the new
+        spool dir into `committed`.  Returns True once the producer is
+        healthy again (including when a concurrent consumer got there
+        first); False when the path doesn't map to a healable stage."""
+        # path: {base}/{query}/{fragment}/{task}.{attempt}/buffer_{id}.bin
+        attempt_dir = os.path.dirname(os.path.abspath(path))
+        frag_dir = os.path.dirname(attempt_dir)
+        task_s, _, attempt_s = os.path.basename(attempt_dir).partition(".")
+        try:
+            fid = int(os.path.basename(frag_dir))
+            task_index = int(task_s)
+        except ValueError:
+            return False
+        ctx = self._stage_ctx.get(fid)
+        paths = committed.get(fid)
+        if ctx is None or paths is None or task_index >= len(paths):
+            return False
+        with self._heal_lock:
+            if os.path.abspath(paths[task_index]) != attempt_dir:
+                return True  # another consumer already healed this one
+            SpoolHandle(attempt_dir).decommit()
+            # fresh attempt numbers: above every attempt dir ever created
+            # for this task — task ids must not be reused (the worker's
+            # create_or_update is idempotent and would hand back the old
+            # task instead of re-running it)
+            used = [int(attempt_s)] if attempt_s.isdigit() else []
+            try:
+                for d in os.listdir(frag_dir):
+                    t, _, a = d.partition(".")
+                    if t == task_s and a.isdigit():
+                        used.append(int(a))
+            except OSError:
+                pass
+            attempt_base = max(used, default=-1) + 1
+            frag_json, per_task_splits, out_buffers = ctx
+            new_path = self._run_task_with_retries(
+                query_id, by_id[fid], task_index, frag_json,
+                per_task_splits[task_index], out_buffers, committed,
+                by_id, attempt_base=attempt_base,
+            )
+            paths[task_index] = new_path
+            self.heal_actions.append({
+                "action": "respawn_corrupt_attempt",
+                "fragment": fid,
+                "task": task_index,
+                "corrupt_path": attempt_dir,
+                "healed_path": new_path,
+            })
+            return True
 
     def _poll_task(self, uri: str, task_id: str):
         """One status poll: (state, reachable) — state None while running

@@ -10,9 +10,15 @@ Reference parity:
   - DELETE /v1/task/{taskId} abort
   - worker announcement to the coordinator's discovery endpoint
     (airlift discovery "trino" service announcements, DiscoveryNodeManager)
-  - fault injection hook (execution/FailureInjector.java:39,61 wired into
-    TaskResource.injectFailure:183): POST /v1/task/{taskId}/fail before the
-    task exists makes its creation fail once (task-retry testing).
+  - fault injection (execution/FailureInjector.java:39,61 wired into
+    TaskResource.injectFailure:183): the seeded FaultInjector
+    (utils/faults.py) drives every chaos site — task_run/task_stall at
+    task start, exchange_fetch/spool_read inside the exchange client,
+    spool_write_corrupt on the FTE spool write, heartbeat in the
+    announcer.  Tasks carrying a ``fault_injection`` property share one
+    injector per spec (per worker), so nth-call rules count across a
+    query; POST /v1/task/{taskId}/fail keeps its taskId-addressed
+    one-shot semantics through the same harness.
 
 Execution: each task runs on its own thread; the fragment compiles/executes
 as one XLA program (exec/fragment_exec.py); output pages are hash/broadcast
@@ -40,6 +46,7 @@ from ..exec.partitioner import (
 from ..page import Page
 from ..serde import decode_value, plan_from_json, serialize_page
 from ..spi import Split
+from ..utils.faults import FaultInjector
 
 TASK_STATES = (
     "PLANNED", "RUNNING", "FLUSHING", "FINISHED", "CANCELED", "ABORTED",
@@ -69,8 +76,14 @@ class TaskManager:
     def __init__(self, catalogs: CatalogManager):
         self.catalogs = catalogs
         self.tasks: Dict[str, TaskExecution] = {}
-        self.injected_failures: Dict[str, str] = {}
         self.lock = threading.Lock()
+        # worker-level injector: serves the /v1/task/{id}/fail endpoint's
+        # taskId-addressed modes and operator-configured sites (heartbeat)
+        self.fault_injector = FaultInjector()
+        # per-spec injectors for tasks shipping a fault_injection
+        # property: all tasks of a query share the spec, hence the
+        # injector, hence one deterministic call counter per worker
+        self._injectors: Dict[str, FaultInjector] = {}
 
     def create_or_update(self, task_id: str, doc: dict) -> TaskExecution:
         with self.lock:
@@ -83,8 +96,19 @@ class TaskManager:
         return t
 
     def inject_failure(self, task_id: str, mode: str):
+        self.fault_injector.set_task_mode(task_id, mode)
+
+    def _injector_for(self, spec) -> FaultInjector:
+        if not spec:
+            return self.fault_injector
+        key = spec if isinstance(spec, str) else json.dumps(
+            spec, sort_keys=True
+        )
         with self.lock:
-            self.injected_failures[task_id] = mode
+            inj = self._injectors.get(key)
+            if inj is None:
+                inj = self._injectors[key] = FaultInjector.from_spec(spec)
+            return inj
 
     def abort(self, task_id: str):
         t = self.tasks.get(task_id)
@@ -106,8 +130,10 @@ class TaskManager:
                 return
             t.state = "RUNNING"
         try:
-            with self.lock:
-                mode = self.injected_failures.pop(t.task_id, None)
+            doc = t.doc
+            config = dict(doc.get("properties") or {})
+            inj = self._injector_for(config.get("fault_injection"))
+            mode = self.fault_injector.take_task_mode(t.task_id)
             if mode is not None:
                 if mode.startswith("STALL"):
                     # straggler injection (FailureInjector TASK_MANAGEMENT
@@ -118,20 +144,28 @@ class TaskManager:
                     _time.sleep(float(mode.split(":", 1)[1]))
                 else:
                     raise RuntimeError(f"injected task failure ({mode})")
-            doc = t.doc
+            if inj.fires("task_run", key=t.task_id):
+                raise RuntimeError(
+                    "injected task failure "
+                    f"(fault_injection site task_run, task {t.task_id})"
+                )
+            inj.stall("task_stall", key=t.task_id)
             plan = plan_from_json(doc["fragment"])
             splits_by_scan: Dict[int, List[Split]] = {}
             for k, sps in (doc.get("splits") or {}).items():
                 splits_by_scan[int(k)] = [decode_value(s) for s in sps]
             sources = doc.get("sources") or {}
-            client = ExchangeClient()
+            client = ExchangeClient(
+                retries=config.get("exchange_retry_attempts"),
+                retry_budget_s=config.get("exchange_retry_budget_s"),
+                fault_injector=inj if inj.enabled() else None,
+            )
             remote_pages = client.fetch_sources(
                 {int(fid): list(locs) for fid, locs in sources.items()}
             )
             with t.lock:
                 if t.state == "ABORTED":
                     return
-            config = dict(doc.get("properties") or {})
             dfs = None
             if config.get("dynamic_filtering", True):
                 from ..exec.dynamic_filter import collect_dynamic_filters
@@ -181,7 +215,22 @@ class TaskManager:
                 # Consumers read only the spool, so drop the RAM copy.
                 from ..exchange.filesystem import SpoolHandle
 
-                SpoolHandle(spool_path).write_buffers(t.buffers)
+                with t.lock:
+                    bufs = t.buffers
+                if inj.enabled():
+                    # chaos site: flip a bit in a spool-bound frame AFTER
+                    # serialization — the commit still happens, so the
+                    # corruption is only detectable by the read-side CRC
+                    bufs = {
+                        bid: [
+                            inj.corrupt(
+                                "spool_write_corrupt", fr, key=t.task_id
+                            )
+                            for fr in frames
+                        ]
+                        for bid, frames in bufs.items()
+                    }
+                SpoolHandle(spool_path).write_buffers(bufs)
                 with t.lock:
                     t.buffers = {}
             with t.lock:
@@ -365,9 +414,16 @@ class WorkerServer:
         coordinator_uri: Optional[str] = None,
         port: int = 0,
         announce_interval: float = 0.25,
+        fault_injection=None,
     ):
         self.node_id = f"worker-{uuid.uuid4().hex[:8]}"
         self.task_manager = TaskManager(catalogs)
+        if fault_injection:
+            # operator-configured chaos (heartbeat drops etc.) rides the
+            # worker-level injector, alongside the /fail endpoint modes
+            self.task_manager.fault_injector = FaultInjector.from_spec(
+                fault_injection
+            )
         self.started = time.time()
         handler = type("Handler", (_WorkerHandler,), {"worker": self})
         self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
@@ -423,6 +479,13 @@ class WorkerServer:
     def _announce_loop(self):
         body = json.dumps({"nodeId": self.node_id, "uri": self.uri}).encode()
         while not self._stop.is_set():
+            if self.task_manager.fault_injector.fires(
+                "heartbeat", key=self.node_id
+            ):
+                # injected missed announcement: the coordinator's
+                # failure detector sees this node go silent
+                self._stop.wait(self.announce_interval)
+                continue
             try:
                 req = urllib.request.Request(
                     f"{self.coordinator_uri}/v1/announcement",

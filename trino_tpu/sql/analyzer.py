@@ -1695,6 +1695,16 @@ class Analyzer:
                 raise SemanticError(
                     f"view {view.name} is stale: column count changed"
                 )
+            # the declared types are part of the view's contract too
+            # (VIEW_IS_STALE covers type drift, not just arity): a base
+            # table whose column changed type under the view must fail
+            # expansion, not silently return the new type
+            for (cname, ctype), fld in zip(view.columns, rp.scope.fields):
+                if ctype and str(fld.type) != ctype:
+                    raise SemanticError(
+                        f"view {view.name} is stale: column '{cname}' "
+                        f"type changed ({ctype} -> {fld.type})"
+                    )
             qual = t.alias or view.name
             fields = [
                 Field(qual, c.lower(), f.symbol, f.type)
