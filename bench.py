@@ -63,6 +63,38 @@ JAX_CACHE = os.path.join(REPO, ".jax_cache")
 # this through a scan is a measurement artifact, not throughput
 HBM_BYTES_PER_SEC_CAP = 2.0e12
 
+
+def _cache_mode() -> str:
+    """--cache {off,cold,warm} (also BENCH_CACHE env).
+
+    cold (default): result cache OFF during timing — warm repeats measure
+        fragment execution, not cache lookups (the pre-cache-subsystem
+        semantics, so numbers stay comparable across runs); the compile
+        and scan caches behave as always.
+    warm: every tier on — warm repeats are served from the fragment
+        result cache, and hit rates land in the config's JSON.
+    off:  every tier off (result, compile, scan) — the no-cache floor.
+    """
+    mode = os.environ.get("BENCH_CACHE", "cold")
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--cache" and i + 1 < len(argv):
+            mode = argv[i + 1]
+        elif a.startswith("--cache="):
+            mode = a.split("=", 1)[1]
+    if mode not in ("off", "cold", "warm"):
+        raise SystemExit(f"--cache must be off|cold|warm, got {mode!r}")
+    return mode
+
+
+CACHE_MODE = _cache_mode()
+CACHE_PROPS = {
+    "off": {"result_cache": False, "compile_cache": False,
+            "scan_cache_enabled": False},
+    "cold": {"result_cache": False},
+    "warm": {},
+}[CACHE_MODE]
+
 Q6 = """
 select sum(l_extendedprice * l_discount) as revenue
 from lineitem
@@ -185,10 +217,19 @@ def _safe(fn):
         return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
 
 
+def _cache_counts(session):
+    mgr = getattr(session, "caches", None)
+    if mgr is None:
+        return None
+    rc, cc = mgr.result_cache, mgr.compile_cache
+    return (rc.hits, rc.misses, cc.hits, cc.misses)
+
+
 def _time_config(session, sql, rows, iters):
     """cold (first, incl. compile+upload) + steady (best warm) timings."""
     import jax
 
+    c0 = _cache_counts(session)
     t0 = time.perf_counter()
     page = session.execute(sql)
     jax.block_until_ready(())  # results are host numpy already (Page)
@@ -201,7 +242,7 @@ def _time_config(session, sql, rows, iters):
         times.append(time.perf_counter() - t0)
     steady = min(times) if times else cold
     gbps = (nbytes / steady) / 1e9 if steady > 0 else 0.0
-    return {
+    out = {
         "rows": rows,
         "out_rows": page.count,
         "cold_s": round(cold, 4),
@@ -211,6 +252,20 @@ def _time_config(session, sql, rows, iters):
         "effective_gbps": round(gbps, 2),
         "bandwidth_suspect": bool(gbps * 1e9 > HBM_BYTES_PER_SEC_CAP),
     }
+    c1 = _cache_counts(session)
+    if c0 is not None and c1 is not None:
+        # per-config deltas (the compile cache is process-global, so raw
+        # totals would smear across configs)
+        rh, rm = c1[0] - c0[0], c1[1] - c0[1]
+        ch, cm = c1[2] - c0[2], c1[3] - c0[3]
+        out["result_cache_hits"] = rh
+        out["result_cache_hit_rate"] = (
+            round(rh / (rh + rm), 3) if rh + rm else 0.0
+        )
+        out["compile_cache_hit_rate"] = (
+            round(ch / (ch + cm), 3) if ch + cm else 0.0
+        )
+    return out
 
 
 def _table_rows(session, table) -> int:
@@ -227,6 +282,9 @@ def _drop_session(s):
     s._scan_cache.entries.clear()
     s._scan_cache.bytes = 0
     s._jit_cache.clear()
+    mgr = getattr(s, "caches", None)
+    if mgr is not None:
+        mgr.result_cache.clear()
     gc.collect()
     import jax as _jax
 
@@ -423,6 +481,7 @@ def _cpu_probe(iters, budget_left) -> dict:
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_CPU_PROBE"] = "1"
     env["BENCH_ITERS"] = str(iters)
+    env["BENCH_CACHE"] = CACHE_MODE  # probe must time the same semantics
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -460,7 +519,7 @@ def _run_probe():
     from trino_tpu.session import tpch_session
 
     iters = int(os.environ.get("BENCH_ITERS", "5"))
-    s = tpch_session(1.0)
+    s = tpch_session(1.0, **CACHE_PROPS)
     rows = _table_rows(s, "lineitem")
     r = _time_config(s, Q6, rows, iters)
     print(json.dumps({"value": r["rows_per_sec"], "backend": _backend()}))
@@ -522,6 +581,7 @@ def main():
         "vs_baseline": 0.0,
         "backend": backend,
         "compile_cache": compile_cache,
+        "cache_mode": CACHE_MODE,
         "budget_s": budget,
         "configs": {},
     }
@@ -550,16 +610,16 @@ def main():
                 self.obj = None
 
     def _mk_big():
-        s = tpch_session(big_sf)
+        s = tpch_session(big_sf, **CACHE_PROPS)
         s._scan_cache.max_bytes = 11 << 30
         return s
 
     def _mk_ds():
-        s = tpcds_session(ds_sf)
+        s = tpcds_session(ds_sf, **CACHE_PROPS)
         s._scan_cache.max_bytes = 9 << 30
         return s
 
-    sf1 = Shared(lambda: tpch_session(1.0))
+    sf1 = Shared(lambda: tpch_session(1.0, **CACHE_PROPS))
     big = Shared(_mk_big)
     ds = Shared(_mk_ds)
 
@@ -570,13 +630,13 @@ def main():
         return run
 
     def _cfg_tiny():
-        s = tpch_session(0.01)
+        s = tpch_session(0.01, **CACHE_PROPS)
         r = _time_config(s, Q6, _table_rows(s, "lineitem"), iters)
         _drop_session(s)
         return r
 
     def _cfg_q3_big():
-        s = tpch_session(q3_sf)
+        s = tpch_session(q3_sf, **CACHE_PROPS)
         s._scan_cache.max_bytes = 9 << 30
         r = _time_config(s, Q3, _table_rows(s, "lineitem"), iters_big)
         r["sf"] = q3_sf
@@ -587,7 +647,9 @@ def main():
         # bounded-memory STREAMING config: Q3 at the spec SF10 used to
         # OOM-crash the worker; the fragment-tiled executor bounds the
         # device working set (host RAM is the exchange tier)
-        s = tpch_session(10.0, query_max_memory_bytes=4 << 30)
+        s = tpch_session(
+            10.0, query_max_memory_bytes=4 << 30, **CACHE_PROPS
+        )
         rows = int(
             s.metadata.table_statistics("tpch", "lineitem").row_count
         )
@@ -600,7 +662,9 @@ def main():
         # generation (row count from connector stats: count(*) would
         # stream the whole table once just to size the denominator)
         def run():
-            s = tpch_session(100.0, query_max_memory_bytes=8 << 30)
+            s = tpch_session(
+                100.0, query_max_memory_bytes=8 << 30, **CACHE_PROPS
+            )
             rows = int(
                 s.metadata.table_statistics("tpch", "lineitem").row_count
             )
@@ -611,7 +675,7 @@ def main():
         return run
 
     def _cfg_hive():
-        gen = tpch_session(hive_sf)
+        gen = tpch_session(hive_sf, **CACHE_PROPS)
         page = gen.execute(
             "select l_orderkey, l_quantity, l_extendedprice, "
             "l_discount, l_shipdate from lineitem"
@@ -621,7 +685,7 @@ def main():
         with tempfile.TemporaryDirectory() as wh:
             write_parquet_table(wh, "lineitem", page, rows_per_group=1 << 20)
             _drop_session(gen)
-            hs = Session()
+            hs = Session(config=dict(CACHE_PROPS))
             hs.create_catalog("hive", "hive", {"hive.warehouse-dir": wh})
             r = _time_config(hs, HIVE_SCAN, page.count, iters)
             _drop_session(hs)

@@ -1,0 +1,87 @@
+"""Unified cache subsystem: one memory-accounted, observable home for the
+engine's three cooperating cache tiers.
+
+- signature:     canonical plan digests + determinism analysis (keys)
+- result_cache:  fragment result pages, LRU + TPG2 disk spill (session tier)
+- compile_cache: compiled XLA fragment executables (process tier, with a
+                 persistent on-disk index via JAX's compilation cache)
+
+The session-owned DeviceScanCache (exec/local.py) is the fourth, older tier;
+CacheManager adopts it for stats so ``system.runtime.caches`` and /v1/cache
+report every tier in one place.  Hits/misses/evictions flow to
+utils.events listeners as CacheEvents.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .compile_cache import CompileCache, shared_compile_cache
+from .result_cache import FragmentResultCache
+from .signature import (
+    PlanSignature,
+    analyze_determinism,
+    fragment_fingerprint,
+    plan_signature,
+)
+
+__all__ = [
+    "CacheManager",
+    "CompileCache",
+    "FragmentResultCache",
+    "PlanSignature",
+    "analyze_determinism",
+    "fragment_fingerprint",
+    "plan_signature",
+    "shared_compile_cache",
+]
+
+_ROW_COLUMNS = (
+    "hits", "misses", "puts", "evictions", "entries", "bytes", "max_bytes",
+    "heals", "invalidations",
+)
+
+
+class CacheManager:
+    """Per-session facade over the cache tiers: stats aggregation for the
+    system table / HTTP endpoint and the single CacheEvent funnel."""
+
+    def __init__(
+        self,
+        result_cache: FragmentResultCache,
+        compile_cache: CompileCache,
+        scan_cache=None,
+        events=None,
+    ):
+        self.result_cache = result_cache
+        self.compile_cache = compile_cache
+        self.scan_cache = scan_cache
+        self._events = events
+
+    def emit(self, tier: str, op: str, nbytes: int = 0) -> None:
+        if self._events is not None:
+            self._events.cache_event(tier, op, nbytes)
+
+    def stats_rows(self) -> List[Dict[str, int]]:
+        """Fixed-schema rows for system.runtime.caches (one per tier)."""
+        rows = []
+        for src in (self.result_cache, self.compile_cache, self.scan_cache):
+            if src is None:
+                continue
+            st = src.stats()
+            rows.append(
+                {"name": st.get("name", type(src).__name__)}
+                | {c: int(st.get(c, 0)) for c in _ROW_COLUMNS}
+            )
+        return rows
+
+    def snapshot(self) -> List[dict]:
+        """Full stats (superset of stats_rows) for /v1/cache."""
+        out = []
+        for src in (self.result_cache, self.compile_cache, self.scan_cache):
+            if src is not None:
+                out.append(dict(src.stats()))
+        return out
+
+    def clear(self) -> None:
+        self.result_cache.clear()
+        self.compile_cache.clear()

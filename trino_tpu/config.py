@@ -212,6 +212,31 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "result_cache",
+            "serve repeated deterministic queries from the fragment "
+            "result cache (invalidated by connector data versions)",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "result_cache_max_bytes",
+            "in-memory byte budget for the fragment result cache "
+            "(cold entries spill to disk as checksummed frames)",
+            int, 256 << 20,
+        ),
+        PropertyMetadata(
+            "compile_cache",
+            "share compiled XLA fragment executables across queries and "
+            "sessions (off: per-executor jit only)",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "compile_cache_dir",
+            "persistent compile-cache directory shared across processes "
+            "(jax persistent compilation cache + fragment index); "
+            "empty = in-memory only",
+            str, "",
+        ),
+        PropertyMetadata(
             "device_generation",
             "materialize counter-based generator scans (tpch) directly "
             "in HBM instead of host numpy + upload",

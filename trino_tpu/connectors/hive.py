@@ -21,6 +21,7 @@ Catalog config: {"hive.warehouse-dir": path}. Layout:
 from __future__ import annotations
 
 import glob
+import hashlib
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -432,11 +433,13 @@ class HiveConnector(Connector):
         never invalidates B's cached scans or compiled fragments.  The
         inode + ctime terms catch same-size in-place rewrites even on
         filesystems with coarse mtime granularity (an atomic
-        rename-into-place always changes the inode)."""
+        rename-into-place always changes the inode).  The digest is
+        process-stable (blake2b, not salted hash()) — persistent
+        compile-cache keys embed it and must survive restarts."""
         root_dir = (
             os.path.join(self.warehouse, table) if table else self.warehouse
         )
-        h = 0
+        h = hashlib.blake2b(digest_size=8)
         for root, _dirs, files in sorted(os.walk(root_dir)):
             for f in sorted(files):
                 p = os.path.join(root, f)
@@ -444,11 +447,11 @@ class HiveConnector(Connector):
                     st = os.stat(p)
                 except OSError:
                     continue
-                h = hash(
-                    (h, p, st.st_mtime_ns, st.st_ctime_ns, st.st_ino,
-                     st.st_size)
+                h.update(
+                    repr((p, st.st_mtime_ns, st.st_ctime_ns, st.st_ino,
+                          st.st_size)).encode()
                 )
-        return h
+        return int.from_bytes(h.digest(), "little")
 
     def metadata(self) -> HiveMetadata:
         return self._metadata

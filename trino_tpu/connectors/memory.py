@@ -36,6 +36,13 @@ class _Store:
         self.tables: Dict[str, Page] = {}
         self.schemas: Dict[str, TableSchema] = {}
         self.version = 0  # bumped on every write (scan-cache invalidation)
+        # per-table change counters (Connector.data_version(table)): an
+        # INSERT into A must not invalidate cached results scanning B
+        self.versions: Dict[str, int] = {}
+
+    def bump(self, table: str) -> None:
+        self.version += 1
+        self.versions[table] = self.versions.get(table, 0) + 1
 
 
 class MemoryMetadata(ConnectorMetadata):
@@ -56,7 +63,7 @@ class MemoryMetadata(ConnectorMetadata):
     def create_table(self, schema: TableSchema) -> None:
         if schema.name in self.store.tables:
             raise ValueError(f"table {schema.name} already exists")
-        self.store.version += 1
+        self.store.bump(schema.name)
         cols = [column_from_pylist(c.type, []) for c in schema.columns]
         self.store.tables[schema.name] = Page(
             cols, 0, [c.name for c in schema.columns]
@@ -66,7 +73,7 @@ class MemoryMetadata(ConnectorMetadata):
     def drop_table(self, table: str) -> None:
         if table not in self.store.tables:
             raise KeyError(f"table {table} does not exist")
-        self.store.version += 1
+        self.store.bump(table)
         del self.store.tables[table]
         del self.store.schemas[table]
 
@@ -149,7 +156,7 @@ class MemoryPageSink(PageSink):
             cols, len(data[schema.columns[0].name]),
             [c.name for c in schema.columns],
         )
-        self.store.version += 1
+        self.store.bump(self.table)
         return self.rows
 
 
@@ -169,11 +176,13 @@ class MemoryConnector(Connector):
         self.store = _Store()
 
     def data_version(self, table=None) -> int:
-        return self.store.version
+        if table is None:
+            return self.store.version
+        return self.store.versions.get(table, 0)
 
     def create_table(self, name: str, schema, data: dict):
         """schema: list of (col, Type); data: col -> python values."""
-        self.store.version += 1
+        self.store.bump(name)
         cols = [column_from_pylist(t, data[c]) for c, t in schema]
         counts = {len(c) for c in cols}
         assert len(counts) == 1

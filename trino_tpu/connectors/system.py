@@ -62,6 +62,20 @@ SCHEMAS: Dict[str, List] = {
         ("value", T.VARCHAR),
         ("default", T.VARCHAR),
     ],
+    # one row per cache tier (result_cache / compile_cache / scan_cache);
+    # backed by the session CacheManager (cache/__init__._ROW_COLUMNS)
+    "caches": [
+        ("name", T.VARCHAR),
+        ("hits", T.BIGINT),
+        ("misses", T.BIGINT),
+        ("puts", T.BIGINT),
+        ("evictions", T.BIGINT),
+        ("entries", T.BIGINT),
+        ("bytes", T.BIGINT),
+        ("max_bytes", T.BIGINT),
+        ("heals", T.BIGINT),
+        ("invalidations", T.BIGINT),
+    ],
 }
 
 
@@ -156,6 +170,13 @@ class _SystemSource:
                 "name": [r[0] for r in rows],
                 "value": [r[1] for r in rows],
                 "default": [r[2] for r in rows],
+            }
+        if table == "caches":
+            mgr = getattr(s, "caches", None)
+            stats = mgr.stats_rows() if mgr is not None else []
+            return {
+                c: [r.get(c) for r in stats]
+                for c, _t in SCHEMAS["caches"]
             }
         raise KeyError(f"unknown system table: {table}")
 

@@ -22,6 +22,7 @@ Sort/TopN emit compacted, ordered prefixes.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -56,17 +57,45 @@ class DeviceScanCache:
         self.max_bytes = max_bytes
         self.entries: Dict[tuple, dict] = {}
         self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.puts = 0
+        self.evictions = 0
 
-    def get(self, key: tuple):
-        return self.entries.get(key)
+    def get(self, key: tuple, record: bool = True):
+        """record=False for secondary lookups of an already-counted entry
+        (the device-lane rebind path re-reads what _load_one_scan found)."""
+        entry = self.entries.get(key)
+        if record:
+            if entry is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+        return entry
 
     def put(self, key: tuple, entry: dict, nbytes: int):
         while self.bytes + nbytes > self.max_bytes and self.entries:
             oldest = next(iter(self.entries))
             self.bytes -= self.entries.pop(oldest).get("nbytes", 0)
+            self.evictions += 1
         entry["nbytes"] = nbytes
         self.entries[key] = entry
         self.bytes += nbytes
+        self.puts += 1
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "name": "scan_cache",
+            "hits": self.hits,
+            "misses": self.misses,
+            "puts": self.puts,
+            "evictions": self.evictions,
+            "entries": len(self.entries),
+            "bytes": self.bytes,
+            "max_bytes": self.max_bytes,
+            "heals": 0,
+            "invalidations": 0,
+        }
 
 
 class ExecutionError(RuntimeError):
@@ -205,14 +234,19 @@ def merge_pages_to_arrays(pages, symbols, types, dicts):
 def dict_fingerprint(dicts: Dict[str, np.ndarray], symbols) -> int:
     """Exact content hash of the dictionaries for these symbols (dict
     codes are baked into traced programs as constants; identical
-    fingerprints are required to share a compiled executable)."""
-    parts = []
+    fingerprints are required to share a compiled executable).  blake2b,
+    not hash(): the fingerprint flows into compile-cache keys whose
+    persistent tier must be stable across processes, and str hashing is
+    salted per process."""
+    h = hashlib.blake2b(digest_size=8)
     for s in sorted(symbols):
         d = dicts.get(s)
         if d is None:
             continue
-        parts.append((s, len(d), tuple(str(x) for x in d)))
-    return hash(tuple(parts))
+        h.update(f"{s}\x1f{len(d)}\x1f".encode())
+        for x in d:
+            h.update(str(x).encode() + b"\x00")
+    return int.from_bytes(h.digest(), "little")
 
 
 def _is_null_expr(e: ir.Expr) -> bool:
@@ -385,15 +419,17 @@ class LocalExecutor:
                          {s: out_lanes[s] for s in plan.symbols}, sel)
                     )
                 except jax.errors.JaxRuntimeError as e:
-                    # axon tunnel executable-reuse fault: drop any cached
-                    # executable and recompile the same trace.  The fault
-                    # strikes warm re-dispatches AND cold first dispatches
-                    # (after a different-shape sibling compiled), so retry
-                    # regardless of cache state — a fresh jax.jit wrapper
-                    # gets a clean executable.  ONLY for INVALID_ARGUMENT
-                    # (the observed fault signature), at most three times —
-                    # OOM/crashes (RESOURCE_EXHAUSTED/UNAVAILABLE) must
-                    # surface with their real message, not burn the ladder
+                    # axon tunnel executable-reuse fault: the poisoned
+                    # object is the CACHED EXECUTABLE (and possibly its
+                    # cached device operands), so the remedy is targeted:
+                    # evict that one entry and recompile EXACTLY ONCE per
+                    # key.  (The old path retried up to three times
+                    # "regardless of cache state", re-popping an entry the
+                    # first retry already replaced — two extra compiles of
+                    # a program that was going to fail identically.)
+                    # ONLY for INVALID_ARGUMENT (the observed fault
+                    # signature) — OOM/crashes (RESOURCE_EXHAUSTED/
+                    # UNAVAILABLE) must surface with their real message.
                     jc = self.config.get("jit_cache")
                     retries = getattr(self, "_jit_fault_retries", 0)
                     msg = str(e)
@@ -416,37 +452,47 @@ class LocalExecutor:
                         stream_page = self._try_forced_streaming(plan)
                         if stream_page is not None:
                             return stream_page
-                    transient = (
-                        "INVALID_ARGUMENT" in msg
+                    key = getattr(self, "_last_jit_key", None)
+                    if use_jit and not compile_oom and compile_flake:
                         # remote compile service hiccups (HTTP 500 /
                         # truncated body) are infra flakes, not program
                         # errors — retry them, with a backoff pause so a
                         # briefly overloaded compile helper can recover
-                        or compile_flake
-                    )
-                    if (
-                        use_jit
-                        and not compile_oom
-                        and retries < (5 if compile_flake else 3)
-                        and transient
-                    ):
-                        if compile_flake:
+                        if retries < 5:
                             import time as _time
 
                             _time.sleep(3.0 * (retries + 1))
-                        self._jit_fault_retries = retries + 1
-                        if jc:
-                            jc.pop(
-                                getattr(self, "_last_jit_key", None), None
-                            )
-                        if retries >= 1:
-                            # persistent fault: cached DEVICE buffers from
-                            # sibling queries can be the poisoned operand.
-                            # RETIRE them to a keep-alive graveyard — NOT
-                            # free them: the tunnel's async buffer frees
-                            # are themselves an observed poison source for
-                            # later transfers (bench.py keeps sessions
-                            # alive for the same reason) — then re-upload.
+                            self._jit_fault_retries = retries + 1
+                            if jc is not None:
+                                jc.pop(key, None)
+                            continue
+                    elif (
+                        use_jit
+                        and not compile_oom
+                        and "INVALID_ARGUMENT" in msg
+                    ):
+                        poisoned = getattr(self, "_poisoned_jit_keys", None)
+                        if poisoned is None:
+                            poisoned = self._poisoned_jit_keys = set()
+                        if key not in poisoned:
+                            # first fault on this key: evict the poisoned
+                            # entry, retire cached device buffers, and
+                            # recompile once.  A second fault on the same
+                            # key means the fresh executable fails too —
+                            # a real program error, surface it.
+                            poisoned.add(key)
+                            if jc is not None:
+                                if hasattr(jc, "evict_poisoned"):
+                                    jc.evict_poisoned(key)
+                                else:
+                                    jc.pop(key, None)
+                            # cached DEVICE buffers from sibling queries
+                            # can be the poisoned operand.  RETIRE them to
+                            # a keep-alive graveyard — NOT free them: the
+                            # tunnel's async buffer frees are themselves
+                            # an observed poison source for later
+                            # transfers (bench.py keeps sessions alive
+                            # for the same reason) — then re-upload.
                             sc = self.config.get(
                                 "scan_cache"
                             ) or getattr(self, "_streaming_cache", None)
@@ -463,11 +509,18 @@ class LocalExecutor:
                                     if dev:
                                         grave.append(dict(dev))
                                         dev.clear()
-                        continue
+                            continue
                     raise
                 fell_back = False
                 for (join_node, _), dup in zip(dups, dup_vals):
                     if int(dup) > 0:
+                        if join_node is None:
+                            # ordinal from a foreign trace did not resolve
+                            # in this plan (should be impossible for
+                            # fingerprint-matched plans): no node to force
+                            raise ExecutionError(
+                                "duplicate build keys in unresolvable join"
+                            )
                         if (
                             getattr(join_node, "direct_domain", None)
                             is not None
@@ -869,7 +922,10 @@ class LocalExecutor:
         if nid is None and node is not None:
             nid = id(node)
         key = self._scan_keys.get(nid) if nid is not None else None
-        entry = cache.get(key) if (cache is not None and key) else None
+        entry = (
+            cache.get(key, record=False)
+            if (cache is not None and key) else None
+        )
         # RemoteSource (exchange input) reuses this load path but has no
         # column mapping and never caches (key is None for it)
         sym_to_col = {
@@ -984,6 +1040,25 @@ class LocalExecutor:
         cache = self.config.get("jit_cache")
         if cache is None:
             cache = {}
+        # the key is built by the cache subsystem: (fragment fingerprint,
+        # capacity ladder state, per-scan shape bucket + versioned scan
+        # identity + dict fingerprint), with plan-local ids translated to
+        # traversal ordinals — a compiled program is a pure function of
+        # (plan, capacities, padded lane shapes, BAKED dictionary
+        # contents), NOT of which splits produced the rows or which
+        # session traced it, so structurally identical fragments from
+        # other sessions (or, via the persistent tier, other processes)
+        # share one executable.
+        from ..cache.compile_cache import fragment_key
+
+        key, order, by_ord = fragment_key(
+            self, plan, scans, counts, _pad_capacity
+        )
+        self._last_jit_key = key
+        # prep is keyed by plan ordinal, NOT id(node): dict keys are part
+        # of the jit pytree structure, so id-based keys would force a
+        # retrace (into the WRONG captured plan) for every session sharing
+        # an entry; ordinals make the structure session-invariant
         prep = {}
         for nid, arrays in scans.items():
             lanes = dict(self._device_lanes(
@@ -994,45 +1069,29 @@ class LocalExecutor:
             # (streaming tiles differ by a few rows while sharing the
             # padded shape — they must share one program)
             lanes["__count__"] = jnp.asarray(counts[nid], dtype=jnp.int64)
-            prep[nid] = lanes
-        key = (
-            id(plan), self.group_capacity, self.join_factor,
-            getattr(self, "topn_factor", 1),
-            getattr(self, "compact_factor", 1),
-            getattr(self, "group_salt", 0),
-            getattr(self, "force_wide_mul", False),
-            frozenset(getattr(self, "force_expansion", ())),
-            frozenset(getattr(self, "force_no_direct", ())),
-            # a compiled program is a pure function of (plan, capacities,
-            # padded lane shapes, BAKED dictionary contents) — NOT of
-            # which splits produced the rows.  The per-scan component is
-            # therefore (row count, version-without-splits, dictionary
-            # fingerprint): streaming tiles with equal tile shapes and
-            # equal (usually empty) dictionaries share one executable,
-            # while a connector write (version bump) or any dictionary
-            # drift still recompiles and refreshes the dict snapshot.
-            tuple(sorted(
-                (nid,
-                 max(_pad_capacity(counts[nid]),
-                     int(self.config.get("scan_cap_override") or 0)
-                     if isinstance(self._scan_nodes.get(nid), P.TableScan)
-                     else 0),
-                 self._jit_scan_component(nid))
-                for nid in scans
-            )),
-        )
-        self._last_jit_key = key
+            prep[order.get(nid, nid)] = lanes
         entry = cache.get(key)
         if entry is None:
             cell: Dict[str, object] = {}
+            # ordinal -> id(node) of the TRACING plan, for the closure
+            ids = {o: i for i, o in order.items()}
 
             def raw(prep_arg):
-                ctx = self.trace_ctx_cls(self, prep_arg, counts)
+                ctx = self.trace_ctx_cls(
+                    self,
+                    {ids.get(o, o): v for o, v in prep_arg.items()},
+                    counts,
+                )
                 ctx.prepared = True
                 out_lanes, sel, ordered, checks = self._run(plan, ctx)
                 cell["ordered"] = ordered
                 cell["caps"] = [(c, k) for _, c, k in checks]
-                cell["dup_nodes"] = [n for n, _ in ctx.dup_checks]
+                # dup-check join nodes are recorded as plan ordinals so a
+                # different session hitting this entry resolves them to
+                # ITS OWN plan's node objects (force sets are id-based)
+                cell["dup_ords"] = [
+                    order.get(id(n), -1) for n, _ in ctx.dup_checks
+                ]
                 return (
                     out_lanes,
                     sel,
@@ -1046,21 +1105,25 @@ class LocalExecutor:
             fn = jax.jit(raw)
             out = fn(prep)
             cell["dicts"] = dict(self.dicts)
+            # the plan reference pins id(plan) (fingerprint memo validity)
             entry = {"fn": fn, "cell": cell, "plan": plan}
             cache[key] = entry
         else:
             cell = entry["cell"]
             self.dicts.update(cell["dicts"])
             # dispatch is async: a tunnel re-dispatch fault surfaces at the
-            # execute() loop's device_get, whose handler retries only
-            # INVALID_ARGUMENT (never OOM) with a bounded recompile count
+            # execute() loop's device_get, whose handler evicts the
+            # poisoned entry and recompiles exactly once (INVALID_ARGUMENT
+            # only, never OOM)
             out = entry["fn"](prep)
         out_lanes, sel, ngroups, dup_vals, colls, wides, sflags = out
         checks = [
             (ng, cap, kind)
             for ng, (cap, kind) in zip(ngroups, cell["caps"])
         ]
-        dups = list(zip(cell["dup_nodes"], dup_vals))
+        dups = [
+            (by_ord.get(o), d) for o, d in zip(cell["dup_ords"], dup_vals)
+        ]
         return (out_lanes, sel, cell["ordered"], checks, dups, colls,
                 wides, sflags)
 

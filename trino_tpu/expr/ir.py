@@ -20,6 +20,18 @@ from typing import Any, Optional, Sequence, Tuple
 
 from .. import types as T
 
+# Functions whose value is not a pure function of their arguments (the
+# reference's FunctionMetadata.isDeterministic bit).  One registry consulted
+# by constant folding (sql/analyzer._fold), the plan-signature determinism
+# analysis (cache/signature.py), and the optimizer: `now`-class functions
+# fold to per-query Constants carrying nondeterministic_origin; `rand`-class
+# functions stay as Calls and are never folded or result-cached.  `uuid` is
+# registered for tagging even though this engine has no runtime kernel yet.
+NONDETERMINISTIC_FUNCTIONS = frozenset({
+    "now", "current_timestamp", "current_date", "localtimestamp",
+    "rand", "random", "uuid",
+})
+
 
 class Expr:
     type: T.Type
@@ -31,10 +43,17 @@ class Expr:
 @dataclasses.dataclass(frozen=True)
 class Constant(Expr):
     """Literal. value is a python scalar; for varchar it is the python str,
-    for decimal it is the *unscaled* int, for date the epoch-day int."""
+    for decimal it is the *unscaled* int, for date the epoch-day int.
+
+    nondeterministic_origin marks constants produced by folding a
+    nondeterministic function at analysis time (now()/current_timestamp
+    evaluate once per query): the value is a legitimate constant for THIS
+    query but must never be shared across queries, so plan/result caches
+    refuse plans containing one."""
 
     type: T.Type
     value: Any  # None = NULL literal
+    nondeterministic_origin: bool = False
 
     def __repr__(self):
         return f"Const({self.value}:{self.type})"
@@ -186,6 +205,19 @@ def walk(e: Expr):
     yield e
     for c in e.children():
         yield from walk(c)
+
+
+def is_deterministic(e: Expr) -> bool:
+    """True when the expression is a pure function of its column inputs —
+    no `rand()`-class Calls and no constants folded from `now()`-class
+    functions.  The single flag the result cache, the plan cache, and
+    constant folding all consult."""
+    for n in walk(e):
+        if isinstance(n, Call) and n.name in NONDETERMINISTIC_FUNCTIONS:
+            return False
+        if isinstance(n, Constant) and n.nondeterministic_origin:
+            return False
+    return True
 
 
 def referenced_columns(e: Expr) -> list:

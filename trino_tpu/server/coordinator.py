@@ -145,6 +145,12 @@ class Coordinator:
                         "NO_NODES_AVAILABLE: no alive workers to schedule on"
                     )
                 plan = self.session._plan_stmt(stmt)
+                # fragment result cache: a warm deterministic plan skips
+                # scheduling entirely (the coordinator-side tier — workers
+                # never see the query)
+                rkey, hit = self.session.cached_result(plan)
+                if hit is not None:
+                    return hit
                 with q.lock:
                     q.state = "RUNNING"
                 props = self.session.properties
@@ -175,17 +181,22 @@ class Coordinator:
                         self.session.catalogs, self.node_manager,
                         properties=task_props,
                     )
-                    return fte.run(plan, q.query_id)
+                    page = fte.run(plan, q.query_id)
+                    self.session.store_result(rkey, page, plan)
+                    return page
                 if props.get("retry_policy") == "query":
-                    return self._run_with_query_retries(
+                    page = self._run_with_query_retries(
                         q, plan, workers, task_props, props
                     )
+                    self.session.store_result(rkey, page, plan)
+                    return page
                 sched = DistributedScheduler(
                     self.session.catalogs, workers, task_props
                 )
                 page = sched.run(plan, q.query_id)
                 # per-task stats rollup (TaskStats -> QueryStats)
                 q.task_stats = getattr(sched, "last_task_stats", [])
+                self.session.store_result(rkey, page, plan)
                 return page
         return self.session.execute(q.sql, user=q.user)
 
@@ -426,6 +437,13 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/v1/resourceGroupState":
             self._json(200, co.resource_groups.info())
+            return
+        if self.path == "/v1/cache":
+            # per-tier cache stats (the HTTP face of system.runtime.caches)
+            mgr = getattr(co.session, "caches", None)
+            self._json(200, {
+                "caches": mgr.snapshot() if mgr is not None else [],
+            })
             return
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:
             q = co.queries.get(parts[2])

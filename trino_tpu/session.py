@@ -86,11 +86,28 @@ class Session:
         from .exec.local import DeviceScanCache
 
         self._scan_cache = DeviceScanCache()
-        # compiled-fragment cache + plan cache (keyed by SQL text): repeat
-        # queries reuse the optimized plan object, whose identity keys the
-        # jitted XLA executable (one program per fragment)
-        self._jit_cache: dict = {}
+        # unified cache subsystem (cache/): session-scoped fragment result
+        # cache + process-global compiled-fragment cache, with the scan
+        # cache adopted for stats (system.runtime.caches, /v1/cache)
+        from .cache import CacheManager, FragmentResultCache
+        from .cache import shared_compile_cache
+
+        self.caches = CacheManager(
+            FragmentResultCache(
+                max_bytes=self.properties.get("result_cache_max_bytes"),
+                on_event=self.events.cache_event,
+            ),
+            shared_compile_cache(),
+            self._scan_cache,
+            events=self.events,
+        )
+        # back-compat alias (bench/tests reach the compiled-fragment
+        # cache through this name); plan cache stays keyed by SQL text
+        self._jit_cache = self.caches.compile_cache
         self._plan_cache: dict = {}
+        # FaultInjector instances per spec text: rules are stateful
+        # (nth counters), so the same spec must reuse one injector
+        self._fault_injectors: dict = {}
         self._capacity_hints: dict = {}
         # streaming fragment DAGs keyed by id(plan): re-fragmenting per
         # run would mint fresh plan objects and defeat jit-cache reuse
@@ -130,7 +147,17 @@ class Session:
         exec_config["broadcast_join_threshold_rows"] = self.properties.get(
             "broadcast_join_threshold_rows"
         )
-        exec_config["jit_cache"] = self._jit_cache
+        cc = self.caches.compile_cache
+        cache_dir = self.properties.get("compile_cache_dir")
+        if cache_dir:
+            # persistent tier: point jax's compilation cache at the shared
+            # directory so a second process skips the XLA compile
+            cc.attach_persistent(cache_dir)
+        # session property compile_cache=false detaches the shared cache
+        # (a throwaway dict keeps the executor's duck-typed surface)
+        exec_config["jit_cache"] = (
+            cc if self.properties.get("compile_cache") else {}
+        )
         exec_config["capacity_hints"] = self._capacity_hints
         exec_config["fragment_cache"] = self._fragment_cache
         if self.properties.get("distributed"):
@@ -203,11 +230,14 @@ class Session:
             ast.DropFunction, ast.CreateTable, ast.DropTable, ast.Use,
             ast.SetSession, ast.CreateView, ast.DropView,
         )):
-            # statements that change planning state invalidate cached plans
-            # and compiled fragments; read-only EXECUTE/SHOW/EXPLAIN keep
-            # them (planned DML clears below at planning)
+            # statements that change planning state invalidate cached
+            # plans; read-only EXECUTE/SHOW/EXPLAIN keep them.  Compiled
+            # fragments survive: their keys embed the plan fingerprint,
+            # capacity state and per-table data versions, so entries for
+            # changed schemas/data simply stop being addressable (and the
+            # compile cache is process-shared — clearing it here would
+            # nuke other sessions' warm programs).
             self._plan_cache.clear()
-            self._jit_cache.clear()
             self._capacity_hints.clear()
         if isinstance(stmt, ast.SetSession):
             self.access_control.check_can_set_session(identity, stmt.name)
@@ -569,33 +599,125 @@ class Session:
             if stmt.if_exists and table not in md.list_tables():
                 return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
             md.drop_table(table)
+            self.caches.result_cache.invalidate(catalog, table)
             return page_from_pydict([("rows", T.BIGINT)], {"rows": [0]})
 
         if isinstance(stmt, ast.Query):
             cached = self._plan_cache.get(sql)
             if cached is None:
                 cached = self._plan_stmt(stmt)
-                self._plan_cache[sql] = cached
-                del_keys = list(self._plan_cache)[:-256]
-                for k in del_keys:  # bound the cache
-                    self._plan_cache.pop(k, None)
+                from .cache import plan_signature
+
+                # nondeterministic plans carry query-time folded constants
+                # (now() timestamps, rand() seeds): caching the plan by SQL
+                # text would replay the first execution's values forever
+                if plan_signature(cached).deterministic:
+                    self._plan_cache[sql] = cached
+                    del_keys = list(self._plan_cache)[:-256]
+                    for k in del_keys:  # bound the cache
+                        self._plan_cache.pop(k, None)
             plan = cached
         else:
             # writes (INSERT/DELETE/UPDATE/MERGE/CTAS) change data: cached
-            # plans and compiled fragments are stale
+            # plans are stale (compiled fragments stay — their keys embed
+            # per-table data versions)
             self._plan_cache.clear()
-            self._jit_cache.clear()
             self._capacity_hints.clear()
             plan = self._plan_stmt(stmt)
         self._check_plan_access(plan, identity)
+        rkey = None
+        if isinstance(stmt, ast.Query):
+            rkey, page = self.cached_result(plan)
+            if page is not None:
+                return page
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):
             page = executor.execute(plan)
         # input working-set size of the last query (bench + stats surface)
         self.last_scan_bytes = getattr(executor, "scan_bytes", 0)
+        if rkey is not None:
+            self.store_result(rkey, page, plan)
+        if not isinstance(stmt, ast.Query):
+            self._invalidate_written_tables(plan)
         # batch-export completed spans when an OTLP exporter is attached
         self.tracer.flush()
         return page
+
+    # -- fragment result cache (cache/result_cache) --------------------
+    def _fault_injector(self):
+        """Session FaultInjector from the fault_injection property, cached
+        per spec text (rules hold nth-counters, so the same spec must keep
+        reusing one injector instance)."""
+        spec = self.properties.get("fault_injection")
+        if not spec:
+            return None
+        key = spec if isinstance(spec, str) else repr(spec)
+        inj = self._fault_injectors.get(key)
+        if inj is None:
+            from .utils.faults import FaultInjector
+
+            inj = self._fault_injectors[key] = FaultInjector.from_spec(spec)
+        return inj
+
+    def _result_cache_key(self, plan):
+        """(digest, params, table versions) result-cache key, or None when
+        the plan must not be result-cached: tier disabled, nondeterministic
+        plan, or any scanned connector that is not cacheable."""
+        if not self.properties.get("result_cache"):
+            return None
+        from .cache import plan_signature
+
+        sig = plan_signature(plan)
+        if not sig.deterministic:
+            return None
+        versions = []
+        for cat, tab in sig.tables:
+            try:
+                conn = self.catalogs.get(cat)
+            except Exception:
+                return None
+            if not getattr(conn, "cacheable", True):
+                return None
+            versions.append((cat, tab, conn.data_version(tab)))
+        return (sig.digest, sig.params, tuple(versions))
+
+    def cached_result(self, plan):
+        """Consult the result cache for a planned Query.  Returns
+        (key, page): key is None when the plan is uncacheable; a non-None
+        page is a hit and the query skips fragment execution entirely."""
+        key = self._result_cache_key(plan)
+        if key is None:
+            return None, None
+        rc = self.caches.result_cache
+        # SET SESSION result_cache_max_bytes resizes the live budget
+        rc.max_bytes = int(self.properties.get("result_cache_max_bytes"))
+        page = rc.get(key, injector=self._fault_injector())
+        if page is None:
+            return key, None
+        self.last_scan_bytes = 0  # served from cache: nothing was scanned
+        # relabel with THIS plan's output aliases: the digest is alias-
+        # invariant, so the cached page may carry another query's names
+        return key, Page(list(page.columns), page.count, list(plan.names))
+
+    def store_result(self, key, page: Page, plan) -> None:
+        if key is None:
+            return
+        # scanned tables ride inside the key's version component
+        tables = tuple((c, t) for c, t, _v in key[2])
+        self.caches.result_cache.put(key, page, tables=tables)
+
+    def _invalidate_written_tables(self, plan) -> None:
+        """Eagerly drop cached results over tables a write touched (the
+        version-keyed lookups already miss; this reclaims the bytes)."""
+        rc = self.caches.result_cache
+
+        def walk(n):
+            if isinstance(n, P.TableWriter):
+                rc.invalidate(n.catalog, n.table)
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
 
     def _explain_analyze(self, query, query_id: str) -> Page:
         """EXPLAIN ANALYZE: execute with per-node instrumentation and print

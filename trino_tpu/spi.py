@@ -154,8 +154,30 @@ class Connector:
     cacheable: bool = True
 
     def data_version(self, table: Optional[str] = None) -> int:
-        """Change counter for cache invalidation; connectors tracking
-        per-table versions may scope it to `table`."""
+        """Per-table data-version fingerprint: the cache-invalidation SPI.
+
+        Every cache tier keys on this value — the device scan cache, the
+        compiled-fragment cache and the fragment result cache all embed
+        (catalog, table, data_version(table)) in their keys, so a version
+        bump makes stale entries unaddressable without any explicit
+        invalidation protocol.  Contract:
+
+        - MUST change whenever the visible contents of ``table`` change
+          (INSERT/DELETE/overwrite, external file mutation, ...).
+        - SHOULD be scoped to ``table`` (an INSERT into A must not churn
+          cached results scanning B); ``table=None`` asks for a whole-
+          catalog version (any-table-changed counter).
+        - MUST be stable within a process for unchanged data, and SHOULD
+          be stable ACROSS processes (derive from content/mtimes, not
+          from salted ``hash()``) so persistent compile-cache keys built
+          from it survive restarts.
+
+        The default (constant 0) is correct for immutable sources
+        (generators, static files); mutable connectors either bump a
+        counter per write (connectors/memory) or fingerprint the backing
+        storage (connectors/hive walks the table directory's mtimes).
+        Sources that cannot honor the contract must set ``cacheable``
+        False instead."""
         return 0
 
     def session_property_metadata(self) -> dict:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import datetime
+import random as _random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import types as T
@@ -2194,13 +2195,31 @@ class ExprAnalyzer:
         if e.name in ("current_date", "current_timestamp", "now",
                       "localtimestamp"):
             # evaluated once per query at analysis (reference: constant per
-            # query via Session start time)
+            # query via Session start time); nondeterministic_origin keeps
+            # the FunctionMetadata.isDeterministic bit visible after
+            # folding so plan/result caches never reuse the frozen instant
             now = datetime.datetime.now(datetime.timezone.utc)
             if e.name == "current_date":
                 d = now.date()
-                return ir.Constant(T.DATE, days_from_civil(d.year, d.month, d.day))
+                return ir.Constant(
+                    T.DATE, days_from_civil(d.year, d.month, d.day),
+                    nondeterministic_origin=True,
+                )
             us = int(now.timestamp() * 1_000_000)
-            return ir.Constant(T.TIMESTAMP, us)
+            return ir.Constant(
+                T.TIMESTAMP, us, nondeterministic_origin=True
+            )
+        if e.name in ("rand", "random"):
+            # per-row pseudorandom double in [0, 1): the kernel is a pure
+            # function of (row index, seed) so the traced program stays
+            # deterministic per execution while each QUERY draws a fresh
+            # analysis-time seed (never folded, never cached)
+            if e.args:
+                raise SemanticError(f"{e.name}() takes no arguments")
+            seed = _random.getrandbits(63)
+            return ir.Call(
+                T.DOUBLE, "rand", (ir.Constant(T.BIGINT, seed),)
+            )
         from ..expr.functions import SIGNATURES
 
         if e.name in SIGNATURES:
@@ -2845,6 +2864,11 @@ def _fold(e: ir.Expr) -> ir.Expr:
     """Evaluate constant-only arithmetic/cast at analysis time
     (IrExpressionInterpreter / constant folding analog)."""
     if isinstance(e, ir.Call):
+        # the isDeterministic bit gates folding (the reference's
+        # ExpressionInterpreter does the same): rand(seed) over constants
+        # is still a fresh value per row
+        if e.name in ir.NONDETERMINISTIC_FUNCTIONS:
+            return e
         if not all(isinstance(a, ir.Constant) for a in e.args):
             return e
         if any(a.value is None for a in e.args):

@@ -35,6 +35,17 @@ class QueryCompletedEvent:
         return (self.end_time - self.create_time) * 1000
 
 
+@dataclasses.dataclass(frozen=True)
+class CacheEvent:
+    """One cache-tier operation (hit/miss/put/evict/spill/heal/...): the
+    observability feed behind system.runtime.caches aggregate counters."""
+
+    tier: str  # result | compile | scan
+    op: str
+    nbytes: int
+    time: float
+
+
 class EventListener:
     """SPI: subclass and register (spi/eventlistener/EventListener)."""
 
@@ -42,6 +53,9 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent):
+        pass
+
+    def cache_event(self, event: CacheEvent):
         pass
 
 
@@ -67,6 +81,13 @@ class EventListenerManager:
         )
         for l in self.listeners:
             l.query_completed(ev)
+
+    def cache_event(self, tier: str, op: str, nbytes: int = 0):
+        if not self.listeners:  # hot path: hits/misses fire per query
+            return
+        ev = CacheEvent(tier, op, int(nbytes), time.time())
+        for l in self.listeners:
+            l.cache_event(ev)
 
 
 class HttpEventListener(EventListener):

@@ -35,6 +35,7 @@ SITES = (
     "task_run",             # task fails at start (FailureInjector TASK)
     "task_stall",           # straggler injection (TASK_MANAGEMENT_TIMEOUT)
     "heartbeat",            # worker skips an announcement round
+    "cache_read",           # corrupt a spilled result-cache frame on read
 )
 
 
