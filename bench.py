@@ -265,6 +265,11 @@ def _time_config(session, sql, rows, iters):
         out["compile_cache_hit_rate"] = (
             round(ch / (ch + cm), 3) if ch + cm else 0.0
         )
+    # per-query TPU kernel profile summary (compile wall, recompiles,
+    # padding waste, transfer estimates) from the last warm execute
+    prof = getattr(session, "last_kernel_profile", None) or {}
+    if prof.get("summary"):
+        out["profile"] = prof["summary"]
     return out
 
 
@@ -588,6 +593,11 @@ def main():
 
     def flush():
         state["elapsed_s"] = round(time.perf_counter() - t_start, 1)
+        # engine-wide metrics registry snapshot rides in the artifact:
+        # scheduler/exchange/cache/kernel counters for the whole run
+        from trino_tpu.utils.metrics import REGISTRY
+
+        state["metrics"] = REGISTRY.snapshot()
         print(json.dumps(state), flush=True)
 
     from trino_tpu.session import Session, tpch_session, tpcds_session

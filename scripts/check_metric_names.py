@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Lint: every metric name in the tree follows the naming convention.
+
+Convention: ``trino_tpu_<subsystem>_<name>`` ending in ``_total`` (event
+counts), ``_bytes`` (byte counters), or ``_seconds`` (histograms), with
+``<subsystem>`` drawn from the known set in ``trino_tpu.utils.metrics``.
+The registry enforces this at runtime; this lint catches names at rest in
+the source — including ones on code paths tests never execute.
+
+Run standalone (``python scripts/check_metric_names.py``, exit 1 on
+violations) or as a fast test (tests/test_observability.py wraps it).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from trino_tpu.utils.metrics import METRIC_NAME_RE  # noqa: E402
+
+# a metric name is the first string literal of a registry call; matching
+# at the call site (not every trino_tpu_* literal) keeps unrelated strings
+# like tempdir prefixes out of scope
+REGISTRATION_RE = re.compile(
+    r'\b(?:counter|gauge|histogram)\(\s*["\'](trino_tpu_[a-z0-9_]+)["\']'
+)
+# bare prefixed literals elsewhere still get a looser check: anything that
+# LOOKS like a metric (ends in a unit suffix) must conform fully
+LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_[a-z0-9_]+_(?:total|bytes|seconds))["\']'
+)
+
+SCAN_DIRS = ("trino_tpu", "tests", "scripts")
+SCAN_FILES = ("bench.py",)
+
+
+def iter_source_files(root: str):
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+    for fn in SCAN_FILES:
+        p = os.path.join(root, fn)
+        if os.path.exists(p):
+            yield p
+
+
+def check_tree(root: str):
+    """Returns (checked_count, violations) over every Python file."""
+    checked = 0
+    violations = []
+    for path in iter_source_files(root):
+        with open(path, "r", encoding="utf-8") as f:
+            text = f.read()
+        seen_spans = set()
+        for regex in (REGISTRATION_RE, LITERAL_RE):
+            for m in regex.finditer(text):
+                if m.span(1) in seen_spans:
+                    continue
+                seen_spans.add(m.span(1))
+                name = m.group(1)
+                checked += 1
+                # histogram series names render with _bucket/_sum/_count
+                # suffixes; literals naming those are exposition artifacts,
+                # not registrations
+                base = re.sub(r"_(bucket|sum|count)$", "", name)
+                if not (METRIC_NAME_RE.match(name) or METRIC_NAME_RE.match(base)):
+                    rel = os.path.relpath(path, root)
+                    lineno = text.count("\n", 0, m.start(1)) + 1
+                    violations.append((rel, lineno, name))
+    return checked, violations
+
+
+def main() -> int:
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    checked, violations = check_tree(root)
+    if violations:
+        for rel, lineno, name in violations:
+            print(
+                f"{rel}:{lineno}: metric name {name!r} violates "
+                "trino_tpu_<subsystem>_<name>{_total|_bytes|_seconds}"
+            )
+        return 1
+    print(f"ok: {checked} metric-name literals conform")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -269,6 +269,11 @@ class CompileCache:
             return False
 
     def _event(self, op: str) -> None:
+        from ..utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "trino_tpu_cache_op_total", "Cache operations by tier and op"
+        ).inc(tier="compile", op=op)
         if self._on_event is not None:
             self._on_event("compile", op, 0)
 

@@ -76,6 +76,18 @@ SCHEMAS: Dict[str, List] = {
         ("heals", T.BIGINT),
         ("invalidations", T.BIGINT),
     ],
+    # one row per metric series from the process-global MetricsRegistry —
+    # the plugin/trino-jmx "metrics as SQL" surface; histograms expose
+    # interpolated p50/p95/p99 alongside the observation count
+    "metrics": [
+        ("name", T.VARCHAR),
+        ("kind", T.VARCHAR),
+        ("labels", T.VARCHAR),
+        ("value", T.DOUBLE),
+        ("p50", T.DOUBLE),
+        ("p95", T.DOUBLE),
+        ("p99", T.DOUBLE),
+    ],
 }
 
 
@@ -178,6 +190,10 @@ class _SystemSource:
                 c: [r.get(c) for r in stats]
                 for c, _t in SCHEMAS["caches"]
             }
+        if table == "metrics":
+            from ..utils.metrics import REGISTRY
+
+            return REGISTRY.rows()
         raise KeyError(f"unknown system table: {table}")
 
 
@@ -234,6 +250,7 @@ class SystemPageSourceProvider(PageSourceProvider):
 
 class SystemConnector(Connector):
     cacheable = False  # live engine state changes between queries
+    coordinator_only = True  # snapshots THIS process; never runs on workers
 
     def __init__(self, name: str, session):
         self.name = name

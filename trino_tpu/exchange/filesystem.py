@@ -22,6 +22,7 @@ from typing import Dict, List, Optional
 
 from ..page import Page
 from ..serde import PageIntegrityError, deserialize_page
+from ..utils.metrics import REGISTRY
 
 
 class SpoolCorruptionError(RuntimeError):
@@ -48,6 +49,7 @@ class SpoolHandle:
 
     def write_buffers(self, buffers: Dict[int, List[bytes]]):
         os.makedirs(self.path, exist_ok=True)
+        written = 0
         for bid, frames in buffers.items():
             tmp = os.path.join(self.path, f".buffer_{bid}.tmp")
             with open(tmp, "wb") as f:
@@ -55,7 +57,11 @@ class SpoolHandle:
                 for fr in frames:
                     f.write(struct.pack("<I", len(fr)))
                     f.write(fr)
+                    written += len(fr)
             os.replace(tmp, os.path.join(self.path, f"buffer_{bid}.bin"))
+        REGISTRY.counter(
+            "trino_tpu_spool_write_bytes", "Page-frame bytes spooled to durable storage"
+        ).inc(written)
         # commit marker makes the attempt visible to the scheduler
         with open(os.path.join(self.path, "_COMMIT"), "wb"):
             pass
@@ -83,21 +89,31 @@ def read_spool_pages(path: str) -> List[Page]:
     """Read one committed buffer file back into pages, validating frame
     lengths and per-frame CRCs; any structural damage raises
     SpoolCorruptionError (a *retriable* fault to the FTE scheduler)."""
+    crc_failures = REGISTRY.counter(
+        "trino_tpu_spool_crc_failure_total",
+        "Spool reads rejected by frame-length or CRC validation",
+    )
     with open(path, "rb") as f:
         data = f.read()
+    REGISTRY.counter(
+        "trino_tpu_spool_read_bytes", "Page-frame bytes read back from spool"
+    ).inc(len(data))
     if len(data) < 4:
+        crc_failures.inc()
         raise SpoolCorruptionError(path, f"file truncated ({len(data)}B)")
     (n,) = struct.unpack_from("<I", data, 0)
     off = 4
     pages = []
     for i in range(n):
         if off + 4 > len(data):
+            crc_failures.inc()
             raise SpoolCorruptionError(
                 path, f"truncated at frame {i}/{n} (offset {off})"
             )
         (ln,) = struct.unpack_from("<I", data, off)
         off += 4
         if off + ln > len(data):
+            crc_failures.inc()
             raise SpoolCorruptionError(
                 path,
                 f"frame {i}/{n} length {ln} overruns file "
@@ -106,6 +122,7 @@ def read_spool_pages(path: str) -> List[Page]:
         try:
             pages.append(deserialize_page(data[off : off + ln]))
         except PageIntegrityError as e:
+            crc_failures.inc()
             raise SpoolCorruptionError(path, str(e)) from e
         off += ln
     return pages

@@ -25,6 +25,8 @@ from typing import Dict, List, Optional
 
 from ..page import Page
 from ..serde import deserialize_page
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER
 
 
 class RemoteTaskError(RuntimeError):
@@ -58,6 +60,15 @@ def _fetch_buffer(
     create_deadline = time.time() + CREATE_WAIT
     transient = 0  # consecutive transient failures in the current streak
     streak_deadline = 0.0
+    fetch_total = REGISTRY.counter(
+        "trino_tpu_exchange_fetch_total", "Exchange buffer-fetch HTTP requests"
+    )
+    retry_total = REGISTRY.counter(
+        "trino_tpu_exchange_retry_total", "Exchange fetch backoff retries"
+    )
+    fetch_bytes = REGISTRY.counter(
+        "trino_tpu_exchange_fetch_bytes", "Serialized page bytes pulled over exchange"
+    )
     while True:
         url = f"{uri}/v1/task/{task}/results/{buffer}/{token}"
         try:
@@ -67,6 +78,7 @@ def _fetch_buffer(
                 raise urllib.error.URLError(
                     "injected transient exchange failure"
                 )
+            fetch_total.inc()
             with urllib.request.urlopen(url, timeout=10.0) as resp:
                 seen_task = True
                 transient = 0
@@ -74,6 +86,7 @@ def _fetch_buffer(
                 if resp.status == 200:
                     body = resp.read()
                     if body:
+                        fetch_bytes.inc(len(body))
                         pages.append(deserialize_page(body))
                     if resp.headers.get("X-Buffer-Complete") == "true":
                         return pages
@@ -108,6 +121,7 @@ def _fetch_buffer(
                     f"upstream worker {uri} unreachable after "
                     f"{transient} tries: {e}"
                 )
+            retry_total.inc()
             backoff = RETRY_BASE_S * (2 ** (transient - 1))
             time.sleep(min(backoff * (1.0 + random.random()), 2.0))
             continue
@@ -126,6 +140,7 @@ class ExchangeClient:
         retries: Optional[int] = None,
         retry_budget_s: Optional[float] = None,
         fault_injector=None,
+        traceparent: Optional[str] = None,
     ):
         self.timeout = timeout
         self.concurrency = concurrency
@@ -134,6 +149,9 @@ class ExchangeClient:
             RETRY_BUDGET_S if retry_budget_s is None else float(retry_budget_s)
         )
         self.fault_injector = fault_injector
+        # W3C trace context of the hosting task: fetch spans run on pool
+        # threads with empty span stacks, so the link must be explicit
+        self.traceparent = traceparent
 
     def fetch_sources(
         self, sources: Dict[int, List[dict]]
@@ -150,6 +168,10 @@ class ExchangeClient:
         if not flat:
             return out
 
+        fetch_seconds = REGISTRY.histogram(
+            "trino_tpu_exchange_fetch_seconds", "Wall time of one exchange source fetch"
+        )
+
         def fetch(loc: dict) -> List[Page]:
             if "path" in loc:
                 from ..exchange.filesystem import (
@@ -157,17 +179,29 @@ class ExchangeClient:
                     read_spool_pages,
                 )
 
-                if self.fault_injector is not None and (
-                    self.fault_injector.fires("spool_read", key=loc["path"])
+                with TRACER.span(
+                    "spool_read", traceparent=self.traceparent, path=loc["path"]
                 ):
-                    raise SpoolCorruptionError(
-                        loc["path"], "injected spool read fault"
-                    )
-                return read_spool_pages(loc["path"])
-            return _fetch_buffer(
-                loc["uri"], loc["task"], int(loc["buffer"]), self.timeout,
-                self.retries, self.retry_budget_s, self.fault_injector,
-            )
+                    if self.fault_injector is not None and (
+                        self.fault_injector.fires("spool_read", key=loc["path"])
+                    ):
+                        raise SpoolCorruptionError(
+                            loc["path"], "injected spool read fault"
+                        )
+                    return read_spool_pages(loc["path"])
+            start = time.time()
+            with TRACER.span(
+                "exchange_fetch",
+                traceparent=self.traceparent,
+                uri=loc["uri"],
+                task=loc["task"],
+            ):
+                pages = _fetch_buffer(
+                    loc["uri"], loc["task"], int(loc["buffer"]), self.timeout,
+                    self.retries, self.retry_budget_s, self.fault_injector,
+                )
+            fetch_seconds.observe(time.time() - start)
+            return pages
 
         with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
             futures = [(fid, pool.submit(fetch, loc)) for fid, loc in flat]

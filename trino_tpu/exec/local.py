@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -40,6 +41,8 @@ from ..ops import window as window_ops
 from ..page import Column, Page, pad_to
 from ..plan import nodes as P
 from ..spi import Split
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER
 
 DEFAULT_GROUP_CAPACITY = 4096
 
@@ -280,6 +283,11 @@ class LocalExecutor:
         # EXPLAIN ANALYZE: id(plan node) -> {rows, wall_s, calls}
         # (OperatorStats analog, filled when collect_node_stats is set)
         self.node_stats: Dict[int, dict] = {}
+        # per-query TPU kernel profile: one record per compiled (or eager)
+        # fragment program — compile wall, recompiles, padded-vs-actual
+        # rows, host<->device byte estimates.  Surfaced via EXPLAIN
+        # ANALYZE, /v1/query/{id}/profile, the web UI, and bench output.
+        self.kernel_profile: Dict[str, object] = {"kernels": [], "summary": {}}
         # scan-node id -> DeviceScanCache key (None when uncacheable)
         self._scan_keys: Dict[int, tuple] = {}
         self._scan_nodes: Dict[int, P.TableScan] = {}
@@ -403,6 +411,7 @@ class LocalExecutor:
                             plan, scans, counts
                         )
                     else:
+                        eager_start = time.time()
                         ctx = self.trace_ctx_cls(self, scans, counts)
                         out_lanes, sel, ordered, checks = self._run(
                             plan, ctx
@@ -411,6 +420,15 @@ class LocalExecutor:
                         colls = ctx.collision_checks
                         wides = ctx.lowering.overflow_flags
                         sflags = ctx.sum_overflow
+                        # eager mode has no XLA compile step; the trace
+                        # wall is the honest analog (and each ladder rung
+                        # re-traces, so rungs count as recompiles)
+                        self._record_kernel(
+                            "eager-%d" % attempt,
+                            compile_s=time.time() - eager_start,
+                            cached=False,
+                            mode="eager",
+                        )
                     (dup_vals, check_vals, coll_vals, wide_vals,
                      sflag_vals, host_lanes, sel_np) = jax.device_get(
                         ([d for _, d in dups],
@@ -588,6 +606,7 @@ class LocalExecutor:
                 )
                 for k in list(hints)[:-512]:
                     hints.pop(k, None)
+            self._finalize_kernel_profile(scans, counts, host_lanes, sel_np)
             return self._materialize_host(plan, host_lanes, sel_np)
         finally:
             if pool is not None:
@@ -1030,6 +1049,84 @@ class LocalExecutor:
         return _pad_capacity(min(best * 2, max_rows, 1 << 18))
 
     # ------------------------------------------------------------------
+    def _record_kernel(
+        self, digest: str, compile_s: float, cached: bool, mode: str = "jit"
+    ) -> dict:
+        """Accumulate one fragment-program execution into kernel_profile."""
+        kernels: List[dict] = self.kernel_profile["kernels"]  # type: ignore[assignment]
+        rec = None
+        for k in kernels:
+            if k["digest"] == digest:
+                rec = k
+                break
+        if rec is None:
+            rec = {
+                "digest": digest,
+                "mode": mode,
+                "compiles": 0,
+                "compileWallS": 0.0,
+                "executions": 0,
+                "cacheHits": 0,
+            }
+            kernels.append(rec)
+        rec["executions"] += 1
+        if cached:
+            rec["cacheHits"] += 1
+        else:
+            prior = sum(k["compiles"] for k in kernels)
+            rec["compiles"] += 1
+            rec["compileWallS"] += compile_s
+            REGISTRY.histogram(
+                "trino_tpu_kernel_compile_seconds",
+                "XLA fragment compile (or eager trace) wall time",
+            ).observe(compile_s)
+            if prior > 0:
+                # any compile after the query's first is a recompile:
+                # capacity-ladder rungs, poison evictions, fallback re-traces
+                REGISTRY.counter(
+                    "trino_tpu_kernel_recompile_total",
+                    "Fragment programs compiled beyond the first per query",
+                ).inc()
+        return rec
+
+    def _finalize_kernel_profile(self, scans, counts, host_lanes, sel_np):
+        """Fill the profile summary once the fragment settles: padding
+        waste and estimated host<->device transfer volume."""
+        actual = sum(int(c) for c in counts.values())
+        padded = sum(_pad_capacity(int(c)) for c in counts.values())
+        h2d = 0
+        for nid, arrays in scans.items():
+            count = max(int(counts.get(nid, 1)), 1)
+            scale = _pad_capacity(count) / count
+            for v, ok in arrays.values():
+                nb = int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
+                h2d += int(nb * scale)
+        d2h = int(getattr(sel_np, "nbytes", 0))
+        for v, ok in host_lanes.values():
+            d2h += int(getattr(v, "nbytes", 0))
+            d2h += int(getattr(ok, "nbytes", 0)) if ok is not None else 0
+        kernels: List[dict] = self.kernel_profile["kernels"]  # type: ignore[assignment]
+        compiles = sum(k["compiles"] for k in kernels)
+        self.kernel_profile["summary"] = {
+            "kernels": len(kernels),
+            "compiles": compiles,
+            "recompiles": max(0, compiles - 1),
+            "cacheHits": sum(k["cacheHits"] for k in kernels),
+            "compileWallS": sum(k["compileWallS"] for k in kernels),
+            "actualRows": actual,
+            "paddedRows": padded,
+            "paddingRatio": (padded / actual) if actual else 1.0,
+            "h2dBytes": h2d,
+            "d2hBytes": d2h,
+        }
+        REGISTRY.counter(
+            "trino_tpu_kernel_h2d_bytes", "Estimated host-to-device scan upload bytes"
+        ).inc(h2d)
+        REGISTRY.counter(
+            "trino_tpu_kernel_d2h_bytes", "Estimated device-to-host result bytes"
+        ).inc(d2h)
+
+    # ------------------------------------------------------------------
     def _run_jitted(self, plan: P.Output, scans, counts):
         """One jitted XLA program per fragment (the architecture's codegen
         slot: LocalExecutionPlanner -> generated bytecode in the reference,
@@ -1049,11 +1146,12 @@ class LocalExecutor:
         # session traced it, so structurally identical fragments from
         # other sessions (or, via the persistent tier, other processes)
         # share one executable.
-        from ..cache.compile_cache import fragment_key
+        from ..cache.compile_cache import fragment_key, stable_key_digest
 
         key, order, by_ord = fragment_key(
             self, plan, scans, counts, _pad_capacity
         )
+        digest = stable_key_digest(key)[:12]
         self._last_jit_key = key
         # prep is keyed by plan ordinal, NOT id(node): dict keys are part
         # of the jit pytree structure, so id-based keys would force a
@@ -1102,8 +1200,13 @@ class LocalExecutor:
                     tuple(ctx.sum_overflow),
                 )
 
-            fn = jax.jit(raw)
-            out = fn(prep)
+            compile_start = time.time()
+            with TRACER.span("xla_compile", fragment=digest):
+                fn = jax.jit(raw)
+                out = fn(prep)
+            self._record_kernel(
+                digest, compile_s=time.time() - compile_start, cached=False
+            )
             cell["dicts"] = dict(self.dicts)
             # the plan reference pins id(plan) (fingerprint memo validity)
             entry = {"fn": fn, "cell": cell, "plan": plan}
@@ -1116,6 +1219,7 @@ class LocalExecutor:
             # poisoned entry and recompiles exactly once (INVALID_ARGUMENT
             # only, never OOM)
             out = entry["fn"](prep)
+            self._record_kernel(digest, compile_s=0.0, cached=True)
         out_lanes, sel, ngroups, dup_vals, colls, wides, sflags = out
         checks = [
             (ng, cap, kind)

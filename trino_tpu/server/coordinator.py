@@ -30,6 +30,8 @@ from ..page import Page
 from ..session import Session
 from ..sql import ast
 from ..sql.parser import parse
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER
 from . import protocol
 from .discovery import HeartbeatFailureDetector, NodeManager
 from .resource_groups import QueryQueueFullError, ResourceGroupManager
@@ -96,6 +98,9 @@ class Coordinator:
                source: str = "") -> QueryExecution:
         q = QueryExecution(f"q_{uuid.uuid4().hex[:16]}", sql, user)
         self.queries[q.query_id] = q
+        REGISTRY.counter(
+            "trino_tpu_query_submitted_total", "Queries accepted for dispatch"
+        ).inc()
         group = self.resource_groups.select(user, source)
         q.group = group
         try:
@@ -120,14 +125,43 @@ class Coordinator:
                 q.types = [c.type for c in page.columns]
                 q.state = "FINISHED"
                 q.finished = time.time()
+            REGISTRY.counter(
+                "trino_tpu_query_finished_total", "Queries that reached FINISHED"
+            ).inc()
         except Exception as e:  # surfaced via the protocol error field
             with q.lock:
                 q.error = f"{type(e).__name__}: {e}"
                 q.state = "FAILED"
                 q.finished = time.time()
+            REGISTRY.counter(
+                "trino_tpu_query_failed_total", "Queries that reached FAILED"
+            ).inc()
         finally:
+            REGISTRY.histogram(
+                "trino_tpu_query_wall_seconds", "End-to-end query wall time"
+            ).observe((q.finished or time.time()) - q.created)
             if q.group is not None:
                 q.group.finish()
+
+    def _plan_is_coordinator_only(self, plan) -> bool:
+        """True when the plan scans a connector marked coordinator_only
+        (the system catalog): those tables read live engine state from
+        this process and are never mounted on workers."""
+        from ..plan import nodes as P
+
+        found = []
+
+        def check(node, _depth):
+            if isinstance(node, P.TableScan):
+                try:
+                    conn = self.session.catalogs.get(node.catalog)
+                except Exception:
+                    return
+                if getattr(conn, "coordinator_only", False):
+                    found.append(node.catalog)
+
+        P.visit_plan(plan, check)
+        return bool(found)
 
     def _execute(self, q: QueryExecution) -> Page:
         """Distributed mode routes plain queries through the fragment
@@ -139,12 +173,22 @@ class Coordinator:
             if isinstance(stmt, ast.Query):
                 from .scheduler import DistributedScheduler, SchedulerError
 
+                plan = self.session._plan_stmt(stmt)
+                if self._plan_is_coordinator_only(plan):
+                    # system-catalog scans snapshot THIS process's live
+                    # state (node manager, query history, metrics
+                    # registry); workers don't mount the system catalog
+                    # (SystemTable Distribution.SINGLE_COORDINATOR)
+                    page = self.session.execute(q.sql, user=q.user)
+                    q.kernel_profile = getattr(
+                        self.session, "last_kernel_profile", None
+                    )
+                    return page
                 workers = self.node_manager.alive()
                 if not workers:
                     raise SchedulerError(
                         "NO_NODES_AVAILABLE: no alive workers to schedule on"
                     )
-                plan = self.session._plan_stmt(stmt)
                 # fragment result cache: a warm deterministic plan skips
                 # scheduling entirely (the coordinator-side tier — workers
                 # never see the query)
@@ -174,31 +218,41 @@ class Coordinator:
                     "exchange_retry_budget_s":
                         props.get("exchange_retry_budget_s"),
                 }
-                if props.get("retry_policy") == "task":
-                    from .fte import FaultTolerantScheduler
+                try:
+                    # the query span parents every scheduler dispatch made
+                    # on this thread (traceparent rides the task POSTs), so
+                    # worker task spans join this trace
+                    with TRACER.span("query", query_id=q.query_id):
+                        if props.get("retry_policy") == "task":
+                            from .fte import FaultTolerantScheduler
 
-                    fte = FaultTolerantScheduler(
-                        self.session.catalogs, self.node_manager,
-                        properties=task_props,
-                    )
-                    page = fte.run(plan, q.query_id)
-                    self.session.store_result(rkey, page, plan)
-                    return page
-                if props.get("retry_policy") == "query":
-                    page = self._run_with_query_retries(
-                        q, plan, workers, task_props, props
-                    )
-                    self.session.store_result(rkey, page, plan)
-                    return page
-                sched = DistributedScheduler(
-                    self.session.catalogs, workers, task_props
-                )
-                page = sched.run(plan, q.query_id)
-                # per-task stats rollup (TaskStats -> QueryStats)
-                q.task_stats = getattr(sched, "last_task_stats", [])
+                            fte = FaultTolerantScheduler(
+                                self.session.catalogs, self.node_manager,
+                                properties=task_props,
+                            )
+                            page = fte.run(plan, q.query_id)
+                        elif props.get("retry_policy") == "query":
+                            page = self._run_with_query_retries(
+                                q, plan, workers, task_props, props
+                            )
+                        else:
+                            sched = DistributedScheduler(
+                                self.session.catalogs, workers, task_props
+                            )
+                            page = sched.run(plan, q.query_id)
+                            # per-task stats rollup (TaskStats -> QueryStats)
+                            q.task_stats = getattr(
+                                sched, "last_task_stats", []
+                            )
+                finally:
+                    TRACER.flush()
                 self.session.store_result(rkey, page, plan)
                 return page
-        return self.session.execute(q.sql, user=q.user)
+        page = self.session.execute(q.sql, user=q.user)
+        # in-process execution: the session-side executor's kernel profile
+        # feeds /v1/query/{id}/profile for coordinator-only clusters
+        q.kernel_profile = getattr(self.session, "last_kernel_profile", None)
+        return page
 
     def _run_with_query_retries(
         self, q: QueryExecution, plan, workers, task_props, props
@@ -222,6 +276,10 @@ class Coordinator:
         for attempt in range(max_retries + 1):
             if attempt:
                 q.retry_count = attempt
+                REGISTRY.counter(
+                    "trino_tpu_query_retry_total",
+                    "Whole-query re-dispatches under retry_policy=query",
+                ).inc()
                 time.sleep(QUERY_RETRY_BASE_S * (2 ** (attempt - 1)))
                 # re-resolve placement: the failed worker must be gone
                 # from (or back in) the alive set before we re-dispatch
@@ -256,6 +314,57 @@ class Coordinator:
             f"query failed after {max_retries} whole-query retries: "
             f"{last_error}"
         )
+
+    def query_profile(self, q: QueryExecution) -> dict:
+        """Per-query TPU kernel profile (GET /v1/query/{id}/profile):
+        per-kernel compile wall, recompiles, padding ratio, transfer
+        bytes — rolled up from worker task stats in distributed mode, or
+        taken from the in-process executor otherwise."""
+        tasks = []
+        kernels = []
+        summaries = []
+        for t in getattr(q, "task_stats", []) or []:
+            prof = t.get("kernelProfile")
+            if not prof:
+                continue
+            tasks.append({
+                "taskId": t.get("taskId"),
+                "uri": t.get("uri"),
+                "profile": prof,
+            })
+            kernels.extend(prof.get("kernels") or [])
+            if prof.get("summary"):
+                summaries.append(prof["summary"])
+        local = getattr(q, "kernel_profile", None)
+        if not tasks and local:
+            kernels = list(local.get("kernels") or [])
+            if local.get("summary"):
+                summaries.append(local["summary"])
+        summary = {}
+        if summaries:
+            actual = sum(s.get("actualRows", 0) for s in summaries)
+            padded = sum(s.get("paddedRows", 0) for s in summaries)
+            summary = {
+                "kernels": sum(s.get("kernels", 0) for s in summaries),
+                "compiles": sum(s.get("compiles", 0) for s in summaries),
+                "recompiles": sum(s.get("recompiles", 0) for s in summaries),
+                "cacheHits": sum(s.get("cacheHits", 0) for s in summaries),
+                "compileWallS": sum(
+                    s.get("compileWallS", 0.0) for s in summaries
+                ),
+                "actualRows": actual,
+                "paddedRows": padded,
+                "paddingRatio": (padded / actual) if actual else 1.0,
+                "h2dBytes": sum(s.get("h2dBytes", 0) for s in summaries),
+                "d2hBytes": sum(s.get("d2hBytes", 0) for s in summaries),
+            }
+        return {
+            "queryId": q.query_id,
+            "state": q.state,
+            "kernels": kernels,
+            "summary": summary,
+            "tasks": tasks,
+        }
 
     def cancel(self, query_id: str):
         q = self.queries.get(query_id)
@@ -411,6 +520,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path == "/metrics":
+            body = REGISTRY.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path == "/v1/info":
             self._json(200, {
                 "nodeId": co.node_id,
@@ -444,6 +563,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {
                 "caches": mgr.snapshot() if mgr is not None else [],
             })
+            return
+        if (
+            len(parts) == 4
+            and parts[:2] == ["v1", "query"]
+            and parts[3] == "profile"
+        ):
+            q = co.queries.get(parts[2])
+            if q is None:
+                self._json(404, {"error": "query not found"})
+                return
+            self._json(200, co.query_profile(q))
             return
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:
             q = co.queries.get(parts[2])

@@ -422,6 +422,17 @@ class FaultTolerantScheduler:
             "spool_path": sink.path,
         }
         _post_json(f"{uri}/v1/task/{task_id}", doc)
+        from ..utils.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "trino_tpu_scheduler_dispatch_total",
+            "Remote task creations dispatched to workers",
+        ).inc()
+        if attempt > 0:
+            REGISTRY.counter(
+                "trino_tpu_scheduler_retry_total",
+                "Task attempts beyond the first (failover, backup, heal)",
+            ).inc()
         self._created_tasks.append((uri, task_id))
         return uri, task_id, sink
 

@@ -39,6 +39,8 @@ from ..plan.fragment import (
     fragment_plan,
 )
 from ..serde import encode_value, plan_to_json
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER
 
 SPLITS_PER_NODE = 4
 
@@ -54,10 +56,14 @@ class TaskHandle:
 
 
 def _post_json(url: str, doc: dict, timeout: float = 30.0):
+    headers = {"Content-Type": "application/json"}
+    # propagate the caller's trace context (W3C Trace Context): the worker
+    # parents its task span under the coordinator's query span
+    tp = TRACER.current_traceparent()
+    if tp:
+        headers["traceparent"] = tp
     req = urllib.request.Request(
-        url,
-        data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json"},
+        url, data=json.dumps(doc).encode(), headers=headers
     )
     with urllib.request.urlopen(req, timeout=timeout) as resp:
         return json.loads(resp.read() or b"{}")
@@ -153,7 +159,7 @@ class DistributedScheduler:
                 )
                 created.extend(tasks[f.id])
             root_task = tasks[0][0]
-            client = ExchangeClient()
+            client = ExchangeClient(traceparent=TRACER.current_traceparent())
             pages = client.fetch_sources(
                 {0: [{"uri": root_task.uri, "task": root_task.task_id,
                       "buffer": 0}]}
@@ -230,5 +236,9 @@ class DistributedScheduler:
                 "properties": self.properties,
             }
             _post_json(f"{uri}/v1/task/{task_id}", doc)
+            REGISTRY.counter(
+                "trino_tpu_scheduler_dispatch_total",
+                "Remote task creations dispatched to workers",
+            ).inc()
             handles.append(TaskHandle(task_id, uri))
         return handles

@@ -76,9 +76,23 @@ async function refresh() {
       }
       tr.onclick = async () => {
         const d = await j('/v1/query/' + q.queryId);
+        let prof = null;
+        try { prof = await j('/v1/query/' + q.queryId + '/profile'); }
+        catch (e) { /* profile unavailable */ }
         const el = document.getElementById('detail');
         el.style.display = 'block';
-        el.textContent = JSON.stringify(d, null, 2);
+        let text = JSON.stringify(d, null, 2);
+        const s = prof && prof.summary;
+        if (s && Object.keys(s).length) {
+          text += '\\n\\nTPU kernel profile:' +
+            '\\n  compile wall: ' + (s.compileWallS * 1000).toFixed(2) + 'ms' +
+            '\\n  compiles: ' + s.compiles + ' (recompiles ' + s.recompiles +
+            ', cache hits ' + s.cacheHits + ')' +
+            '\\n  padding ratio: ' + s.paddingRatio.toFixed(2) + 'x (' +
+            s.actualRows + ' -> ' + s.paddedRows + ' rows)' +
+            '\\n  transfers: ~' + s.h2dBytes + 'B h2d, ~' + s.d2hBytes + 'B d2h';
+        }
+        el.textContent = text;
       };
       tbody.appendChild(tr);
     }

@@ -47,6 +47,8 @@ from ..page import Page
 from ..serde import decode_value, plan_from_json, serialize_page
 from ..spi import Split
 from ..utils.faults import FaultInjector
+from ..utils.metrics import REGISTRY
+from ..utils.tracing import TRACER
 
 TASK_STATES = (
     "PLANNED", "RUNNING", "FLUSHING", "FINISHED", "CANCELED", "ABORTED",
@@ -129,6 +131,24 @@ class TaskManager:
             if t.state != "PLANNED":
                 return
             t.state = "RUNNING"
+        REGISTRY.counter(
+            "trino_tpu_task_created_total", "Tasks accepted by this worker"
+        ).inc()
+        # the coordinator's traceparent rides the task doc: this thread
+        # has no local span stack, so the task span joins the query trace
+        # through the W3C context (the Dapper cross-process edge)
+        traceparent = t.doc.get("traceparent")
+        try:
+            with TRACER.span(
+                "task", traceparent=traceparent, task_id=t.task_id
+            ) as task_span:
+                self._run_inner(t, task_span)
+        finally:
+            # export (when an exporter is attached) without waiting for the
+            # coordinator: worker spans must survive task teardown
+            TRACER.flush()
+
+    def _run_inner(self, t: TaskExecution, task_span):
         try:
             doc = t.doc
             config = dict(doc.get("properties") or {})
@@ -159,6 +179,7 @@ class TaskManager:
                 retries=config.get("exchange_retry_attempts"),
                 retry_budget_s=config.get("exchange_retry_budget_s"),
                 fault_injector=inj if inj.enabled() else None,
+                traceparent=task_span.traceparent,
             )
             remote_pages = client.fetch_sources(
                 {int(fid): list(locs) for fid, locs in sources.items()}
@@ -177,12 +198,16 @@ class TaskManager:
             import time as _time
 
             _t0 = _time.time()
-            page = ex.execute(plan)
+            with TRACER.span("fragment_execute", task_id=t.task_id):
+                page = ex.execute(plan)
             t.stats = {
                 "dynamicFilterRowsPruned": ex.df_rows_pruned,
                 "scanBytes": ex.scan_bytes,
                 "outputRows": page.count,
                 "wallMillis": int((_time.time() - _t0) * 1000),
+                # per-kernel compile wall / recompiles / padding — rides
+                # the existing stats rollup back to the coordinator
+                "kernelProfile": getattr(ex, "kernel_profile", None),
             }
             out = doc.get("output") or {}
             part = out.get("partitioning", "single")
@@ -230,7 +255,8 @@ class TaskManager:
                         ]
                         for bid, frames in bufs.items()
                     }
-                SpoolHandle(spool_path).write_buffers(bufs)
+                with TRACER.span("spool_write", path=spool_path):
+                    SpoolHandle(spool_path).write_buffers(bufs)
                 with t.lock:
                     t.buffers = {}
             with t.lock:
@@ -239,6 +265,9 @@ class TaskManager:
                 t.complete = True
                 t.state = "FINISHED"
         except Exception as e:  # propagated to consumers + coordinator
+            REGISTRY.counter(
+                "trino_tpu_task_failed_total", "Tasks that ended FAILED"
+            ).inc()
             with t.lock:
                 if t.state != "ABORTED":
                     t.state = "FAILED"
@@ -288,6 +317,11 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 return
             n = int(self.headers.get("Content-Length", 0))
             doc = json.loads(self.rfile.read(n))
+            # W3C trace context arrives as an HTTP header (scheduler
+            # dispatch); stash it on the doc for the task thread
+            tp = self.headers.get("traceparent")
+            if tp and "traceparent" not in doc:
+                doc["traceparent"] = tp
             t = tm.create_or_update(parts[2], doc)
             self._json(200, {"taskId": t.task_id, "state": t.state})
             return
@@ -322,6 +356,16 @@ class _WorkerHandler(BaseHTTPRequestHandler):
     def do_GET(self):
         parts = self.path.strip("/").split("/")
         w = self.worker
+        if self.path == "/metrics":
+            body = REGISTRY.render_prometheus().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if self.path == "/v1/info":
             self._json(200, {
                 "nodeId": w.node_id,

@@ -635,6 +635,9 @@ class Session:
             page = executor.execute(plan)
         # input working-set size of the last query (bench + stats surface)
         self.last_scan_bytes = getattr(executor, "scan_bytes", 0)
+        # per-query TPU kernel profile (compile wall / recompiles /
+        # padding), surfaced via /v1/query/{id}/profile and bench output
+        self.last_kernel_profile = getattr(executor, "kernel_profile", None)
         if rkey is not None:
             self.store_result(rkey, page, plan)
         if not isinstance(stmt, ast.Query):
@@ -695,6 +698,7 @@ class Session:
         if page is None:
             return key, None
         self.last_scan_bytes = 0  # served from cache: nothing was scanned
+        self.last_kernel_profile = None  # no kernel ran either
         # relabel with THIS plan's output aliases: the digest is alias-
         # invariant, so the cached page may carry another query's names
         return key, Page(list(page.columns), page.count, list(plan.names))
@@ -738,11 +742,34 @@ class Session:
         t0 = time.perf_counter()
         page = executor.execute(plan)
         wall = time.perf_counter() - t0
+        self.last_kernel_profile = getattr(executor, "kernel_profile", None)
         text = P.plan_to_string(plan, executor.node_stats)
         text += (
             f"\n\nQuery: {page.count} output rows in {wall * 1000:.2f}ms "
             f"(single node)"
         )
+        prof = self.last_kernel_profile or {}
+        summary = prof.get("summary") or {}
+        if summary:
+            text += (
+                "\n\nTPU kernel profile:"
+                f"\n  kernels: {summary.get('kernels', 0)}"
+                f" (compile wall {summary.get('compileWallS', 0.0) * 1000:.2f}ms,"
+                f" recompiles {summary.get('recompiles', 0)},"
+                f" cache hits {summary.get('cacheHits', 0)})"
+                f"\n  padding: {summary.get('actualRows', 0)} rows padded to "
+                f"{summary.get('paddedRows', 0)} "
+                f"(ratio {summary.get('paddingRatio', 1.0):.2f}x)"
+                f"\n  transfers: ~{summary.get('h2dBytes', 0)}B host->device, "
+                f"~{summary.get('d2hBytes', 0)}B device->host"
+            )
+            for k in prof.get("kernels") or []:
+                text += (
+                    f"\n  kernel {k['digest']} [{k['mode']}]: "
+                    f"compile {k['compileWallS'] * 1000:.2f}ms, "
+                    f"executions {k['executions']}, "
+                    f"compiles {k['compiles']}"
+                )
         col = column_from_pylist(T.VARCHAR, text.split("\n"))
         return Page([col], len(text.split("\n")), ["Query Plan"])
 

@@ -103,6 +103,12 @@ class FaultInjector:
                 fired = True
             if fired:
                 self._fired[site] += 1
+                from .metrics import REGISTRY
+
+                REGISTRY.counter(
+                    "trino_tpu_fault_injected_total",
+                    "Chaos-harness fault firings by injection site",
+                ).inc(site=site)
             return fired
 
     def fired_count(self, site: str) -> int:

@@ -8,6 +8,7 @@ in-memory recorder; an exporter can forward to a real OTel endpoint.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import threading
@@ -30,12 +31,54 @@ class Span:
     def duration_ms(self) -> float:
         return ((self.end or time.time()) - self.start) * 1000
 
+    @property
+    def traceparent(self) -> str:
+        return format_traceparent(self.trace_id, self.span_id)
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """W3C Trace Context `traceparent`: version-traceid-spanid-flags."""
+    return "00-%s-%s-01" % (trace_id, span_id)
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Dict[str, str]]:
+    """Parse a W3C `traceparent` header into trace/parent span ids.
+
+    Returns None for absent or malformed headers (per spec, an invalid
+    header means "start a fresh trace", never an error).
+    """
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    _version, trace_id, span_id = parts[0], parts[1], parts[2]
+    if len(trace_id) != 32 or len(span_id) != 16:
+        return None
+    try:
+        int(trace_id, 16)
+        int(span_id, 16)
+    except ValueError:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return {"trace_id": trace_id, "parent_id": span_id}
+
 
 class Tracer:
-    """Per-process tracer with thread-local span stacks."""
+    """Per-process tracer with thread-local span stacks.
 
-    def __init__(self):
-        self.spans: List[Span] = []
+    Completed spans land in a bounded ring buffer (oldest dropped first)
+    so a long-lived process with no exporter attached holds at most
+    `max_spans` spans in memory.
+    """
+
+    DEFAULT_MAX_SPANS = 4096
+
+    def __init__(self, max_spans: int = DEFAULT_MAX_SPANS):
+        self.max_spans = max_spans
+        self.spans: "collections.deque[Span]" = collections.deque(maxlen=max_spans)
+        self.exporter: Optional["OtlpFileExporter"] = None
         self._local = threading.local()
         self._lock = threading.Lock()
 
@@ -45,14 +88,25 @@ class Tracer:
         return self._local.stack
 
     @contextlib.contextmanager
-    def span(self, name: str, **attributes):
+    def span(self, name: str, traceparent: Optional[str] = None, **attributes):
+        """Open a span.  A remote `traceparent` joins that trace when the
+        calling thread has no local parent (the Dapper cross-process link:
+        coordinator->worker dispatch, exchange fetch threads)."""
         stack = self._stack()
         parent = stack[-1] if stack else None
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            remote = parse_traceparent(traceparent)
+            if remote is not None:
+                trace_id, parent_id = remote["trace_id"], remote["parent_id"]
+            else:
+                trace_id, parent_id = uuid.uuid4().hex, None
         s = Span(
             name=name,
-            trace_id=parent.trace_id if parent else uuid.uuid4().hex,
+            trace_id=trace_id,
             span_id=uuid.uuid4().hex[:16],
-            parent_id=parent.span_id if parent else None,
+            parent_id=parent_id,
             start=time.time(),
             attributes=dict(attributes),
         )
@@ -64,6 +118,14 @@ class Tracer:
             stack.pop()
             with self._lock:
                 self.spans.append(s)
+
+    def current_span(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    def current_traceparent(self) -> Optional[str]:
+        s = self.current_span()
+        return s.traceparent if s is not None else None
 
     def for_trace(self, trace_id: str) -> List[Span]:
         with self._lock:
@@ -81,11 +143,12 @@ class Tracer:
         """Export + drop all recorded spans (called at query completion —
         the airlift OTel exporter's batch-flush role).  Without an exporter
         spans stay in memory for tests/system tables."""
-        exporter = getattr(self, "exporter", None)
+        exporter = self.exporter
         if exporter is None:
             return
         with self._lock:
-            spans, self.spans = self.spans, []
+            spans = list(self.spans)
+            self.spans.clear()
         if spans:
             exporter.export(spans)
 
