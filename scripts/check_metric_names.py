@@ -31,6 +31,11 @@ REGISTRATION_RE = re.compile(
 LITERAL_RE = re.compile(
     r'["\'](trino_tpu_[a-z0-9_]+_(?:total|bytes|seconds))["\']'
 )
+# memory-subsystem literals are checked unconditionally (suffix or not):
+# the trino_tpu_memory_* gauges are scraped by dashboards keyed on the
+# full convention, so even a suffixless literal in a test or helper is a
+# violation, not an unrelated string
+MEMORY_LITERAL_RE = re.compile(r'["\'](trino_tpu_memory_[a-z0-9_]*)["\']')
 
 SCAN_DIRS = ("trino_tpu", "tests", "scripts")
 SCAN_FILES = ("bench.py",)
@@ -59,7 +64,7 @@ def check_tree(root: str):
         with open(path, "r", encoding="utf-8") as f:
             text = f.read()
         seen_spans = set()
-        for regex in (REGISTRATION_RE, LITERAL_RE):
+        for regex in (REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
                     continue

@@ -1,0 +1,504 @@
+"""Cluster memory manager suite: pools, admission, killer, chaos.
+
+Reference parity: memory/LocalMemoryManager + MemoryPool blocked-future
+semantics, ClusterMemoryManager.java's heartbeat-fed cluster view and
+query.max-total-memory enforcement, LowMemoryKiller policy selection, and
+the resource-group softMemoryLimit gate.  The acceptance scenarios from
+the subsystem's issue live here: (1) two concurrent queries whose
+combined reservation exceeds the budget — the second queues under
+admission control and runs after the first completes; (2) a seeded
+`oom` fault at a blocked node — the low-memory killer kills exactly the
+policy-selected query with a structured error while the other query
+finishes.
+"""
+import json
+import threading
+import time
+
+import pytest
+
+from trino_tpu.memory import (
+    CLUSTER_OOM_MESSAGE,
+    ClusterMemoryManager,
+    LocalMemoryManager,
+    MemoryAdmissionController,
+    QueryKilledError,
+    create_killer,
+)
+from trino_tpu.server.resource_groups import InternalResourceGroup
+from trino_tpu.session import tpch_session
+from trino_tpu.testing import DistributedQueryRunner
+from trino_tpu.utils.faults import FaultInjector
+from trino_tpu.utils.memory import ExceededMemoryLimitError, MemoryPool
+
+
+def _wait_until(cond, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# --- MemoryPool primitives ----------------------------------------------
+
+
+def test_pool_try_reserve_and_snapshot():
+    pool = MemoryPool(100)
+    assert pool.try_reserve("q1", 60)
+    assert not pool.try_reserve("q2", 50)  # would exceed
+    assert pool.try_reserve("q2", 40)
+    assert pool.free_bytes() == 0
+    assert pool.query_bytes("q1") == 60
+    snap = pool.snapshot()
+    assert snap["size"] == 100 and snap["reserved"] == 100
+    assert snap["byQuery"] == {"q1": 60, "q2": 40}
+    pool.free("q1")
+    assert pool.free_bytes() == 60 and pool.query_bytes("q1") == 0
+
+
+# --- LocalMemoryManager accounting --------------------------------------
+
+
+def test_manager_reserved_pool_single_owner_promotion():
+    mgr = LocalMemoryManager(1000)  # general 1000 + reserved 100
+    mgr.reserve("a", 950)
+    mgr.reserve("a", 80)  # overflows general -> promoted to reserved
+    snap = mgr.snapshot()
+    assert snap["pools"]["general"]["byQuery"] == {"a": 950}
+    assert snap["pools"]["reserved"]["byQuery"] == {"a": 80}
+    # the reserved pool admits ONE overflow query at a time
+    with pytest.raises(ExceededMemoryLimitError, match="host memory limit"):
+        mgr.reserve("b", 60, timeout=0)
+    mgr.free_query("a")
+    mgr.reserve("b", 60)
+    snap = mgr.snapshot()
+    assert snap["pools"]["general"]["byQuery"] == {"b": 60}
+    assert snap["pools"]["reserved"]["byQuery"] == {}
+
+
+def test_manager_device_tier_accounted_separately():
+    mgr = LocalMemoryManager(1000, device_bytes=256)
+    mgr.reserve("q", 800, tier="host")
+    mgr.reserve("q", 200, tier="device")
+    snap = mgr.snapshot()
+    assert snap["pools"]["general"]["reserved"] == 800
+    assert snap["pools"]["device"]["reserved"] == 200
+    # HBM exhausted: a device reservation fails even with host headroom
+    with pytest.raises(ExceededMemoryLimitError, match="device"):
+        mgr.reserve("q2", 100, tier="device", timeout=0)
+    mgr.reserve("q2", 100, tier="host")  # host tier unaffected
+    mgr.free_query("q")
+    mgr.reserve("q2", 200, tier="device")  # freed HBM is reusable
+    assert mgr.snapshot()["pools"]["device"]["byQuery"] == {"q2": 200}
+
+
+def test_manager_free_query_clears_every_pool():
+    mgr = LocalMemoryManager(1000, device_bytes=500)
+    mgr.reserve("q", 400)
+    mgr.reserve("q", 300, tier="device")
+    mgr.register_revocable("q", 100, lambda: 0)
+    mgr.free_query("q")
+    snap = mgr.snapshot()
+    assert all(p["reserved"] == 0 for p in snap["pools"].values())
+    assert snap["blocked"] == {}
+    assert mgr._revocable == []
+
+
+# --- revoke -> spill ordering -------------------------------------------
+
+
+def test_revoke_largest_first_before_blocking():
+    mgr = LocalMemoryManager(1000)  # +100 reserved
+    mgr.reserve("a", 300)
+    mgr.reserve("b", 500)
+    order = []
+
+    def spiller(name, held):
+        def spill():
+            order.append(name)
+            mgr.free(name, held)  # the spill releases real pool bytes
+            return held
+        return spill
+
+    mgr.register_revocable("a", 300, spiller("a", 300))
+    mgr.register_revocable("b", 500, spiller("b", 500))
+    # free = 200 general + 100 reserved = 300; q wants 700 -> revoke 400
+    mgr.reserve("q", 700, timeout=0)
+    # largest revocable context spilled FIRST, and spilling stopped as
+    # soon as the shortfall was covered — "a" was never asked
+    assert order == ["b"]
+    snap = mgr.snapshot()
+    assert snap["pools"]["general"]["byQuery"]["q"] == 700
+    # spilled-but-registered contexts stay registered (they free nothing
+    # next time); only unregister/free_query removes them
+    assert [r[0] for r in mgr._revocable] == ["a", "b"]
+
+
+def test_blocked_reservation_unblocks_when_memory_frees():
+    mgr = LocalMemoryManager(100)
+    mgr.reserve("a", 100)
+    got = {}
+
+    def blocked():
+        mgr.reserve("b", 50, timeout=10.0)
+        got["ok"] = True
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    _wait_until(lambda: "b" in mgr.blocked_queries(), what="b blocked")
+    assert mgr.snapshot()["blocked"] == {"b": 50}
+    mgr.free_query("a")
+    t.join(5)
+    assert got.get("ok") and "b" not in mgr.blocked_queries()
+
+
+# --- killer policies -----------------------------------------------------
+
+
+def _node(node_id, blocked, by_query):
+    return {
+        "nodeId": node_id,
+        "blocked": dict(blocked),
+        "pools": {
+            "general": {
+                "size": 1000,
+                "reserved": sum(by_query.values()),
+                "free": 1000 - sum(by_query.values()),
+                "byQuery": dict(by_query),
+            }
+        },
+    }
+
+
+def test_killer_policy_selection():
+    nodes = [
+        _node("w1", {"q_small": 10}, {"q_small": 10, "q_big": 40}),
+        _node("w2", {}, {"q_huge": 900}),
+    ]
+    blocked = create_killer("total-reservation-on-blocked-nodes")
+    # q_huge reserves the most cluster-wide, but w2 is not blocked: the
+    # blocked-nodes policy picks the biggest query ON THE BLOCKED NODE
+    assert blocked.choose_victim(nodes) == "q_big"
+    assert create_killer("total-reservation").choose_victim(nodes) == "q_huge"
+    assert create_killer("none").choose_victim(nodes) is None
+    # the running allowlist keeps finished queries out of the verdict
+    assert blocked.choose_victim(nodes, running=["q_small"]) == "q_small"
+    assert blocked.choose_victim([]) is None
+    with pytest.raises(ValueError):
+        create_killer("bogus-policy")
+
+
+def test_cluster_total_memory_limit_enforced():
+    cm = ClusterMemoryManager(kill_grace_s=0.0)
+    cm.update_node("w1", _node("w1", {}, {"qa": 60, "qb": 20}))
+    cm.update_node("w2", _node("w2", {}, {"qa": 50}))
+    assert cm.query_totals() == {"qa": 110, "qb": 20}
+    kills = []
+    killed = cm.process(
+        lambda qid, reason: kills.append((qid, reason)), total_limit=100
+    )
+    assert killed == ["qa"]
+    assert "distributed total memory limit" in kills[0][1]
+    assert "110" in kills[0][1]
+    assert cm.info()["kills"][0]["queryId"] == "qa"
+
+
+def test_cluster_killer_waits_for_grace_then_kills():
+    patient = ClusterMemoryManager(kill_grace_s=30.0)
+    patient.update_node("w1", _node("w1", {"qb": 10}, {"qa": 90, "qb": 10}))
+    assert patient.process(lambda q, r: None) == []  # inside the grace
+    cm = ClusterMemoryManager(kill_grace_s=0.0)
+    cm.update_node("w1", _node("w1", {"qb": 10}, {"qa": 90, "qb": 10}))
+    kills = []
+    killed = cm.process(lambda qid, reason: kills.append((qid, reason)))
+    assert killed == ["qa"]
+    assert kills[0][1] == CLUSTER_OOM_MESSAGE
+    assert cm.info()["kills"][0]["policy"] == (
+        "total-reservation-on-blocked-nodes"
+    )
+
+
+def test_cluster_kill_cb_failure_is_skipped():
+    """A victim whose kill callback raises (query already finished) is
+    not recorded as killed — the next pass picks a fresh victim."""
+    cm = ClusterMemoryManager(kill_grace_s=0.0)
+    cm.update_node("w1", _node("w1", {"qb": 10}, {"qa": 90, "qb": 10}))
+
+    def kill_cb(qid, reason):
+        raise RuntimeError("already done")
+
+    assert cm.process(kill_cb) == []
+    assert cm.kills == []
+
+
+# --- admission control ---------------------------------------------------
+
+
+def test_admission_second_query_queues_then_runs():
+    """Acceptance scenario 1: combined reservation exceeds the budget —
+    the second query queues and is admitted after the first releases."""
+    ctrl = MemoryAdmissionController(lambda: 100)
+    events = []
+    ctrl.acquire("q1", 80)
+    admitted = threading.Event()
+
+    def second():
+        ctrl.acquire(
+            "q2", 50, timeout_s=10.0,
+            on_queue=lambda: events.append("queued"),
+        )
+        events.append("admitted")
+        admitted.set()
+
+    t = threading.Thread(target=second)
+    t.start()
+    _wait_until(lambda: events == ["queued"], what="q2 queued")
+    time.sleep(0.15)  # q2 must STAY queued while q1 holds the budget
+    assert not admitted.is_set()
+    assert ctrl.stats()["waiting"] == {"q2": 50}
+    ctrl.release("q1")
+    assert admitted.wait(5.0)
+    assert events == ["queued", "admitted"]
+    assert ctrl.stats()["admitted"] == {"q2": 50}
+    assert ctrl.stats()["queuedTotal"] == 1
+    ctrl.release("q2")
+
+
+def test_admission_fifo_no_queue_jumping():
+    ctrl = MemoryAdmissionController(lambda: 100)
+    ctrl.acquire("q1", 80)
+    order = []
+
+    def waiter(qid, bytes_):
+        def go():
+            ctrl.acquire(qid, bytes_, timeout_s=10.0)
+            order.append(qid)
+        return go
+
+    t2 = threading.Thread(target=waiter("q2", 50))
+    t2.start()
+    _wait_until(lambda: "q2" in ctrl.stats()["waiting"], what="q2 waiting")
+    # q3 would fit beside q1 (80+10 <= 100) but must not jump q2
+    t3 = threading.Thread(target=waiter("q3", 10))
+    t3.start()
+    _wait_until(lambda: "q3" in ctrl.stats()["waiting"], what="q3 waiting")
+    time.sleep(0.15)
+    assert order == []  # q3 did NOT jump the queue while q1 held it
+    ctrl.release("q1")
+    t2.join(5)
+    t3.join(5)
+    assert set(order) == {"q2", "q3"}
+    assert ctrl.stats()["admitted"] == {"q2": 50, "q3": 10}
+
+
+def test_admission_oversized_query_admitted_alone():
+    ctrl = MemoryAdmissionController(lambda: 100)
+    ctrl.acquire("huge", 500)  # larger than the budget, but running alone
+    ctrl.release("huge")
+
+
+def test_admission_timeout_is_a_clean_error():
+    ctrl = MemoryAdmissionController(lambda: 100)
+    ctrl.acquire("q1", 90)
+    with pytest.raises(ExceededMemoryLimitError, match="admission queue"):
+        ctrl.acquire("q2", 50, timeout_s=0.2)
+    assert ctrl.stats()["waiting"] == {}  # failed waiter left the queue
+    ctrl.release("q1")
+
+
+# --- resource-group soft memory limit ------------------------------------
+
+
+def test_resource_group_soft_memory_limit_gates_queue():
+    g = InternalResourceGroup("g", soft_memory_limit_bytes=100)
+    started = []
+    assert g.submit(lambda: started.append("a")) == "running"
+    g.add_memory_usage(120)  # at/over the soft limit
+    assert g.submit(lambda: started.append("b")) == "queued"
+    assert started == ["a"]
+    g.add_memory_usage(-120)  # dropping below the limit admits the queue
+    assert started == ["a", "b"]
+    assert g.stats()["memoryUsageBytes"] == 0
+
+
+# --- fault injection: the `oom` site -------------------------------------
+
+
+def test_forced_oom_revokes_then_fails_cleanly():
+    inj = FaultInjector({"oom": {"nth": 1}})
+    mgr = LocalMemoryManager(1000, fault_injector=inj)
+    revoked = []
+    mgr.register_revocable("other", 100, lambda: revoked.append(1) and 0)
+    with pytest.raises(ExceededMemoryLimitError) as ei:
+        mgr.reserve("q1", 10, timeout=0)
+    assert not isinstance(ei.value, QueryKilledError)
+    assert "cannot reserve 10 bytes" in str(ei.value)
+    assert revoked, "revocation (spill) must be attempted before failing"
+    mgr.reserve("q1", 10)  # rule exhausted: the manager is not wedged
+    assert mgr.snapshot()["pools"]["general"]["byQuery"] == {"q1": 10}
+
+
+def test_forced_oom_blocks_node_then_policy_kill_wakes_it():
+    """Chaos-to-killer handshake: the injected oom blocks the query, the
+    node's snapshot reports it, the killer policy picks it, and the kill
+    wakes the blocked reservation with QueryKilledError."""
+    inj = FaultInjector({"oom": {"nth": 2}})
+    mgr = LocalMemoryManager(1000, node_id="w1", fault_injector=inj)
+    mgr.reserve("q_big", 600)  # call 1: clean
+    err = {}
+
+    def blocked():
+        try:
+            mgr.reserve("q_big", 100, timeout=15.0)  # call 2: forced oom
+        except Exception as e:  # noqa: BLE001
+            err["e"] = e
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    _wait_until(lambda: "q_big" in mgr.blocked_queries(), what="blocked")
+    cm = ClusterMemoryManager(kill_grace_s=0.0)
+    cm.update_node("w1", mgr.snapshot())
+    killed = cm.process(
+        lambda qid, reason: mgr.kill(qid, reason), running=["q_big"]
+    )
+    assert killed == ["q_big"]
+    t.join(5)
+    assert isinstance(err.get("e"), QueryKilledError)
+    assert CLUSTER_OOM_MESSAGE in str(err["e"])
+
+
+# --- session-level behavior ----------------------------------------------
+
+
+def test_session_query_drains_pools():
+    s = tpch_session(0.01)
+    s.execute("select sum(l_extendedprice) from lineitem")
+    snap = s.memory_manager.snapshot()
+    assert all(p["reserved"] == 0 for p in snap["pools"].values())
+    assert snap["blocked"] == {}
+
+
+def test_seeded_oom_chaos_ends_in_clean_error_not_a_crash():
+    """Acceptance scenario (local form): a seeded oom at reservation time
+    surfaces as an ExceededMemoryLimitException-style error, and the
+    engine keeps serving queries afterwards."""
+    spec = json.dumps({"seed": 7, "oom": {"p": 1.0, "times": 1}})
+    s = tpch_session(0.01, fault_injection=spec)
+    with pytest.raises(ExceededMemoryLimitError) as ei:
+        s.execute("select sum(l_extendedprice) from lineitem")
+    assert "memory limit" in str(ei.value)
+    assert not isinstance(ei.value, QueryKilledError)
+    # not wedged: the very next query on the same session succeeds
+    page = s.execute("select count(*) from lineitem")
+    assert page.to_pylist()[0][0] > 0
+
+
+def test_device_pressure_spills_to_streaming_not_a_crash():
+    """A query whose working set exceeds the HBM budget runs through the
+    tiled streaming path (bounded device working set) instead of
+    kernel-faulting — and produces the same result."""
+    sql = "select sum(l_quantity) from lineitem"
+    s = tpch_session(0.01)
+    baseline = s.execute(sql).to_pylist()
+    s2 = tpch_session(0.01)
+    s2.memory_manager.device.size = 1 << 10  # 1 KiB of "HBM"
+    assert s2.execute(sql).to_pylist() == baseline
+
+
+def test_system_runtime_memory_table():
+    s = tpch_session(0.01)
+    rows = s.execute(
+        "select node_id, pool, size_bytes, reserved_bytes, free_bytes "
+        "from system.runtime.memory order by pool"
+    ).to_pylist()
+    assert [r[1] for r in rows] == ["device", "general", "reserved"]
+    for node_id, _pool, size, reserved, free in rows:
+        assert node_id == "session"
+        assert size > 0 and reserved >= 0 and free == size - reserved
+
+
+def test_memory_metrics_registered():
+    from trino_tpu.utils.metrics import REGISTRY
+
+    s = tpch_session(0.01)
+    s.execute("select count(*) from lineitem")
+    text = REGISTRY.render_prometheus()
+    assert "trino_tpu_memory_pool_size_bytes" in text
+    assert "trino_tpu_memory_pool_reserved_bytes" in text
+
+
+# --- distributed acceptance: the low-memory killer end to end ------------
+
+
+def test_cluster_low_memory_killer_end_to_end():
+    """Acceptance scenario 2: fault_injection forces an `oom` on a worker
+    mid-query; the node reports blocked via its heartbeat, the
+    coordinator's enforcement loop runs the policy, kills exactly the
+    selected query with the structured cluster-OOM error — and another
+    query on the same cluster finishes normally."""
+    spec = json.dumps({"seed": 11, "oom": {"nth": 2}})
+    with DistributedQueryRunner(
+        workers=1,
+        catalogs=(("tpch", "tpch", {"tpch.scale-factor": 0.01}),),
+        properties={
+            "fault_injection": spec,
+            # generous: the blocked reservation must out-wait any
+            # load-induced stall in the heartbeat/enforcement pipeline so
+            # the KILLER resolves it, never the reserve timeout (whose
+            # fallback path would mask a broken killer here)
+            "memory_blocked_timeout_s": 120.0,
+        },
+    ) as runner:
+        co = runner.coordinator.coordinator
+        worker = runner.workers[0]
+        # query A: the big scan — its host reservation (reserve call 1)
+        # lands, its HBM reservation (call 2) hits the forced oom and
+        # blocks, so A is the largest reserver on the blocked node
+        qa = co.submit(
+            "select sum(l_extendedprice * l_discount) from lineitem"
+        )
+        _wait_until(
+            lambda: qa.query_id in worker.memory_manager.blocked_queries()
+            or qa.state == "FAILED",
+            timeout=60.0, what="query A blocked on the worker",
+        )
+        # query B: smaller scan, same worker — must finish while A is
+        # blocked and the killer deliberates
+        assert runner.rows("select count(*) from orders") == [(15000,)]
+        _wait_until(
+            lambda: qa.state == "FAILED", timeout=60.0,
+            what="killer verdict on query A",
+        )
+        assert qa.error == CLUSTER_OOM_MESSAGE
+        kills = co.cluster_memory.kills
+        assert [k["queryId"] for k in kills] == [qa.query_id]
+        assert kills[0]["policy"] == "total-reservation-on-blocked-nodes"
+        # the blocked reservation woke up and the node drained
+        _wait_until(
+            lambda: worker.memory_manager.blocked_queries() == {},
+            timeout=30.0, what="worker unblocked after the kill",
+        )
+        _wait_until(
+            lambda: all(
+                p["reserved"] == 0
+                for p in worker.memory_manager.snapshot()["pools"].values()
+            ),
+            timeout=30.0, what="worker pools drained",
+        )
+        # the memory surfaces agree on what happened
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"{runner.coordinator.uri}/v1/memory", timeout=5.0
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["killerPolicy"] == "total-reservation-on-blocked-nodes"
+        assert [k["queryId"] for k in doc["kills"]] == [qa.query_id]
+        assert "localManager" in doc and "admission" in doc
+        with urllib.request.urlopen(
+            f"{worker.uri}/v1/memory", timeout=5.0
+        ) as resp:
+            wdoc = json.loads(resp.read())
+        assert set(wdoc["pools"]) == {"general", "reserved", "device"}

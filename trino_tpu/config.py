@@ -68,6 +68,30 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 8 << 30,
         ),
         PropertyMetadata(
+            "query_max_total_memory_bytes",
+            "per-query reservation limit summed across every node and "
+            "pool (query.max-total-memory analog; 0 = unlimited)",
+            int, 0,
+        ),
+        PropertyMetadata(
+            "low_memory_killer_policy",
+            "victim selection when a node is blocked on memory: none | "
+            "total-reservation | total-reservation-on-blocked-nodes",
+            str, "total-reservation-on-blocked-nodes",
+        ),
+        PropertyMetadata(
+            "memory_admission_timeout_s",
+            "seconds a query may wait in the memory admission queue "
+            "before failing with an exceeded-memory error",
+            float, 60.0,
+        ),
+        PropertyMetadata(
+            "memory_blocked_timeout_s",
+            "seconds a blocked memory reservation waits for frees, "
+            "revocation, or a killer verdict before raising",
+            float, 0.0,
+        ),
+        PropertyMetadata(
             "distributed",
             "execute over the full device mesh instead of one device",
             _bool, False,

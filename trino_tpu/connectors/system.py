@@ -76,6 +76,19 @@ SCHEMAS: Dict[str, List] = {
         ("heals", T.BIGINT),
         ("invalidations", T.BIGINT),
     ],
+    # one row per (node, pool): the cluster memory view — the session's
+    # LocalMemoryManager plus every heartbeat-announced worker snapshot
+    # held by the coordinator ClusterMemoryManager (MemoryPool MBeans /
+    # the reference's memory UI surface)
+    "memory": [
+        ("node_id", T.VARCHAR),
+        ("pool", T.VARCHAR),
+        ("size_bytes", T.BIGINT),
+        ("reserved_bytes", T.BIGINT),
+        ("free_bytes", T.BIGINT),
+        ("queries", T.BIGINT),
+        ("blocked_queries", T.BIGINT),
+    ],
     # one row per metric series from the process-global MetricsRegistry —
     # the plugin/trino-jmx "metrics as SQL" surface; histograms expose
     # interpolated p50/p95/p99 alongside the observation count
@@ -190,6 +203,30 @@ class _SystemSource:
                 c: [r.get(c) for r in stats]
                 for c, _t in SCHEMAS["caches"]
             }
+        if table == "memory":
+            snaps = []
+            mm = getattr(s, "memory_manager", None)
+            if mm is not None:
+                snaps.append(mm.snapshot())
+            cm = getattr(s, "cluster_memory", None)
+            if cm is not None:
+                local_id = snaps[0]["nodeId"] if snaps else None
+                snaps.extend(
+                    n for n in cm.nodes_view()
+                    if n.get("nodeId") != local_id
+                )
+            out = {c: [] for c, _t in SCHEMAS["memory"]}
+            for snap in snaps:
+                blocked = len(snap.get("blocked") or {})
+                for pool, p in (snap.get("pools") or {}).items():
+                    out["node_id"].append(snap.get("nodeId", "local"))
+                    out["pool"].append(pool)
+                    out["size_bytes"].append(int(p.get("size", 0)))
+                    out["reserved_bytes"].append(int(p.get("reserved", 0)))
+                    out["free_bytes"].append(int(p.get("free", 0)))
+                    out["queries"].append(len(p.get("byQuery") or {}))
+                    out["blocked_queries"].append(blocked)
+            return out
         if table == "metrics":
             from ..utils.metrics import REGISTRY
 

@@ -51,6 +51,16 @@ class FragmentExecutor(LocalExecutor):
         super().__init__(catalogs, config)
         self.splits_by_scan = splits_by_scan
         self.remote_pages = remote_pages
+        # exchange buffers held for the whole execution (the fetched
+        # pages stay referenced beside their merged copies), so they
+        # count toward this task's host reservation in _account_memory
+        self.exchange_bytes = sum(
+            int(getattr(c.values, "nbytes", 0))
+            + int(getattr(c.validity, "nbytes", 0) or 0)
+            for pages in (remote_pages or {}).values()
+            for p in pages
+            for c in p.columns
+        )
         # {(scan_preorder_index, symbol): [Domain]} from exec/dynamic_filter
         self.dynamic_filters = dynamic_filters or {}
         self.df_rows_pruned = 0

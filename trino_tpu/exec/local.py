@@ -86,6 +86,16 @@ class DeviceScanCache:
         self.bytes += nbytes
         self.puts += 1
 
+    def drop_all(self) -> int:
+        """Evict everything; returns bytes freed.  Registered with the
+        LocalMemoryManager as a revocable resource — warm-HBM cache is
+        the first thing to go under memory pressure."""
+        freed = self.bytes
+        self.evictions += len(self.entries)
+        self.entries.clear()
+        self.bytes = 0
+        return freed
+
     def stats(self) -> Dict[str, int]:
         return {
             "name": "scan_cache",
@@ -343,6 +353,37 @@ class LocalExecutor:
             self._load_scans(plan, scans, dicts, counts)
         self._account_memory(scans, limit)
         pool = self.config.get("memory_pool")
+        manager = self.config.get("memory_manager")
+        self.device_bytes = 0
+        if manager is not None:
+            # HBM tier: every kernel is static-shape, so the device
+            # working set (padded batches + compiled program) is known
+            # before dispatch; a query that would blow HBM is blocked,
+            # spilled via revocation, or failed cleanly here instead of
+            # kernel-faulting the backend
+            from ..memory import QueryKilledError
+            from ..utils.memory import ExceededMemoryLimitError
+            from .streaming import estimate_program_bytes
+
+            est = int(max(self.scan_bytes,
+                          estimate_program_bytes(self, plan)))
+            try:
+                manager.reserve(
+                    self.query_id, est, tier="device",
+                    timeout=float(
+                        self.config.get("memory_blocked_timeout_s") or 0.0
+                    ),
+                )
+                self.device_bytes = est
+            except ExceededMemoryLimitError as exc:
+                manager.free(self.query_id, self.scan_bytes, tier="host")
+                self.scan_bytes = 0
+                if isinstance(exc, QueryKilledError):
+                    raise
+                out = self._try_forced_streaming(plan)
+                if out is not None:
+                    return out
+                raise
         try:
             self.dicts = dicts
             self.group_capacity = int(
@@ -609,7 +650,13 @@ class LocalExecutor:
             self._finalize_kernel_profile(scans, counts, host_lanes, sel_np)
             return self._materialize_host(plan, host_lanes, sel_np)
         finally:
-            if pool is not None:
+            if manager is not None:
+                manager.free(self.query_id, self.scan_bytes, tier="host")
+                if self.device_bytes:
+                    manager.free(
+                        self.query_id, self.device_bytes, tier="device"
+                    )
+            elif pool is not None:
                 pool.free(self.query_id, self.scan_bytes)
 
     # ------------------------------------------------------------------
@@ -715,16 +762,36 @@ class LocalExecutor:
         proportional and covered by the limit's headroom."""
         from ..utils.memory import ExceededMemoryLimitError
 
-        total = 0
+        scan_total = 0
         for arrays in scans.values():
             for v, ok in arrays.values():
-                total += int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
+                scan_total += (
+                    int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
+                )
+        # fragment tasks also hold the raw exchange pages they fetched —
+        # counted toward the node's host reservation below, but NOT
+        # against the spillability limit: that limit gates the device
+        # working set, and exchange buffers stay in host RAM (a streaming
+        # sub-fragment legitimately holds pages + merged copies past it)
+        total = scan_total + int(getattr(self, "exchange_bytes", 0))
         self.scan_bytes = total
-        if limit and total > int(limit):
+        if limit and scan_total > int(limit):
             raise ExceededMemoryLimitError(
-                f"query exceeded memory limit: scan working set {total} "
-                f"> {limit} bytes (and plan is not spillable)"
+                f"query exceeded memory limit: scan working set "
+                f"{scan_total} > {limit} bytes (and plan is not spillable)"
             )
+        manager = self.config.get("memory_manager")
+        if manager is not None:
+            # revoke -> block -> clean-error semantics (and the seeded
+            # `oom` fault site) live in the manager; freed after
+            # materialize alongside the device-tier reservation
+            manager.reserve(
+                self.query_id, total, tier="host",
+                timeout=float(
+                    self.config.get("memory_blocked_timeout_s") or 0.0
+                ),
+            )
+            return
         pool = self.config.get("memory_pool")
         if pool is not None:
             pool.reserve(self.query_id, total)  # freed after materialize

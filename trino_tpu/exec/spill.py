@@ -257,6 +257,7 @@ def _spill_ctx(executor):
     cfg = dict(executor.config)
     cfg.pop("memory_limit_bytes", None)
     cfg.pop("memory_pool", None)
+    cfg.pop("memory_manager", None)
     orig_remote = dict(getattr(executor, "remote_pages", {}) or {})
     dyn = getattr(executor, "dynamic_filters", None)
     return cfg, orig_remote, dyn

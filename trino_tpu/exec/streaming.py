@@ -221,6 +221,7 @@ def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Pag
         # own _account_memory raises loudly instead of silently running
         # unbounded.
         cfg.pop("memory_pool", None)
+        cfg.pop("memory_manager", None)
         cfg["spill_enabled"] = False
         cfg["scan_cache"] = None
         return cfg

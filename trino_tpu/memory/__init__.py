@@ -1,0 +1,43 @@
+"""Cluster memory management subsystem.
+
+Layers (reference parity: the memory/ package around
+ClusterMemoryManager.java:91):
+
+- pools.LocalMemoryManager — per-node general/reserved host pools plus
+  a device (HBM) tier; revoke -> block -> clean-error reservations
+- cluster.ClusterMemoryManager — coordinator view fed by heartbeat
+  snapshots; enforces query_max_total_memory_bytes and runs the killer
+- killer.LowMemoryKiller — pluggable victim-selection policies
+- admission.MemoryAdmissionController — FIFO gate that queues queries
+  until their estimated peak fits
+"""
+from .admission import MemoryAdmissionController
+from .cluster import CLUSTER_OOM_MESSAGE, ClusterMemoryManager
+from .killer import (
+    LowMemoryKiller,
+    TotalReservationLowMemoryKiller,
+    TotalReservationOnBlockedNodesLowMemoryKiller,
+    create_killer,
+)
+from .pools import (
+    DEVICE_POOL,
+    GENERAL_POOL,
+    RESERVED_POOL,
+    LocalMemoryManager,
+    QueryKilledError,
+)
+
+__all__ = [
+    "CLUSTER_OOM_MESSAGE",
+    "ClusterMemoryManager",
+    "DEVICE_POOL",
+    "GENERAL_POOL",
+    "LocalMemoryManager",
+    "LowMemoryKiller",
+    "MemoryAdmissionController",
+    "QueryKilledError",
+    "RESERVED_POOL",
+    "TotalReservationLowMemoryKiller",
+    "TotalReservationOnBlockedNodesLowMemoryKiller",
+    "create_killer",
+]

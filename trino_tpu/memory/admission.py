@@ -1,0 +1,115 @@
+"""Memory admission control: queue queries until their peak fits.
+
+Reference parity: the resource-group softMemoryLimit gate in
+execution/resourcegroups/InternalResourceGroup.java — a query is not
+started while the cluster is over its memory budget.  Here the gate is
+byte-precise: each query declares its estimated peak
+(estimate_program_bytes from exec/streaming.py) and waits FIFO until
+admitted reservations leave room.  A query larger than the whole budget
+is still admitted when it would run alone — the limit protects
+concurrency, oversized singletons are the LocalMemoryManager's problem.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, Optional
+
+from ..utils.memory import ExceededMemoryLimitError
+from ..utils.metrics import REGISTRY
+
+
+class MemoryAdmissionController:
+    """FIFO byte-budget gate in front of query execution."""
+
+    def __init__(self, capacity_fn: Callable[[], int],
+                 timeout_s: float = 60.0):
+        self.capacity_fn = capacity_fn
+        self.timeout_s = timeout_s
+        self._cond = threading.Condition()
+        self._admitted: Dict[str, int] = {}
+        # insertion order == queue order (FIFO fairness: only the head
+        # of the wait queue may admit, so big queries are not starved)
+        self._waiting: "OrderedDict[str, int]" = OrderedDict()
+        self.queued_total = 0
+
+    def _fits_locked(self, query_id: str, bytes_: int) -> bool:
+        if not self._admitted:
+            return True
+        head = next(iter(self._waiting), query_id)
+        if head != query_id:
+            return False
+        capacity = max(int(self.capacity_fn()), 0)
+        return sum(self._admitted.values()) + bytes_ <= capacity
+
+    def acquire(
+        self,
+        query_id: str,
+        bytes_: int,
+        timeout_s: Optional[float] = None,
+        on_queue: Optional[Callable[[], None]] = None,
+    ):
+        """Block until the estimated peak fits; then admit the query.
+
+        Raises ExceededMemoryLimitError on timeout so the caller can
+        fail the query with a clean admission error."""
+        bytes_ = max(int(bytes_), 0)
+        timeout_s = self.timeout_s if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout_s
+        notified = False
+        with self._cond:
+            self._waiting[query_id] = bytes_
+            try:
+                while not self._fits_locked(query_id, bytes_):
+                    if not notified:
+                        notified = True
+                        self.queued_total += 1
+                        REGISTRY.counter(
+                            "trino_tpu_memory_admission_queued_total",
+                            "Queries queued by memory admission control",
+                        ).inc()
+                        if on_queue is not None:
+                            on_queue()
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise ExceededMemoryLimitError(
+                            f"Query {query_id} timed out in the memory "
+                            f"admission queue: estimated peak {bytes_} "
+                            f"bytes does not fit the cluster budget of "
+                            f"{int(self.capacity_fn())} bytes"
+                        )
+                    self._cond.wait(min(remaining, 0.05))
+                self._admitted[query_id] = bytes_
+            finally:
+                self._waiting.pop(query_id, None)
+                self._cond.notify_all()
+        self._update_gauge()
+
+    def release(self, query_id: str):
+        with self._cond:
+            self._admitted.pop(query_id, None)
+            self._cond.notify_all()
+        self._update_gauge()
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                "admitted": dict(self._admitted),
+                "waiting": dict(self._waiting),
+                "queuedTotal": self.queued_total,
+                "capacity": int(self.capacity_fn()),
+            }
+
+    def _update_gauge(self):
+        with self._cond:
+            admitted = sum(self._admitted.values())
+            waiting = sum(self._waiting.values())
+        REGISTRY.gauge(
+            "trino_tpu_memory_admission_reserved_bytes",
+            "Estimated peak bytes of currently admitted queries",
+        ).set(admitted)
+        REGISTRY.gauge(
+            "trino_tpu_memory_admission_waiting_bytes",
+            "Estimated peak bytes of queries waiting for admission",
+        ).set(waiting)

@@ -21,15 +21,18 @@ import secrets
 import threading
 import time
 import traceback
+import urllib.request
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ..memory import ClusterMemoryManager, MemoryAdmissionController, create_killer
 from ..page import Page
 from ..session import Session
 from ..sql import ast
 from ..sql.parser import parse
+from ..utils.memory import ExceededMemoryLimitError
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from . import protocol
@@ -92,6 +95,94 @@ class Coordinator:
             if distributed
             else None
         )
+        # cluster memory view + OOM arbitration (ClusterMemoryManager
+        # analog), fed by announcement-piggybacked pool snapshots and
+        # the coordinator-local session manager
+        self.cluster_memory = ClusterMemoryManager(
+            killer=create_killer(
+                session.properties.get("low_memory_killer_policy")
+            )
+        )
+        session.cluster_memory = self.cluster_memory
+        # memory admission gate (resource-group softMemoryLimit role):
+        # queries wait in QUEUED until their estimated peak fits
+        self.admission = MemoryAdmissionController(self._memory_capacity)
+        self._stop_enforcement = threading.Event()
+        if distributed:
+            threading.Thread(
+                target=self._enforcement_loop, daemon=True
+            ).start()
+
+    def _memory_capacity(self) -> int:
+        """Admission budget: announced host pools, or the coordinator's
+        own manager when no worker has announced yet."""
+        total = 0
+        for node in self.cluster_memory.nodes_view():
+            pools = node.get("pools") or {}
+            for name in ("general", "reserved"):
+                total += int((pools.get(name) or {}).get("size", 0))
+        if total:
+            return total
+        mm = self.session.memory_manager
+        return mm.general.size + mm.reserved.size
+
+    def _enforcement_loop(self):
+        while not self._stop_enforcement.wait(0.1):
+            try:
+                self.check_cluster_memory()
+            except Exception:
+                pass
+
+    def check_cluster_memory(self):
+        """One enforcement pass: refresh the cluster view from the
+        latest heartbeats, then enforce query_max_total_memory_bytes and
+        the low-memory killer.  Returns the query ids killed."""
+        cm = self.cluster_memory
+        if self.node_manager is not None:
+            for n in self.node_manager.all_nodes():
+                if n.memory:
+                    cm.update_node(n.node_id, n.memory)
+        cm.update_node(
+            self.node_id, self.session.memory_manager.snapshot()
+        )
+        running = [
+            q.query_id for q in self.queries.values()
+            if q.state in ("QUEUED", "PLANNING", "RUNNING")
+        ]
+        limit = int(
+            self.session.properties.get("query_max_total_memory_bytes")
+            or 0
+        )
+        return cm.process(
+            self.kill_query, total_limit=limit or None, running=running
+        )
+
+    def kill_query(self, query_id: str, reason: str):
+        """Fail a query with a structured OOM reason and wake any of its
+        blocked reservations on every node (killer verdict fan-out)."""
+        q = self.queries.get(query_id)
+        if q is None:
+            raise KeyError(query_id)
+        with q.lock:
+            if q.state in ("FINISHED", "FAILED"):
+                raise RuntimeError(f"query {query_id} already done")
+            q.error = reason
+            q.state = "FAILED"
+            q.finished = time.time()
+        self.session.memory_manager.kill(query_id, reason)
+        if self.node_manager is not None:
+            for _node_id, uri in self.node_manager.alive():
+                try:
+                    req = urllib.request.Request(
+                        f"{uri}/v1/memory/kill",
+                        data=json.dumps({
+                            "queryId": query_id, "reason": reason,
+                        }).encode(),
+                        headers={"Content-Type": "application/json"},
+                    )
+                    urllib.request.urlopen(req, timeout=2.0).read()
+                except Exception:
+                    pass
 
     # -- lifecycle ------------------------------------------------------
     def submit(self, sql: str, user: str = "user",
@@ -113,14 +204,52 @@ class Coordinator:
                 q.group = None
         return q
 
+    def _estimated_peak_bytes(self, sql: str) -> int:
+        """Static estimate of a query's peak reservation for admission
+        (estimate_program_bytes over the optimized plan); 0 for utility
+        statements and anything that fails to plan — those carry no scan
+        working set and must not wait behind the gate."""
+        try:
+            stmt = parse(sql)
+            if not isinstance(stmt, ast.Query):
+                return 0
+            plan = self.session._plan_stmt(stmt)
+            from ..exec.streaming import estimate_program_bytes
+
+            ex = self.session._executor()
+            return int(estimate_program_bytes(ex, plan))
+        except Exception:
+            return 0
+
     def _run(self, q: QueryExecution):
         with q.lock:
             if q.state == "FAILED":  # cancelled while queued
                 return
-            q.state = "PLANNING"
+        admitted = False
         try:
+            est = self._estimated_peak_bytes(q.sql)
+            q.estimated_memory_bytes = est
+            if est > 0:
+                # stays QUEUED while waiting for memory headroom
+                self.admission.acquire(
+                    q.query_id, est,
+                    timeout_s=float(
+                        self.session.properties.get(
+                            "memory_admission_timeout_s"
+                        ) or 60.0
+                    ),
+                )
+                admitted = True
+                if q.group is not None:
+                    q.group.add_memory_usage(est)
+            with q.lock:
+                if q.state == "FAILED":  # cancelled/killed while queued
+                    return
+                q.state = "PLANNING"
             page = self._execute(q)
             with q.lock:
+                if q.state == "FAILED":  # killed mid-flight (OOM killer)
+                    return
                 q.page = page
                 q.types = [c.type for c in page.columns]
                 q.state = "FINISHED"
@@ -130,13 +259,23 @@ class Coordinator:
             ).inc()
         except Exception as e:  # surfaced via the protocol error field
             with q.lock:
-                q.error = f"{type(e).__name__}: {e}"
+                if q.error is None:  # keep a killer's structured reason
+                    q.error = f"{type(e).__name__}: {e}"
                 q.state = "FAILED"
                 q.finished = time.time()
             REGISTRY.counter(
                 "trino_tpu_query_failed_total", "Queries that reached FAILED"
             ).inc()
         finally:
+            if admitted:
+                self.admission.release(q.query_id)
+                if q.group is not None:
+                    q.group.add_memory_usage(
+                        -getattr(q, "estimated_memory_bytes", 0)
+                    )
+            # drop any leftover local reservations/kill marks (worker
+            # managers clean up in their executors' finally blocks)
+            self.session.memory_manager.free_query(q.query_id)
             REGISTRY.histogram(
                 "trino_tpu_query_wall_seconds", "End-to-end query wall time"
             ).observe((q.finished or time.time()) - q.created)
@@ -213,6 +352,8 @@ class Coordinator:
                     "fte_speculation_min_s":
                         props.get("fte_speculation_min_s"),
                     "fault_injection": props.get("fault_injection"),
+                    "memory_blocked_timeout_s":
+                        props.get("memory_blocked_timeout_s"),
                     "exchange_retry_attempts":
                         props.get("exchange_retry_attempts"),
                     "exchange_retry_budget_s":
@@ -237,7 +378,8 @@ class Coordinator:
                             )
                         else:
                             sched = DistributedScheduler(
-                                self.session.catalogs, workers, task_props
+                                self.session.catalogs, workers, task_props,
+                                memory_view=self.cluster_memory,
                             )
                             page = sched.run(plan, q.query_id)
                             # per-task stats rollup (TaskStats -> QueryStats)
@@ -479,8 +621,12 @@ class _Handler(BaseHTTPRequestHandler):
             doc = json.loads(self.rfile.read(n))
             if self.coordinator.node_manager is not None:
                 self.coordinator.node_manager.announce(
-                    doc["nodeId"], doc["uri"]
+                    doc["nodeId"], doc["uri"], memory=doc.get("memory")
                 )
+                if doc.get("memory"):
+                    self.coordinator.cluster_memory.update_node(
+                        doc["nodeId"], doc["memory"]
+                    )
             self._json(202, {})
         else:
             self._json(404, {"error": "not found"})
@@ -553,6 +699,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "activeWorkers": len(nm.alive()) if nm is not None else 1,
                 "uptimeSeconds": time.time() - co.started,
             })
+            return
+        if self.path == "/v1/memory":
+            # cluster memory view (server/MemoryResource analog): pool
+            # snapshots per node, per-query totals, killer verdicts
+            doc = co.cluster_memory.info()
+            doc["localManager"] = co.session.memory_manager.snapshot()
+            doc["admission"] = co.admission.stats()
+            self._json(200, doc)
             return
         if self.path == "/v1/resourceGroupState":
             self._json(200, co.resource_groups.info())
@@ -677,6 +831,7 @@ class CoordinatorServer:
 
     def stop(self):
         self.httpd.shutdown()
+        self.coordinator._stop_enforcement.set()
         if self.coordinator.failure_detector is not None:
             self.coordinator.failure_detector.stop()
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 import time
 import urllib.request
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 ANNOUNCEMENT_TTL = 5.0
 NODE_EXPIRY = 30.0  # forget nodes silent this long (restart churn cleanup)
@@ -26,6 +26,9 @@ class NodeState:
         self.last_announced = time.time()
         self.failure_ratio = 0.0
         self.last_ping_ok = True
+        # latest pool snapshot piggybacked on the announcement (consumed
+        # by the coordinator-side ClusterMemoryManager)
+        self.memory: Optional[dict] = None
 
 
 class NodeManager:
@@ -35,7 +38,8 @@ class NodeManager:
         self.nodes: Dict[str, NodeState] = {}
         self.lock = threading.Lock()
 
-    def announce(self, node_id: str, uri: str):
+    def announce(self, node_id: str, uri: str,
+                 memory: Optional[dict] = None):
         with self.lock:
             n = self.nodes.get(node_id)
             if n is None:
@@ -43,6 +47,8 @@ class NodeManager:
                 self.nodes[node_id] = n
             n.uri = uri
             n.last_announced = time.time()
+            if memory is not None:
+                n.memory = memory
 
     def record_ping(self, node_id: str, ok: bool):
         with self.lock:

@@ -32,10 +32,16 @@ class InternalResourceGroup:
         hard_concurrency_limit: int = 100,
         max_queued: int = 1000,
         parent: Optional["InternalResourceGroup"] = None,
+        soft_memory_limit_bytes: int = 0,
     ):
         self.name = name
         self.hard_concurrency_limit = hard_concurrency_limit
         self.max_queued = max_queued
+        # softMemoryLimit analog: while the group's tracked usage is at
+        # or above this, new queries queue instead of starting
+        # (0 = unlimited)
+        self.soft_memory_limit_bytes = soft_memory_limit_bytes
+        self.memory_usage_bytes = 0
         self.parent = parent
         self.running = 0
         self.queue: deque = deque()  # callables to start queued queries
@@ -54,6 +60,11 @@ class InternalResourceGroup:
         g: Optional[InternalResourceGroup] = self
         while g is not None:
             if g.running >= g.hard_concurrency_limit:
+                return False
+            if (
+                g.soft_memory_limit_bytes
+                and g.memory_usage_bytes >= g.soft_memory_limit_bytes
+            ):
                 return False
             g = g.parent
         return True
@@ -109,6 +120,29 @@ class InternalResourceGroup:
         for start in to_start:
             start()
 
+    def add_memory_usage(self, delta: int):
+        """Track admitted-query memory against this group (and its
+        ancestors); a negative delta re-processes the queue, since a
+        group blocked on its soft memory limit may now admit."""
+        to_start: List[Callable[[], None]] = []
+        with self.lock:
+            g: Optional[InternalResourceGroup] = self
+            root = self
+            while g is not None:
+                g.memory_usage_bytes = max(0, g.memory_usage_bytes + delta)
+                root = g
+                g = g.parent
+            if delta < 0:
+                stack = [root]
+                while stack:
+                    g = stack.pop()
+                    while g.queue and g._can_run_locked():
+                        g._add_running_locked(1)
+                        to_start.append(g.queue.popleft())
+                    stack.extend(g.children)
+        for start in to_start:
+            start()
+
     def stats(self) -> dict:
         with self.lock:
             return {
@@ -117,6 +151,8 @@ class InternalResourceGroup:
                 "queued": len(self.queue),
                 "hardConcurrencyLimit": self.hard_concurrency_limit,
                 "maxQueued": self.max_queued,
+                "softMemoryLimitBytes": self.soft_memory_limit_bytes,
+                "memoryUsageBytes": self.memory_usage_bytes,
             }
 
 
@@ -146,6 +182,9 @@ class ResourceGroupManager:
             int(spec.get("hardConcurrencyLimit", 100)),
             int(spec.get("maxQueued", 1000)),
             parent,
+            soft_memory_limit_bytes=int(
+                spec.get("softMemoryLimitBytes", 0)
+            ),
         )
         self.groups[g.full_name] = g
         for sub in spec.get("subGroups", ()) or ():

@@ -109,12 +109,46 @@ class DistributedScheduler:
         catalogs: CatalogManager,
         workers: List[Tuple[str, str]],
         properties: Optional[dict] = None,
+        memory_view=None,
     ):
         if not workers:
             raise SchedulerError("no alive workers")
         self.catalogs = catalogs
         self.workers = workers
         self.properties = properties or {}
+        # optional ClusterMemoryManager: single-task placement prefers
+        # the node with the most free pool bytes and avoids blocked
+        # nodes (NodeScheduler memory-aware selection)
+        self.memory_view = memory_view
+
+    def _pick_single_worker(self, query_id: str) -> Tuple[str, str]:
+        fallback = self.workers[hash(query_id) % len(self.workers)]
+        if self.memory_view is None:
+            return fallback
+        try:
+            nodes = {
+                n.get("nodeId"): n
+                for n in self.memory_view.nodes_view()
+            }
+        except Exception:
+            return fallback
+
+        def headroom(w: Tuple[str, str]) -> int:
+            snap = nodes.get(w[0])
+            if not snap:
+                return -1  # no snapshot yet: only if nothing better
+            if snap.get("blocked"):
+                return -2  # a blocked node can't host new work
+            return sum(
+                int(p.get("free", 0))
+                for p in (snap.get("pools") or {}).values()
+            )
+
+        best = max(headroom(w) for w in self.workers)
+        if best < 0:
+            return fallback
+        candidates = [w for w in self.workers if headroom(w) == best]
+        return candidates[hash(query_id) % len(candidates)]
 
     # ------------------------------------------------------------------
     def run(self, plan: P.Output, query_id: Optional[str] = None) -> Page:
@@ -132,9 +166,8 @@ class DistributedScheduler:
         for f in fragments:
             if f.partitioning in (SOURCE, HASH, ARBITRARY):
                 placement[f.id] = list(self.workers)
-            else:  # SINGLE; spread roots of different queries via hash
-                w = self.workers[hash(query_id) % len(self.workers)]
-                placement[f.id] = [w]
+            else:  # SINGLE; memory-aware pick, hash spread as fallback
+                placement[f.id] = [self._pick_single_worker(query_id)]
             ntasks[f.id] = len(placement[f.id])
 
         # buffer counts: hash output -> one buffer per consumer task

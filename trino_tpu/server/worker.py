@@ -75,8 +75,9 @@ class TaskExecution:
 class TaskManager:
     """Executes tasks against this worker's catalogs (SqlTaskManager)."""
 
-    def __init__(self, catalogs: CatalogManager):
+    def __init__(self, catalogs: CatalogManager, memory_manager=None):
         self.catalogs = catalogs
+        self.memory_manager = memory_manager
         self.tasks: Dict[str, TaskExecution] = {}
         self.lock = threading.Lock()
         # worker-level injector: serves the /v1/task/{id}/fail endpoint's
@@ -192,6 +193,14 @@ class TaskManager:
                 from ..exec.dynamic_filter import collect_dynamic_filters
 
                 dfs = collect_dynamic_filters(plan, remote_pages)
+            if self.memory_manager is not None:
+                # node-level arbitration: fragments of every query on
+                # this worker reserve host + HBM bytes from one manager,
+                # tagged by query id (task ids are {query}.{frag}.{i})
+                config["memory_manager"] = self.memory_manager
+                config["query_id"] = t.task_id.rsplit(".", 2)[0]
+                if inj.enabled():
+                    self.memory_manager.fault_injector = inj
             ex = FragmentExecutor(
                 self.catalogs, config, splits_by_scan, remote_pages, dfs
             )
@@ -325,6 +334,16 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             t = tm.create_or_update(parts[2], doc)
             self._json(200, {"taskId": t.task_id, "state": t.state})
             return
+        if parts == ["v1", "memory", "kill"]:
+            # coordinator low-memory-killer verdict: wake this node's
+            # blocked reservations of the victim with QueryKilledError
+            n = int(self.headers.get("Content-Length", 0))
+            doc = json.loads(self.rfile.read(n) or b"{}")
+            self.worker.memory_manager.kill(
+                doc.get("queryId", ""), doc.get("reason", "killed")
+            )
+            self._json(200, {"killed": doc.get("queryId", "")})
+            return
         if (
             len(parts) == 4
             and parts[:2] == ["v1", "task"]
@@ -375,6 +394,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 "state": w.state,
                 "uptime": f"{time.time() - w.started:.0f}s",
             })
+            return
+        if self.path == "/v1/memory":
+            self._json(200, w.memory_manager.snapshot())
             return
         if self.path == "/v1/status":
             self._json(200, {
@@ -459,9 +481,25 @@ class WorkerServer:
         port: int = 0,
         announce_interval: float = 0.25,
         fault_injection=None,
+        memory_bytes: Optional[int] = None,
+        device_memory_bytes: Optional[int] = None,
     ):
+        from ..memory import LocalMemoryManager
+        from ..memory.pools import detect_device_bytes
+
         self.node_id = f"worker-{uuid.uuid4().hex[:8]}"
-        self.task_manager = TaskManager(catalogs)
+        self.memory_manager = LocalMemoryManager(
+            memory_bytes if memory_bytes is not None else (8 << 30),
+            device_bytes=(
+                device_memory_bytes
+                if device_memory_bytes is not None
+                else detect_device_bytes()
+            ),
+            node_id=self.node_id,
+        )
+        self.task_manager = TaskManager(
+            catalogs, memory_manager=self.memory_manager
+        )
         if fault_injection:
             # operator-configured chaos (heartbeat drops etc.) rides the
             # worker-level injector, alongside the /fail endpoint modes
@@ -521,7 +559,6 @@ class WorkerServer:
 
     # ------------------------------------------------------------------
     def _announce_loop(self):
-        body = json.dumps({"nodeId": self.node_id, "uri": self.uri}).encode()
         while not self._stop.is_set():
             if self.task_manager.fault_injector.fires(
                 "heartbeat", key=self.node_id
@@ -531,6 +568,14 @@ class WorkerServer:
                 self._stop.wait(self.announce_interval)
                 continue
             try:
+                # rebuilt every round: the announcement piggybacks this
+                # node's live pool snapshot for the coordinator-side
+                # ClusterMemoryManager (heartbeat memory view)
+                body = json.dumps({
+                    "nodeId": self.node_id,
+                    "uri": self.uri,
+                    "memory": self.memory_manager.snapshot(),
+                }).encode()
                 req = urllib.request.Request(
                     f"{self.coordinator_uri}/v1/announcement",
                     data=body,

@@ -62,9 +62,16 @@ class Session:
         self.properties = SessionProperties(config)
         self.metadata = Metadata(self.catalogs)
         self.events = EventListenerManager()
-        self.memory_pool = MemoryPool(
-            self.properties.get("query_max_memory_bytes")
+        # per-node memory arbitration (memory/ subsystem): the legacy
+        # session-level MemoryPool is absorbed as the manager's general
+        # pool, so existing reserve/free call sites keep working
+        from .memory import LocalMemoryManager
+
+        self.memory_manager = LocalMemoryManager(
+            self.properties.get("query_max_memory_bytes"),
+            node_id="session",
         )
+        self.memory_pool = self.memory_manager.general
         self.tracer = TRACER
         # PREPARE name FROM ... statements (QueryPreparer / prepared
         # statement store; the reference keeps these per client session)
@@ -86,6 +93,13 @@ class Session:
         from .exec.local import DeviceScanCache
 
         self._scan_cache = DeviceScanCache()
+        # under memory pressure the warm-HBM scan cache is revoked
+        # (spilled to nothing — it can always be re-uploaded) before any
+        # query is blocked or killed
+        self.memory_manager.register_revocable(
+            "scan-cache", self._scan_cache.max_bytes,
+            self._scan_cache.drop_all,
+        )
         # unified cache subsystem (cache/): session-scoped fragment result
         # cache + process-global compiled-fragment cache, with the scan
         # cache adopted for stats (system.runtime.caches, /v1/cache)
@@ -123,6 +137,7 @@ class Session:
         # SET SESSION query_max_memory_bytes resizes the pool for later
         # queries (the pool object is shared; only its budget moves)
         self.memory_pool.size = self.properties.get("query_max_memory_bytes")
+        self.memory_manager.fault_injector = self._fault_injector()
         exec_config = {
             "group_capacity": self.properties.get("group_capacity"),
             "memory_limit_bytes": self.properties.get(
@@ -130,6 +145,10 @@ class Session:
             ),
             "spill_enabled": self.properties.get("spill_enabled"),
             "memory_pool": self.memory_pool,
+            "memory_manager": self.memory_manager,
+            "memory_blocked_timeout_s": self.properties.get(
+                "memory_blocked_timeout_s"
+            ),
             "scan_cache": (
                 self._scan_cache
                 if self.properties.get("scan_cache_enabled") else None

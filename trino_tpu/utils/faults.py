@@ -36,6 +36,8 @@ SITES = (
     "task_stall",           # straggler injection (TASK_MANAGEMENT_TIMEOUT)
     "heartbeat",            # worker skips an announcement round
     "cache_read",           # corrupt a spilled result-cache frame on read
+    "oom",                  # memory reservation behaves as if the pool
+                            # were exhausted (LocalMemoryManager tier)
 )
 
 

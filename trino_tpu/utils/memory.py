@@ -39,6 +39,32 @@ class MemoryPool:
             self.reserved += bytes_
             self.by_query[query_id] = self.by_query.get(query_id, 0) + bytes_
 
+    def try_reserve(self, query_id: str, bytes_: int) -> bool:
+        """Reserve-if-fits (the LocalMemoryManager's non-raising probe)."""
+        try:
+            self.reserve(query_id, bytes_)
+            return True
+        except ExceededMemoryLimitError:
+            return False
+
+    def free_bytes(self) -> int:
+        with self._lock:
+            return max(0, self.size - self.reserved)
+
+    def query_bytes(self, query_id: str) -> int:
+        with self._lock:
+            return self.by_query.get(query_id, 0)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time view for heartbeats / system.runtime.memory."""
+        with self._lock:
+            return {
+                "size": int(self.size),
+                "reserved": int(self.reserved),
+                "free": max(0, int(self.size) - int(self.reserved)),
+                "byQuery": dict(self.by_query),
+            }
+
     def free(self, query_id: str, bytes_: Optional[int] = None):
         with self._lock:
             have = self.by_query.get(query_id, 0)
