@@ -94,6 +94,97 @@ CACHE_PROPS = {
     "cold": {"result_cache": False},
     "warm": {},
 }[CACHE_MODE]
+
+
+def _stats_mode() -> str:
+    """--stats {off,analyzed} (also BENCH_STATS env).
+
+    analyzed: each TPC-H SF1 config runs ANALYZE over its tables (column
+        subsets, so the collection cost stays bounded) BEFORE timing, and
+        records the plan choice (join distributions + estimated rows)
+        both before and after the stats exist — the BENCH json then
+        carries the plan-choice delta and the analyzed-plan runtime next
+        to a --stats off run's numbers.
+    off (default): planning sees connector/static stats only.
+    """
+    mode = os.environ.get("BENCH_STATS", "off")
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--stats" and i + 1 < len(argv):
+            mode = argv[i + 1]
+        elif a.startswith("--stats="):
+            mode = a.split("=", 1)[1]
+    if mode not in ("off", "analyzed"):
+        raise SystemExit(f"--stats must be off|analyzed, got {mode!r}")
+    return mode
+
+
+STATS_MODE = _stats_mode()
+
+# column subsets ANALYZEd per table under --stats analyzed: the columns
+# the benchmark queries actually filter/join on
+ANALYZE_COLUMNS = {
+    "lineitem": ("l_orderkey", "l_quantity", "l_extendedprice",
+                 "l_discount", "l_shipdate"),
+    "orders": ("o_orderkey", "o_custkey", "o_orderdate"),
+    "customer": ("c_custkey", "c_mktsegment"),
+}
+
+
+def _plan_choice(session, sql):
+    """Static plan shape snapshot: join distributions + estimated output
+    rows — the part of the plan that table statistics can flip."""
+    import trino_tpu.plan.nodes as P
+    from trino_tpu.sql.parser import parse as _parse
+
+    try:
+        plan = session._plan_stmt(_parse(sql))
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {str(e)[:120]}"}
+    joins = []
+
+    def walk(n):
+        if isinstance(n, P.Join):
+            joins.append({"kind": n.kind, "distribution": n.distribution})
+        for s in n.sources:
+            walk(s)
+
+    walk(plan)
+    out = {"joins": joins}
+    try:
+        from trino_tpu.plan.cost import StatsProvider
+
+        out["estimated_rows"] = round(
+            float(StatsProvider(session.metadata).estimate(plan).rows), 1
+        )
+    except Exception:
+        pass
+    return out
+
+
+def _with_stats(session, sql, tables):
+    """Under --stats analyzed: ANALYZE the config's tables and capture
+    the before/after plan choice; returns keys merged into the config's
+    BENCH json entry."""
+    out = {"stats_mode": STATS_MODE}
+    if STATS_MODE != "analyzed" or not tables:
+        return out
+    out["plan_before_analyze"] = _plan_choice(session, sql)
+    t0 = time.perf_counter()
+    for t in tables:
+        cols = ANALYZE_COLUMNS.get(t)
+        stmt = (
+            f"analyze {t} ({', '.join(cols)})" if cols else f"analyze {t}"
+        )
+        try:
+            session.execute(stmt)
+        except Exception as e:  # noqa: BLE001
+            out.setdefault("analyze_errors", []).append(
+                f"{t}: {type(e).__name__}: {str(e)[:80]}"
+            )
+    out["analyze_s"] = round(time.perf_counter() - t0, 2)
+    out["plan_after_analyze"] = _plan_choice(session, sql)
+    return out
 if os.environ.get("BENCH_DEVICE_GEN") == "0":
     # the crash-containment retry path: re-run a wedged config through the
     # host/streaming generator instead of on-device generation
@@ -491,6 +582,7 @@ def _cpu_probe(iters, budget_left) -> dict:
     env["BENCH_CPU_PROBE"] = "1"
     env["BENCH_ITERS"] = str(iters)
     env["BENCH_CACHE"] = CACHE_MODE  # probe must time the same semantics
+    env["BENCH_STATS"] = STATS_MODE
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -589,6 +681,7 @@ def _run_isolated(name, cost, budget_left):
     env = dict(os.environ)
     env["BENCH_ONLY"] = name
     env["BENCH_CACHE"] = CACHE_MODE
+    env["BENCH_STATS"] = STATS_MODE
     env.pop("BENCH_CPU_PROBE", None)
     timeout_s = max(90.0, min(budget_left - 10.0, cost * 3.0 + 120.0))
     doc = _run_child(name, env, timeout_s)
@@ -666,6 +759,7 @@ def main():
         "backend": backend,
         "compile_cache": compile_cache,
         "cache_mode": CACHE_MODE,
+        "stats_mode": STATS_MODE,
         "budget_s": budget,
         "configs": {},
     }
@@ -712,10 +806,13 @@ def main():
     big = Shared(_mk_big)
     ds = Shared(_mk_ds)
 
-    def _cfg(shared, sql, rows_table, n_iters):
+    def _cfg(shared, sql, rows_table, n_iters, stats_tables=()):
         def run():
             s = shared.get()
-            return _time_config(s, sql, _table_rows(s, rows_table), n_iters)
+            extra = _with_stats(s, sql, stats_tables)
+            r = _time_config(s, sql, _table_rows(s, rows_table), n_iters)
+            r.update(extra)
+            return r
         return run
 
     def _cfg_tiny():
@@ -727,7 +824,9 @@ def main():
     def _cfg_q3_big():
         s = tpch_session(q3_sf, **CACHE_PROPS)
         s._scan_cache.max_bytes = 9 << 30
+        extra = _with_stats(s, Q3, ("customer", "orders", "lineitem"))
         r = _time_config(s, Q3, _table_rows(s, "lineitem"), iters_big)
+        r.update(extra)
         r["sf"] = q3_sf
         _drop_session(s)
         return r
@@ -790,9 +889,13 @@ def main():
         (f"q6_sf{big_sf:g}", _cfg(big, Q6, "lineitem", iters_big), 100, []),
         (f"q1_sf{big_sf:g}", _cfg(big, Q1, "lineitem", iters_big), 100,
          [big]),
-        ("q6_sf1", _cfg(sf1, Q6, "lineitem", iters), 40, []),
-        ("q1_sf1", _cfg(sf1, Q1, "lineitem", iters), 45, []),
-        ("q3_sf1", _cfg(sf1, Q3, "lineitem", iters), 150, [sf1]),
+        ("q6_sf1", _cfg(sf1, Q6, "lineitem", iters,
+                        stats_tables=("lineitem",)), 40, []),
+        ("q1_sf1", _cfg(sf1, Q1, "lineitem", iters,
+                        stats_tables=("lineitem",)), 45, []),
+        ("q3_sf1", _cfg(sf1, Q3, "lineitem", iters,
+                        stats_tables=("customer", "orders", "lineitem")),
+         150, [sf1]),
         (f"q3_sf{q3_sf:g}", _cfg_q3_big, 200, []),
         (f"tpcds_q3_sf{ds_sf:g}", _cfg(ds, DS_Q3, "store_sales", iters_big),
          280, []),

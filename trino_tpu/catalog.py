@@ -60,6 +60,11 @@ class Metadata:
         # reference delegates durability to connector metastores, which
         # the memory-connector-style store mirrors for every catalog
         self.views: Dict[Tuple[str, str], ViewDefinition] = {}
+        # ANALYZE overlay for connectors without durable stats storage:
+        # (catalog, table) -> (data_version at collection, stats).  Served
+        # only while the connector's data_version still matches, so DML
+        # invalidates overlay stats exactly like stored ones.
+        self.analyzed: Dict[Tuple[str, str], Tuple[int, TableStatistics]] = {}
 
     def _qualify(self, parts, default_catalog: Optional[str]):
         if len(parts) == 3:
@@ -138,4 +143,48 @@ class Metadata:
         return catalog, table
 
     def table_statistics(self, catalog: str, table: str) -> TableStatistics:
-        return self.catalogs.get(catalog).metadata().get_table_statistics(table)
+        from .utils.metrics import counter
+
+        conn = self.catalogs.get(catalog)
+        entry = self.analyzed.get((catalog, table))
+        if entry is not None:
+            version, stats = entry
+            if conn.data_version(table) == version:
+                counter("trino_tpu_stats_served_total").inc()
+                return stats
+            del self.analyzed[(catalog, table)]
+        stats = conn.metadata().get_table_statistics(table)
+        # connector-stored ANALYZE results (histograms prove provenance)
+        # count as serves too; bare row-count fallbacks count as misses
+        if any(
+            c.histogram is not None or c.distinct_count is not None
+            for c in stats.columns.values()
+        ):
+            counter("trino_tpu_stats_served_total").inc()
+        else:
+            counter("trino_tpu_stats_missed_total").inc()
+        return stats
+
+    def store_table_statistics(
+        self, catalog: str, table: str, stats: TableStatistics
+    ) -> int:
+        """Route ANALYZE output to the connector's durable store when it
+        has one, else to the session overlay; either way keyed by the
+        data_version snapshotted here.  Returns that version."""
+        conn = self.catalogs.get(catalog)
+        version = conn.data_version(table)
+        # merge over whatever is currently served so a column-subset
+        # ANALYZE refines rather than erases the other columns' stats
+        try:
+            base = self.table_statistics(catalog, table)
+            merged = dict(base.columns)
+            merged.update(stats.columns)
+            stats = TableStatistics(stats.row_count, merged)
+        except Exception:
+            pass
+        try:
+            conn.metadata().store_table_statistics(table, stats, version)
+            self.analyzed.pop((catalog, table), None)
+        except NotImplementedError:
+            self.analyzed[(catalog, table)] = (version, stats)
+        return version

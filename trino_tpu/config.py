@@ -213,6 +213,26 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "statistics_enabled",
+            "cost the plan from collected/connector table statistics "
+            "(histograms, NDV); off degrades every table to a bare "
+            "row count (statistics-enabled analog)",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "analyze_histogram_buckets",
+            "equi-height histogram buckets ANALYZE collects per "
+            "numeric/date column (device-sort quantile boundaries)",
+            int, 8,
+        ),
+        PropertyMetadata(
+            "adaptive_replan_factor",
+            "FTE: replan the undispatched remainder when a fragment's "
+            "observed output rows diverge from the estimate by this "
+            "multiple in either direction (0 disables)",
+            float, 4.0,
+        ),
+        PropertyMetadata(
             "in_list_pushdown",
             "derive discrete-value TupleDomains from IN lists for "
             "connector split/row-group pruning",

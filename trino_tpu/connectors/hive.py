@@ -127,10 +127,17 @@ def _engine_to_arrow_type(t: T.Type):
 
 
 class HiveMetadata(ConnectorMetadata):
-    def __init__(self, warehouse: str):
+    def __init__(self, warehouse: str, connector: Optional["HiveConnector"] = None):
         self.warehouse = warehouse
+        self.connector = connector
 
     FORMATS = ("parquet", "orc", "csv", "json")  # hive-formats analog
+    # ANALYZE sidecar (metastore table-parameters analog).  Dot-prefixed
+    # on purpose: table discovery globs `*.{ext}`, which skips dotfiles,
+    # so the sidecar can share the table directory without being
+    # discovered as data — and data_version() skips dotfiles so writing
+    # it doesn't invalidate the very version it is keyed by.
+    STATS_SIDECAR = ".trino_stats.json"
 
     def list_tables(self) -> List[str]:
         if not os.path.isdir(self.warehouse):
@@ -190,11 +197,78 @@ class HiveMetadata(ConnectorMetadata):
         )
         pq.write_table(empty, os.path.join(tdir, "schema-0.parquet"))
 
+    def _sidecar_path(self, table: str) -> str:
+        return os.path.join(self.warehouse, table, self.STATS_SIDECAR)
+
+    def store_table_statistics(
+        self, table: str, stats: TableStatistics, data_version: int
+    ) -> None:
+        import json
+
+        self._files(table)  # raises KeyError for unknown tables
+        doc = {
+            "data_version": int(data_version),
+            "row_count": stats.row_count,
+            "columns": {
+                name: {
+                    "distinct_count": c.distinct_count,
+                    "null_fraction": c.null_fraction,
+                    "min_value": c.min_value,
+                    "max_value": c.max_value,
+                    "histogram": (
+                        None if c.histogram is None
+                        else [list(b) for b in c.histogram]
+                    ),
+                }
+                for name, c in stats.columns.items()
+            },
+        }
+        tmp = self._sidecar_path(table) + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self._sidecar_path(table))
+
+    def _sidecar_statistics(self, table: str) -> Optional[TableStatistics]:
+        """Persisted ANALYZE results, iff still keyed to the current
+        data_version (files changed since collection -> stale)."""
+        import json
+
+        path = self._sidecar_path(table)
+        if self.connector is None or not os.path.exists(path):
+            return None
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if int(doc.get("data_version", -1)) != self.connector.data_version(table):
+            return None
+        return TableStatistics(
+            row_count=float(doc["row_count"]),
+            columns={
+                name: ColumnStatistics(
+                    distinct_count=c.get("distinct_count"),
+                    null_fraction=float(c.get("null_fraction") or 0.0),
+                    min_value=c.get("min_value"),
+                    max_value=c.get("max_value"),
+                    histogram=(
+                        None if c.get("histogram") is None
+                        else tuple(tuple(b) for b in c["histogram"])
+                    ),
+                )
+                for name, c in doc.get("columns", {}).items()
+            },
+        )
+
     def get_table_statistics(self, table: str) -> TableStatistics:
-        """Row counts from footers; per-column min/max/nulls from row-group
-        statistics (the reference reads these via ParquetMetadata for CBO).
-        Non-parquet formats report row counts only."""
+        """ANALYZE sidecar when fresh; else row counts from footers and
+        per-column min/max/nulls from row-group statistics (the reference
+        reads these via ParquetMetadata for CBO).  Non-parquet formats
+        report row counts only."""
         _require_pyarrow()
+        side = self._sidecar_statistics(table)
+        if side is not None:
+            return side
         files = self._files(table)
         if self._format_of(files[0]) != "parquet":
             rows = sum(
@@ -424,7 +498,7 @@ class HiveConnector(Connector):
         self.name = name
         self.warehouse = warehouse
         self.writer_target_bytes = writer_target_bytes
-        self._metadata = HiveMetadata(warehouse)
+        self._metadata = HiveMetadata(warehouse, connector=self)
 
     def data_version(self, table: Optional[str] = None) -> int:
         """Fingerprint of (path, mtime_ns, ctime_ns, inode, size) per
@@ -442,6 +516,11 @@ class HiveConnector(Connector):
         h = hashlib.blake2b(digest_size=8)
         for root, _dirs, files in sorted(os.walk(root_dir)):
             for f in sorted(files):
+                if f.startswith("."):
+                    # hidden metadata (the ANALYZE stats sidecar) is not
+                    # table data; including it would let a stats write
+                    # invalidate the version the stats are keyed by
+                    continue
                 p = os.path.join(root, f)
                 try:
                     st = os.stat(p)

@@ -8,7 +8,7 @@ SPI; rows can also be loaded via the python API (create_table).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
@@ -39,6 +39,9 @@ class _Store:
         # per-table change counters (Connector.data_version(table)): an
         # INSERT into A must not invalidate cached results scanning B
         self.versions: Dict[str, int] = {}
+        # ANALYZE results keyed by the data_version they were collected
+        # at; served only while the table hasn't been written since
+        self.stats: Dict[str, Tuple[int, TableStatistics]] = {}
 
     def bump(self, table: str) -> None:
         self.version += 1
@@ -56,8 +59,21 @@ class MemoryMetadata(ConnectorMetadata):
         return self.store.schemas[table]
 
     def get_table_statistics(self, table: str) -> TableStatistics:
+        entry = self.store.stats.get(table)
+        if entry is not None:
+            version, stats = entry
+            if version == self.store.versions.get(table, 0):
+                return stats
+            del self.store.stats[table]  # DML since ANALYZE: stale
         page = self.store.tables[table]
         return TableStatistics(float(page.count), {})
+
+    def store_table_statistics(
+        self, table: str, stats: TableStatistics, data_version: int
+    ) -> None:
+        if table not in self.store.tables:
+            raise KeyError(f"table {table} does not exist")
+        self.store.stats[table] = (int(data_version), stats)
 
     # -- writes (MemoryMetadata.beginCreateTable/beginInsert analog) ----
     def create_table(self, schema: TableSchema) -> None:

@@ -89,6 +89,17 @@ SCHEMAS: Dict[str, List] = {
         ("queries", T.BIGINT),
         ("blocked_queries", T.BIGINT),
     ],
+    # one row per ANALYZEd table (the session's analyze registry): when
+    # stats were collected, over which columns, and at which data_version
+    "table_stats": [
+        ("catalog", T.VARCHAR),
+        ("table_name", T.VARCHAR),
+        ("columns", T.VARCHAR),
+        ("row_count", T.DOUBLE),
+        ("data_version", T.VARCHAR),
+        ("analyzed_at", T.DOUBLE),
+        ("duration_s", T.DOUBLE),
+    ],
     # one row per metric series from the process-global MetricsRegistry —
     # the plugin/trino-jmx "metrics as SQL" surface; histograms expose
     # interpolated p50/p95/p99 alongside the observation count
@@ -227,6 +238,20 @@ class _SystemSource:
                     out["queries"].append(len(p.get("byQuery") or {}))
                     out["blocked_queries"].append(blocked)
             return out
+        if table == "table_stats":
+            entries = sorted(
+                getattr(s, "analyzed_tables", {}).values(),
+                key=lambda e: (e["catalog"], e["table"]),
+            )
+            return {
+                "catalog": [e["catalog"] for e in entries],
+                "table_name": [e["table"] for e in entries],
+                "columns": [", ".join(e["columns"]) for e in entries],
+                "row_count": [e["row_count"] for e in entries],
+                "data_version": [str(e["data_version"]) for e in entries],
+                "analyzed_at": [e["analyzed_at"] for e in entries],
+                "duration_s": [e["duration_s"] for e in entries],
+            }
         if table == "metrics":
             from ..utils.metrics import REGISTRY
 

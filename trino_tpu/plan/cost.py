@@ -18,9 +18,14 @@ import dataclasses
 import math
 from typing import Dict, Optional, Tuple
 
+from .. import types as T
 from ..catalog import Metadata
 from ..expr import ir
+from ..spi import TableStatistics
 from . import nodes as P
+
+# FilterStatsCalculator UNKNOWN_FILTER_COEFFICIENT
+UNKNOWN_FILTER = 0.3
 
 # cost weights (CostCalculatorUsingExchanges exchange_cost_multiplier
 # analog): ICI collective bytes cost ~2x an HBM pass; per-device memory
@@ -180,46 +185,16 @@ class StatsProvider:
 
     # -- selectivity -----------------------------------------------------
     def _selectivity(self, pred: ir.Expr, source: P.PlanNode) -> float:
-        """Per-conjunct selectivity: range fraction against column
-        min/max when the conjunct is a simple comparison over a scan
-        column (FilterStatsCalculator), else 0.3 (UNKNOWN_FILTER)."""
-        sel = 1.0
-        for c in _conjuncts(pred):
-            sel *= self._conjunct_selectivity(c, source)
-        return max(sel, 1e-6)
+        """Per-conjunct selectivity against scan-column statistics
+        (FilterStatsCalculator); 0.3 (UNKNOWN_FILTER) per unrecognized
+        conjunct; same-column range pairs combine jointly."""
+        return conjunct_list_selectivity(
+            _conjuncts(pred), _scan_below(self._resolve(source)), self.metadata
+        )
 
     def _conjunct_selectivity(self, c: ir.Expr, source: P.PlanNode) -> float:
         source = self._resolve(source)
-        scan = _scan_below(source)
-        if scan is None or not isinstance(c, ir.Comparison):
-            return 0.3
-        sym, const, op = _simple_comparison(c)
-        if sym is None:
-            return 0.3
-        col = dict(scan.assignments).get(sym)
-        if col is None:
-            return 0.3
-        st = self.metadata.table_statistics(scan.catalog, scan.table)
-        cs = st.columns.get(col)
-        if cs is None or cs.min_value is None or cs.max_value is None:
-            return 0.3
-        try:
-            lo, hi = float(cs.min_value), float(cs.max_value)
-            v = float(const)
-        except (TypeError, ValueError):
-            if op == "=" and cs.distinct_count:
-                return 1.0 / float(cs.distinct_count)
-            return 0.3
-        span = max(hi - lo, 1e-9)
-        frac = min(max((v - lo) / span, 0.0), 1.0)
-        if op in ("<", "<="):
-            return max(frac, 1e-3)
-        if op in (">", ">="):
-            return max(1.0 - frac, 1e-3)
-        if op == "=":
-            d = float(cs.distinct_count or span)
-            return 1.0 / max(d, 1.0)
-        return 0.3
+        return conjunct_selectivity(c, _scan_below(source), self.metadata)
 
 
 class CostModel:
@@ -304,12 +279,12 @@ class CostModel:
 def annotate(
     plan: P.PlanNode, metadata: Metadata, properties=None
 ) -> Dict[int, dict]:
-    """EXPLAIN cost annotations: id(node) -> {rows, cpu, net, mem} for
-    every node (PlanPrinter's 'Estimates:' lines)."""
+    """EXPLAIN cost annotations: id(node) -> {rows, bytes, cpu, net, mem}
+    for every node (PlanPrinter's 'Estimates:' lines)."""
     ndev = 1
     if properties is not None and properties.get("distributed"):
         ndev = properties.get("num_devices") or 8
-    stats = StatsProvider(metadata, ndev)
+    stats = StatsProvider(effective_metadata(metadata, properties), ndev)
     model = CostModel(stats)
     out: Dict[int, dict] = {}
 
@@ -318,6 +293,7 @@ def annotate(
         c = model.local_cost(n)
         out[id(n)] = {
             "rows": e.rows,
+            "bytes": e.bytes,
             "cpu": c.cpu,
             "net": c.net,
             "mem": c.mem,
@@ -327,6 +303,219 @@ def annotate(
 
     walk(plan)
     return out
+
+
+# -- selectivity, shared with the greedy optimizer passes ----------------
+
+
+def _const_float(v) -> Optional[float]:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+def _const_value(c: Optional[ir.Constant]) -> Optional[float]:
+    """Numeric view of a literal in the column's value space: decimals
+    carry the *unscaled* int (0.05 -> Const(5:decimal(3,2))), so divide
+    the scale back out before comparing against double/float stats."""
+    if c is None or c.value is None:
+        return None
+    v = _const_float(c.value)
+    if v is not None and isinstance(c.type, T.DecimalType) and c.type.scale:
+        return v / (10.0 ** c.type.scale)
+    return v
+
+
+def _le_fraction(cs, v: float) -> Optional[float]:
+    """P(col <= v) over non-null rows: histogram interpolation when
+    ANALYZE collected one, else linear against [min, max]."""
+    if cs.histogram:
+        from ..stats.histogram import le_fraction
+
+        f = le_fraction(cs.histogram, v)
+        if f is not None:
+            return f
+    if cs.min_value is None or cs.max_value is None:
+        return None
+    lo, hi = float(cs.min_value), float(cs.max_value)
+    span = max(hi - lo, 1e-9)
+    return min(max((v - lo) / span, 0.0), 1.0)
+
+
+def _range_fraction(cs, lo: Optional[float], hi: Optional[float]):
+    hi_f = _le_fraction(cs, hi) if hi is not None else 1.0
+    lo_f = _le_fraction(cs, lo) if lo is not None else 0.0
+    if hi_f is None or lo_f is None:
+        return None
+    return min(1.0, max(0.0, hi_f - lo_f))
+
+
+def _clamp_sel(s: float) -> float:
+    return min(1.0, max(s, 1e-3))
+
+
+def conjunct_selectivity(
+    c: ir.Expr, scan: Optional[P.TableScan], metadata: Metadata
+) -> float:
+    """Selectivity of one conjunct against the statistics of the scan
+    it filters (FilterStatsCalculator): histogram-interpolated range
+    fractions for comparisons/BETWEEN, NDV arithmetic for = and IN;
+    UNKNOWN_FILTER for anything unrecognized."""
+    if scan is None:
+        return UNKNOWN_FILTER
+    if isinstance(c, ir.Not):
+        return _clamp_sel(1.0 - conjunct_selectivity(c.term, scan, metadata))
+    assigns = dict(scan.assignments)
+
+    def col_stats(expr):
+        if not isinstance(expr, ir.ColumnRef):
+            return None
+        col = assigns.get(expr.name)
+        if col is None:
+            return None
+        st = metadata.table_statistics(scan.catalog, scan.table)
+        return st.columns.get(col)
+
+    if isinstance(c, ir.Between):
+        cs = col_stats(c.value)
+        lo = _const_value(c.low) if isinstance(c.low, ir.Constant) else None
+        hi = _const_value(c.high) if isinstance(c.high, ir.Constant) else None
+        if cs is None or lo is None or hi is None:
+            return UNKNOWN_FILTER
+        frac = _range_fraction(cs, lo, hi)
+        if frac is None:
+            return UNKNOWN_FILTER
+        sel = frac * (1.0 - cs.null_fraction)
+        return _clamp_sel(1.0 - sel if c.negate else sel)
+    if isinstance(c, ir.In):
+        cs = col_stats(c.value)
+        if (
+            cs is None
+            or not cs.distinct_count
+            or not c.items
+            or not all(isinstance(i, ir.Constant) for i in c.items)
+        ):
+            return UNKNOWN_FILTER
+        distinct = {i.value for i in c.items}
+        sel = min(1.0, len(distinct) / max(float(cs.distinct_count), 1.0))
+        sel *= 1.0 - cs.null_fraction
+        return _clamp_sel(1.0 - sel if c.negate else sel)
+    if not isinstance(c, ir.Comparison):
+        return UNKNOWN_FILTER
+    sym, const, op = _simple_comparison(c)
+    if sym is None:
+        return UNKNOWN_FILTER
+    col = assigns.get(sym)
+    if col is None:
+        return UNKNOWN_FILTER
+    st = metadata.table_statistics(scan.catalog, scan.table)
+    cs = st.columns.get(col)
+    if cs is None:
+        return UNKNOWN_FILTER
+    notnull = 1.0 - cs.null_fraction
+    v = _const_value(const)
+    if v is None:
+        # non-numeric constant (varchar): only NDV arithmetic applies
+        if op == "=" and cs.distinct_count:
+            return _clamp_sel(notnull / float(cs.distinct_count))
+        if op in ("<>", "!=") and cs.distinct_count:
+            return _clamp_sel(notnull * (1.0 - 1.0 / float(cs.distinct_count)))
+        return UNKNOWN_FILTER
+    if op == "=":
+        if cs.distinct_count:
+            return _clamp_sel(notnull / max(float(cs.distinct_count), 1.0))
+        return UNKNOWN_FILTER
+    if op in ("<>", "!="):
+        if cs.distinct_count:
+            return _clamp_sel(
+                notnull * (1.0 - 1.0 / max(float(cs.distinct_count), 1.0))
+            )
+        return UNKNOWN_FILTER
+    frac = _le_fraction(cs, v)
+    if frac is None:
+        return UNKNOWN_FILTER
+    if op in ("<", "<="):
+        return _clamp_sel(frac * notnull)
+    if op in (">", ">="):
+        return _clamp_sel((1.0 - frac) * notnull)
+    return UNKNOWN_FILTER
+
+
+def _column_stats(scan: P.TableScan, metadata: Metadata, sym: str):
+    col = dict(scan.assignments).get(sym)
+    if col is None:
+        return None
+    return metadata.table_statistics(scan.catalog, scan.table).columns.get(col)
+
+
+def conjunct_list_selectivity(
+    conjs, scan: Optional[P.TableScan], metadata: Metadata
+) -> float:
+    """Product of per-conjunct selectivities (independence assumption) —
+    except that opposing inequalities on ONE column collapse into a single
+    histogram range fraction: `d >= a AND d < b` is P(a <= d < b), which
+    for a year out of a seven-year span is ~0.14, not the ~0.32 the
+    two marginals multiply out to."""
+    bounds: Dict[str, list] = {}  # sym -> [lo, hi, terms]
+    rest = []
+    for c in conjs:
+        sym = None
+        if scan is not None and isinstance(c, ir.Comparison):
+            sym, const, op = _simple_comparison(c)
+            v = _const_value(const) if sym is not None else None
+        if sym is None or v is None or op not in ("<", "<=", ">", ">="):
+            rest.append(c)
+            continue
+        b = bounds.setdefault(sym, [None, None, []])
+        if op in ("<", "<="):
+            b[1] = v if b[1] is None else min(b[1], v)
+        else:
+            b[0] = v if b[0] is None else max(b[0], v)
+        b[2].append(c)
+    sel = 1.0
+    for sym, (lo, hi, terms) in bounds.items():
+        cs = _column_stats(scan, metadata, sym)
+        frac = _range_fraction(cs, lo, hi) if cs is not None else None
+        if lo is None or hi is None or frac is None:
+            # one-sided or statless: the per-conjunct path handles it
+            for t in terms:
+                sel *= conjunct_selectivity(t, scan, metadata)
+            continue
+        sel *= _clamp_sel(frac * (1.0 - cs.null_fraction))
+    for c in rest:
+        sel *= conjunct_selectivity(c, scan, metadata)
+    return max(sel, 1e-6)
+
+
+def predicate_selectivity(
+    pred: ir.Expr, scan: Optional[P.TableScan], metadata: Metadata
+) -> float:
+    """Selectivity of a whole predicate against its scan's statistics."""
+    return conjunct_list_selectivity(_conjuncts(pred), scan, metadata)
+
+
+class RowCountOnlyMetadata:
+    """statistics_enabled=false: every consumer sees bare row counts
+    (one wrapper at the single table_statistics choke point gates the
+    Memo, the greedy passes, EXPLAIN estimates and FTE re-costing all
+    at once)."""
+
+    def __init__(self, metadata: Metadata):
+        self._metadata = metadata
+
+    def __getattr__(self, name):
+        return getattr(self._metadata, name)
+
+    def table_statistics(self, catalog: str, table: str) -> TableStatistics:
+        st = self._metadata.table_statistics(catalog, table)
+        return TableStatistics(st.row_count, {})
+
+
+def effective_metadata(metadata: Metadata, properties=None) -> Metadata:
+    if properties is not None and not properties.get("statistics_enabled"):
+        return RowCountOnlyMetadata(metadata)
+    return metadata
 
 
 # -- small helpers shared with the memo ---------------------------------
@@ -352,12 +541,12 @@ def _scan_below(node: P.PlanNode) -> Optional[P.TableScan]:
 
 
 def _simple_comparison(c: ir.Comparison):
-    """(symbol, constant, op) for col <op> const (either orientation)."""
+    """(symbol, Constant, op) for col <op> const (either orientation)."""
     flip = {"<": ">", ">": "<", "<=": ">=", ">=": "<=", "=": "="}
     a, b = c.left, c.right
     if isinstance(a, ir.ColumnRef) and isinstance(b, ir.Constant):
-        return a.name, b.value, c.op
+        return a.name, b, c.op
     if isinstance(b, ir.ColumnRef) and isinstance(a, ir.Constant):
         if c.op in flip:
-            return b.name, a.value, flip[c.op]
+            return b.name, a, flip[c.op]
     return None, None, None

@@ -745,7 +745,8 @@ def plan_to_string(
         if costs is not None and id(n) in costs:
             c = costs[id(n)]
             extra += (
-                f"  {{rows: {c['rows']:.0f}, cpu: {c['cpu']:.2g}, "
+                f"  {{rows: {c['rows']:.0f}, bytes: {c.get('bytes', 0.0):.3g}, "
+                f"cpu: {c['cpu']:.2g}, "
                 f"net: {c['net']:.2g}, mem: {c['mem']:.2g}}}"
             )
         if stats is not None and id(n) in stats:

@@ -45,6 +45,12 @@ def optimize(
             node = _merge_filters(node)
         return node
 
+    if metadata is not None:
+        from .cost import effective_metadata
+
+        # statistics_enabled=false degrades every stats consumer below
+        # (greedy passes, Memo, compaction) to bare row counts at once
+        metadata = effective_metadata(metadata, properties)
     cur = sink_predicates(plan)
     if metadata is not None:
         if prop("reorder_joins"):
@@ -563,9 +569,13 @@ def _estimate_rows(node: P.PlanNode, metadata: Metadata) -> float:
         return metadata.table_statistics(node.catalog, node.table).row_count
     if isinstance(node, P.Filter):
         base = _estimate_rows(node.source, metadata)
-        # crude selectivity: 0.3 per conjunct (FilterStatsCalculator stand-in)
-        k = len(_conjuncts(node.predicate))
-        return base * (0.3**k)
+        # shared FilterStatsCalculator: histogram/NDV selectivity when
+        # the column has collected stats, 0.3 per unknown conjunct
+        from .cost import _scan_below, predicate_selectivity
+
+        return base * predicate_selectivity(
+            node.predicate, _scan_below(node.source), metadata
+        )
     if isinstance(node, P.Join):
         l = _estimate_rows(node.left, metadata)
         r = _estimate_rows(node.right, metadata)

@@ -60,6 +60,7 @@ class QueryExecution:
         self.state = "QUEUED"
         self.error: Optional[str] = None
         self.retry_count = 0  # whole-query re-runs under retry_policy=query
+        self.adaptive_actions: list = []  # FTE mid-query replan records
         self.page: Optional[Page] = None
         self.types = None
         self.created = time.time()
@@ -309,6 +310,34 @@ class Coordinator:
         clusters run in-process (coordinator-only execution)."""
         if self.distributed:
             stmt = parse(q.sql)
+            if isinstance(stmt, ast.Analyze):
+                # ANALYZE's synthesized aggregations ride the distributed
+                # fragment scheduler like any query: per-worker partial
+                # sketches (HLL/KMV) merge at the final stage exactly as
+                # planStatisticsAggregation's partial/final split does
+                workers = self.node_manager.alive()
+                if workers:
+                    from .scheduler import DistributedScheduler
+
+                    props = self.session.properties
+                    seq = [0]
+
+                    def dispatch(plan):
+                        seq[0] += 1
+                        sched = DistributedScheduler(
+                            self.session.catalogs, workers,
+                            {"group_capacity": props.get("group_capacity")},
+                            memory_view=self.cluster_memory,
+                        )
+                        return sched.run(
+                            plan, f"{q.query_id}_analyze{seq[0]}"
+                        )
+
+                    with q.lock:
+                        q.state = "RUNNING"
+                    return self.session.execute_analyze(
+                        stmt, execute_plan=dispatch
+                    )
             if isinstance(stmt, ast.Query):
                 from .scheduler import DistributedScheduler, SchedulerError
 
@@ -358,6 +387,13 @@ class Coordinator:
                         props.get("exchange_retry_attempts"),
                     "exchange_retry_budget_s":
                         props.get("exchange_retry_budget_s"),
+                    # adaptive replanning: estimate-vs-observed divergence
+                    # threshold + the broadcast cutoff the flip re-checks
+                    "statistics_enabled": props.get("statistics_enabled"),
+                    "adaptive_replan_factor":
+                        props.get("adaptive_replan_factor"),
+                    "broadcast_join_threshold_rows":
+                        props.get("broadcast_join_threshold_rows"),
                 }
                 try:
                     # the query span parents every scheduler dispatch made
@@ -370,8 +406,10 @@ class Coordinator:
                             fte = FaultTolerantScheduler(
                                 self.session.catalogs, self.node_manager,
                                 properties=task_props,
+                                metadata=self.session.metadata,
                             )
                             page = fte.run(plan, q.query_id)
+                            q.adaptive_actions = fte.adaptive_actions
                         elif props.get("retry_policy") == "query":
                             page = self._run_with_query_retries(
                                 q, plan, workers, task_props, props

@@ -106,12 +106,24 @@ class FaultTolerantScheduler:
         node_manager,
         exchange: Optional[FileSystemExchangeManager] = None,
         properties: Optional[dict] = None,
+        metadata=None,
     ):
         self.catalogs = catalogs
         self.node_manager = node_manager
         self.exchange = exchange or FileSystemExchangeManager()
         self.properties = properties or {}
         p = self.properties
+        # table statistics for per-fragment output estimates (the
+        # OutputStatsEstimator's *expected* side); None disables the
+        # estimate-vs-observed replan entirely
+        if metadata is not None and not p.get("statistics_enabled", True):
+            from ..plan.cost import RowCountOnlyMetadata
+
+            metadata = RowCountOnlyMetadata(metadata)
+        self.metadata = metadata
+        from ..utils.faults import FaultInjector
+
+        self._injector = FaultInjector.from_spec(p.get("fault_injection"))
         self.max_attempts = int(p.get("fte_max_attempts") or MAX_ATTEMPTS)
         self.task_timeout = float(
             p.get("fte_task_timeout_s") or TASK_TIMEOUT
@@ -126,51 +138,98 @@ class FaultTolerantScheduler:
     # ------------------------------------------------------------------
     def run(self, plan: P.Output, query_id: Optional[str] = None) -> Page:
         query_id = query_id or f"q_{uuid.uuid4().hex[:12]}"
-        fragments = fragment_plan(plan)
-        by_id = {f.id: f for f in fragments}
-        consumer: Dict[int, int] = {}
-        for f in fragments:
-            for sf in f.source_fragments:
-                consumer[sf] = f.id
-
-        # stage width is fixed up-front (task count = buffer addressing),
-        # but *placement* is re-chosen per attempt from the alive set
-        width: Dict[int, int] = {}
-        cluster = self.node_manager.alive()
-        if not cluster:
-            raise SchedulerError("NO_NODES_AVAILABLE: no alive workers")
-        for f in fragments:
-            width[f.id] = (
-                len(cluster)
-                if f.partitioning in (SOURCE, HASH, ARBITRARY)
-                else 1
-            )
-
-        # committed spool dirs: fragment -> [task_index -> SpoolHandle path]
-        committed: Dict[int, List[str]] = {}
         self._created_tasks: List[Tuple[str, str]] = []  # (uri, task_id)
-        # per-stage (frag_json, per-task splits, out_buffers) so a corrupt
-        # committed attempt can be healed by re-running just its producer
-        self._stage_ctx: Dict[int, tuple] = {}
+        # frag spool dir -> everything needed to re-run one producer task
+        # of that stage (heals survive an adaptive re-fragmentation, which
+        # renumbers fragments — the spool path keeps the OLD id)
+        self._heal_ctx: Dict[str, tuple] = {}
         self._heal_lock = threading.RLock()  # heals can nest across stages
         self.heal_actions: List[dict] = []  # observability for chaos tests
-        # observed spool bytes per completed fragment (the
+        # observed spool bytes/rows per completed fragment (the
         # OutputStatsEstimator role) + the adaptive actions taken from
         # them (surfaced for tests/observability)
         self.output_stats: Dict[int, int] = {}
+        self.output_rows: Dict[int, int] = {}
+        self.fragment_estimates: Dict[int, float] = {}
         self.adaptive_actions: List[dict] = []
+        # committed stages survive a replan when the new topology contains
+        # a structurally identical fragment: spools are reused by signature
+        committed_by_sig: Dict[str, List[str]] = {}
+        stats_by_sig: Dict[str, Tuple[int, int]] = {}
+        replans = 0
         try:
-            order = sorted(
-                (f for f in fragments if f.id != 0), key=lambda f: f.id
-            ) + [by_id[0]]
-            for f in order:
-                committed[f.id] = self._run_stage(
-                    query_id, f, width, committed, by_id, consumer
+            while True:
+                # post-replan stages spool under an epoch-suffixed query id
+                # so renumbered fragments never collide with epoch-0 dirs
+                epoch_qid = (
+                    query_id if replans == 0 else f"{query_id}_r{replans}"
                 )
-                if bool(self.properties.get("adaptive_replanning", True)):
-                    self.output_stats[f.id] = self._spool_bytes(
-                        committed[f.id]
+                fragments = fragment_plan(plan)
+                by_id = {f.id: f for f in fragments}
+                consumer: Dict[int, int] = {}
+                for f in fragments:
+                    for sf in f.source_fragments:
+                        consumer[sf] = f.id
+
+                # stage width is fixed up-front (task count = buffer
+                # addressing), but *placement* is re-chosen per attempt
+                # from the alive set
+                width: Dict[int, int] = {}
+                cluster = self.node_manager.alive()
+                if not cluster:
+                    raise SchedulerError(
+                        "NO_NODES_AVAILABLE: no alive workers"
                     )
+                for f in fragments:
+                    width[f.id] = (
+                        len(cluster)
+                        if f.partitioning in (SOURCE, HASH, ARBITRARY)
+                        else 1
+                    )
+
+                sigs = self._fragment_signatures(fragments, width, consumer)
+                est = self._estimate_fragments(fragments, by_id)
+                self.fragment_estimates = dict(est)
+                # committed spool dirs: frag -> [task_index -> spool path]
+                committed: Dict[int, List[str]] = {}
+                self.output_stats = {}
+                self.output_rows = {}
+                adaptive = bool(
+                    self.properties.get("adaptive_replanning", True)
+                )
+                order = sorted(
+                    (f for f in fragments if f.id != 0), key=lambda f: f.id
+                ) + [by_id[0]]
+                replanned = False
+                for f in order:
+                    reused = committed_by_sig.get(sigs[f.id])
+                    if reused is not None:
+                        committed[f.id] = reused
+                        b, r = stats_by_sig.get(sigs[f.id], (0, 0))
+                        self.output_stats[f.id] = b
+                        self.output_rows[f.id] = r
+                        continue
+                    committed[f.id] = self._run_stage(
+                        epoch_qid, f, width, committed, by_id, consumer
+                    )
+                    committed_by_sig[sigs[f.id]] = committed[f.id]
+                    if not adaptive:
+                        continue
+                    b, r = self._spool_stats(committed[f.id])
+                    self.output_stats[f.id] = b
+                    self.output_rows[f.id] = r
+                    stats_by_sig[sigs[f.id]] = (b, r)
+                    if replans == 0:
+                        new_plan = self._maybe_replan(
+                            plan, f, fragments, by_id, est, committed
+                        )
+                        if new_plan is not None:
+                            plan = new_plan
+                            replans += 1
+                            replanned = True
+                            break
+                if not replanned:
+                    break
             for _ in range(self.max_attempts):
                 try:
                     root_pages = read_spool_pages(
@@ -180,9 +239,7 @@ class FaultTolerantScheduler:
                 except SpoolCorruptionError as e:
                     # the ROOT attempt itself is corrupt: heal it like any
                     # other producer (decommit + re-run) and re-read
-                    if not self._heal_corrupt_spool(
-                        query_id, e.path, committed, by_id
-                    ):
+                    if not self._heal_corrupt_spool(e.path):
                         raise
             else:
                 raise SchedulerError(
@@ -204,6 +261,8 @@ class FaultTolerantScheduler:
                 except Exception:
                     pass
             self.exchange.cleanup_query(query_id)
+            for i in range(1, replans + 1):
+                self.exchange.cleanup_query(f"{query_id}_r{i}")
 
     # ------------------------------------------------------------------
     def _sources_for(
@@ -243,9 +302,6 @@ class FaultTolerantScheduler:
         per_task_splits = assign_splits(self.catalogs, f, ntasks)
         root = self._adapt_fragment(f)
         frag_json = plan_to_json(root)
-        # retained so a later-detected corrupt committed attempt can be
-        # healed by re-running exactly one producer task of this stage
-        self._stage_ctx[f.id] = (frag_json, per_task_splits, out_buffers)
         from concurrent.futures import ThreadPoolExecutor
 
         sibling_times: List[float] = []  # completed task durations (stage)
@@ -258,19 +314,33 @@ class FaultTolerantScheduler:
                 )
                 for i in range(ntasks)
             ]
-            return [fut.result() for fut in futures]
+            paths = [fut.result() for fut in futures]
+        # retained so a later-detected corrupt committed attempt can be
+        # healed by re-running exactly one producer task of this stage;
+        # keyed by the fragment's spool dir (stable across a replan's
+        # fragment renumbering) and holding this epoch's committed/by_id
+        # so the heal re-run resolves its sources against the topology it
+        # actually ran under
+        if paths:
+            frag_dir = os.path.dirname(os.path.abspath(paths[0]))
+            self._heal_ctx[frag_dir] = (
+                f, frag_json, per_task_splits, out_buffers, paths,
+                query_id, dict(committed), by_id,
+            )
+        return paths
 
-    def _spool_bytes(self, spool_dirs: List[str]) -> int:
-        """Total committed UNCOMPRESSED output bytes of a stage, read
-        from the page-frame headers only (serde.pages_stats) — the
-        observed stat the adaptive planner consumes.  Compressed file
-        sizes would misrank sides (zstd flattens monotone int columns
-        ~10x)."""
+    def _spool_stats(self, spool_dirs: List[str]) -> Tuple[int, int]:
+        """(uncompressed bytes, rows) committed by a stage, read from the
+        page-frame headers only (serde.pages_stats) — the observed side
+        of the adaptive planner's estimate-vs-actual comparison.
+        Compressed file sizes would misrank sides (zstd flattens monotone
+        int columns ~10x)."""
         import os
 
         from ..serde import pages_stats
 
         total = 0
+        rows = 0
         for d in spool_dirs:
             try:
                 for base, _dirs, files in os.walk(d):
@@ -278,13 +348,14 @@ class FaultTolerantScheduler:
                         p = os.path.join(base, name)
                         try:
                             with open(p, "rb") as fh:
-                                _rows, ub = pages_stats(fh.read())
+                                r, ub = pages_stats(fh.read())
                             total += ub
+                            rows += r
                         except Exception:
                             total += os.path.getsize(p)
             except OSError:
                 pass
-        return total
+        return total, rows
 
     def _adapt_fragment(self, f: PlanFragment) -> P.PlanNode:
         """Adaptive replanning between stages (AdaptivePlanner.java +
@@ -384,6 +455,276 @@ class FaultTolerantScheduler:
             return adapt(f.root)
         except Exception:
             return f.root
+
+    # -- adaptive replanning (topology-changing tier) -------------------
+    def _fragment_signatures(
+        self,
+        fragments: List[PlanFragment],
+        width: Dict[int, int],
+        consumer: Dict[int, int],
+    ) -> Dict[int, str]:
+        """Structural identity of every fragment: its plan shape (with
+        each RemoteSource replaced by the SOURCE fragment's signature,
+        so ids don't matter), task width and output shape.  After an
+        adaptive replan renumbers fragments, a new fragment carrying the
+        same signature as an already-committed one reuses that stage's
+        spools verbatim instead of re-running."""
+        import dataclasses as dc
+        import hashlib
+
+        by_id = {f.id: f for f in fragments}
+        sigs: Dict[int, str] = {}
+
+        def node_sig(n: P.PlanNode, child: Dict[int, str]) -> str:
+            parts = [type(n).__name__]
+            for fld in dc.fields(n):
+                v = getattr(n, fld.name)
+                if isinstance(v, P.PlanNode):
+                    continue
+                if isinstance(v, tuple) and any(
+                    isinstance(x, P.PlanNode) for x in v
+                ):
+                    continue
+                if isinstance(n, P.RemoteSource) and fld.name == "fragment_id":
+                    parts.append(child.get(v, str(v)))
+                    continue
+                parts.append(f"{fld.name}={v!r}")
+            for s in n.sources:
+                parts.append(node_sig(s, child))
+            return hashlib.blake2b(
+                "\x1f".join(parts).encode(), digest_size=16
+            ).hexdigest()
+
+        def frag_sig(fid: int) -> str:
+            if fid in sigs:
+                return sigs[fid]
+            f = by_id[fid]
+            child = {sf: frag_sig(sf) for sf in f.source_fragments}
+            out_buffers = (
+                width[consumer[fid]]
+                if f.output_partitioning in (HASH, ARBITRARY)
+                else 1
+            )
+            doc = "|".join([
+                node_sig(f.root, child),
+                f.output_partitioning or "",
+                ",".join(f.output_keys),
+                str(width[fid]),
+                str(out_buffers),
+            ])
+            sigs[fid] = hashlib.blake2b(
+                doc.encode(), digest_size=16
+            ).hexdigest()
+            return sigs[fid]
+
+        for f in fragments:
+            frag_sig(f.id)
+        return sigs
+
+    def _frag_stats(self, est_map: Dict[int, float], by_id):
+        """StatsProvider whose RemoteSource estimates resolve to the
+        source fragment's rows in `est_map` — the fragment-graph analog
+        of the planner's per-node StatsCalculator."""
+        from ..plan import cost as C
+
+        class _FragmentStats(C.StatsProvider):
+            def _estimate(sp, node):
+                if isinstance(node, P.RemoteSource):
+                    try:
+                        w = C._width_of(node)
+                    except Exception:
+                        w = 8.0
+                    return C.Estimate(
+                        float(est_map.get(node.fragment_id, 1.0)), w
+                    )
+                return super(_FragmentStats, sp)._estimate(node)
+
+        return _FragmentStats(self.metadata, 1)
+
+    def _estimate_fragments(
+        self, fragments: List[PlanFragment], by_id
+    ) -> Dict[int, float]:
+        """Static estimated output rows per fragment — the *expected*
+        side of the adaptive-replan comparison (OutputStatsEstimator's
+        counterpart).  The seeded chaos site ``stats_estimate`` divides a
+        fragment's estimate by its rule ``factor``, deterministically
+        forcing the divergence the replan tests need."""
+        if self.metadata is None:
+            return {}
+        est: Dict[int, float] = {}
+        for f in sorted(fragments, key=lambda f: (f.id == 0, f.id)):
+            sp = self._frag_stats(est, by_id)
+            try:
+                est[f.id] = float(sp.estimate(f.root).rows)
+            except Exception:
+                continue
+            rule = self._injector.rules.get("stats_estimate")
+            if rule is not None and self._injector.fires(
+                "stats_estimate", key=f"fragment.{f.id}"
+            ):
+                factor = float(rule.get("factor", 10.0)) or 1.0
+                est[f.id] = est[f.id] / factor
+        return est
+
+    def _maybe_replan(
+        self,
+        plan: P.PlanNode,
+        f: PlanFragment,
+        fragments: List[PlanFragment],
+        by_id,
+        est: Dict[int, float],
+        committed: Dict[int, List[str]],
+    ) -> Optional[P.PlanNode]:
+        """The topology-CHANGING adaptive tier (AdaptivePlanner's
+        partitioned/broadcast rule): when the just-committed fragment's
+        observed output rows diverge from the static estimate by more
+        than adaptive_replan_factor (either direction), re-cost the
+        not-yet-run remainder with every observation substituted for its
+        estimate; if that flips a pending inner join's build side across
+        broadcast_join_threshold_rows, rewrite the ORIGINAL plan with the
+        corrected Join.distribution and hand it back for
+        re-fragmentation (committed stages are reused by signature)."""
+        try:
+            factor = float(
+                self.properties.get("adaptive_replan_factor") or 0.0
+            )
+        except (TypeError, ValueError):
+            return None
+        if factor <= 0 or self.metadata is None:
+            return None
+        e = est.get(f.id)
+        if e is None or e <= 0:
+            return None
+        o = max(float(self.output_rows.get(f.id, 0)), 1.0)
+        if max(o / e, e / o) < factor:
+            return None
+        from ..config import BROADCAST_JOIN_THRESHOLD_ROWS
+
+        try:
+            threshold = int(
+                self.properties.get("broadcast_join_threshold_rows")
+                or BROADCAST_JOIN_THRESHOLD_ROWS
+            )
+        except (TypeError, ValueError):
+            threshold = BROADCAST_JOIN_THRESHOLD_ROWS
+        # corrected fragment estimates: observed rows where we have them,
+        # re-derived estimates (over the corrected inputs) elsewhere
+        corrected: Dict[int, float] = {}
+        for g in sorted(fragments, key=lambda x: (x.id == 0, x.id)):
+            if g.id in committed and g.id in self.output_rows:
+                corrected[g.id] = max(float(self.output_rows[g.id]), 1.0)
+            else:
+                try:
+                    corrected[g.id] = float(
+                        self._frag_stats(corrected, by_id)
+                        .estimate(g.root).rows
+                    )
+                except Exception:
+                    corrected[g.id] = est.get(g.id, 1.0)
+        sp_old = self._frag_stats(est, by_id)
+        sp_new = self._frag_stats(corrected, by_id)
+        for g in fragments:
+            if g.id in committed:
+                continue
+            found = self._find_distribution_flip(
+                g.root, sp_old, sp_new, threshold
+            )
+            if found is None:
+                continue
+            join, new_dist, old_rows, new_rows = found
+            new_plan = self._flip_join_distribution(plan, join, new_dist)
+            if new_plan is None:
+                continue
+            from ..utils.metrics import counter
+
+            counter("trino_tpu_stats_replan_total").inc()
+            self.adaptive_actions.append({
+                "action": "flip_join_distribution",
+                "fragment": g.id,
+                "diverged_fragment": f.id,
+                "estimated_rows": e,
+                "observed_rows": o,
+                "from": join.distribution,
+                "to": new_dist,
+                "build_rows_estimated": old_rows,
+                "build_rows_corrected": new_rows,
+            })
+            return new_plan
+        return None
+
+    def _find_distribution_flip(
+        self, root: P.PlanNode, sp_old, sp_new, threshold: int
+    ):
+        """First join under `root` whose corrected build-side rows land
+        on the other side of the broadcast threshold from its planned
+        distribution: (join, new_dist, old_rows, new_rows) or None."""
+        found = None
+
+        def walk(n: P.PlanNode):
+            nonlocal found
+            if found is not None:
+                return
+            if (
+                isinstance(n, P.Join)
+                and n.criteria
+                and n.kind in ("inner", "left")
+                and n.distribution in ("broadcast", "partitioned")
+            ):
+                try:
+                    old_r = float(sp_old.estimate(n.right).rows)
+                    new_r = float(sp_new.estimate(n.right).rows)
+                except Exception:
+                    old_r = new_r = None
+                if new_r is not None:
+                    if n.distribution == "broadcast" and new_r > threshold:
+                        found = (n, "partitioned", old_r, new_r)
+                        return
+                    if (
+                        n.distribution == "partitioned"
+                        and new_r <= threshold
+                        and old_r > threshold
+                    ):
+                        found = (n, "broadcast", old_r, new_r)
+                        return
+            for s in n.sources:
+                walk(s)
+
+        walk(root)
+        return found
+
+    def _flip_join_distribution(
+        self, plan: P.PlanNode, join: P.Join, dist: str
+    ) -> Optional[P.PlanNode]:
+        """Rewrite the first Join in the ORIGINAL plan matching the
+        fragment's copy (same kind/criteria/distribution — fragmentation
+        preserves symbols) with the corrected distribution."""
+        import dataclasses as dc
+
+        hit = False
+
+        def walk(n: P.PlanNode) -> P.PlanNode:
+            nonlocal hit
+            srcs = tuple(walk(s) for s in n.sources)
+            if srcs and any(a is not b for a, b in zip(srcs, n.sources)):
+                from ..plan.memo import _replace_sources
+
+                n = _replace_sources(n, srcs)
+            if (
+                not hit
+                and isinstance(n, P.Join)
+                and n.kind == join.kind
+                and n.criteria == join.criteria
+                and n.distribution == join.distribution
+            ):
+                hit = True
+                n = dc.replace(n, distribution=dist)
+            return n
+
+        try:
+            new_plan = walk(plan)
+        except Exception:
+            return None
+        return new_plan if hit else None
 
     def _start_attempt(
         self, query_id, f, task_index, attempt, frag_json, splits,
@@ -575,9 +916,7 @@ class FaultTolerantScheduler:
                     # consumer cannot succeed until the producer is healed
                     # — decommit + re-run it, then retry the consumer
                     # against the spliced-in fresh spool path
-                    self._heal_corrupt_spool(
-                        query_id, corrupt, committed, by_id
-                    )
+                    self._heal_corrupt_spool(corrupt)
                 # never block on a pending backup — it stays in the race;
                 # the next primary draws a fresh number from next_attempt
                 continue
@@ -598,30 +937,27 @@ class FaultTolerantScheduler:
             f"{self.max_attempts} attempts: {last_error}"
         )
 
-    def _heal_corrupt_spool(
-        self,
-        query_id: str,
-        path: str,
-        committed: Dict[int, List[str]],
-        by_id: Dict[int, PlanFragment],
-    ) -> bool:
+    def _heal_corrupt_spool(self, path: str) -> bool:
         """Retire the corrupt committed attempt owning `path` and re-run
         its producer task under fresh attempt numbers, splicing the new
-        spool dir into `committed`.  Returns True once the producer is
-        healthy again (including when a concurrent consumer got there
-        first); False when the path doesn't map to a healable stage."""
+        spool dir into the committed list every consumer reads.  Returns
+        True once the producer is healthy again (including when a
+        concurrent consumer got there first); False when the path doesn't
+        map to a healable stage."""
         # path: {base}/{query}/{fragment}/{task}.{attempt}/buffer_{id}.bin
         attempt_dir = os.path.dirname(os.path.abspath(path))
         frag_dir = os.path.dirname(attempt_dir)
         task_s, _, attempt_s = os.path.basename(attempt_dir).partition(".")
+        ctx = self._heal_ctx.get(frag_dir)
         try:
-            fid = int(os.path.basename(frag_dir))
             task_index = int(task_s)
         except ValueError:
             return False
-        ctx = self._stage_ctx.get(fid)
-        paths = committed.get(fid)
-        if ctx is None or paths is None or task_index >= len(paths):
+        if ctx is None:
+            return False
+        (f, frag_json, per_task_splits, out_buffers, paths,
+         epoch_qid, epoch_committed, epoch_by_id) = ctx
+        if task_index >= len(paths):
             return False
         with self._heal_lock:
             if os.path.abspath(paths[task_index]) != attempt_dir:
@@ -640,16 +976,15 @@ class FaultTolerantScheduler:
             except OSError:
                 pass
             attempt_base = max(used, default=-1) + 1
-            frag_json, per_task_splits, out_buffers = ctx
             new_path = self._run_task_with_retries(
-                query_id, by_id[fid], task_index, frag_json,
-                per_task_splits[task_index], out_buffers, committed,
-                by_id, attempt_base=attempt_base,
+                epoch_qid, f, task_index, frag_json,
+                per_task_splits[task_index], out_buffers, epoch_committed,
+                epoch_by_id, attempt_base=attempt_base,
             )
             paths[task_index] = new_path
             self.heal_actions.append({
                 "action": "respawn_corrupt_attempt",
-                "fragment": fid,
+                "fragment": f.id,
                 "task": task_index,
                 "corrupt_path": attempt_dir,
                 "healed_path": new_path,

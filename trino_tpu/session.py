@@ -126,6 +126,9 @@ class Session:
         # streaming fragment DAGs keyed by id(plan): re-fragmenting per
         # run would mint fresh plan objects and defeat jit-cache reuse
         self._fragment_cache: dict = {}
+        # ANALYZE run registry (system.runtime.table_stats backing store):
+        # (catalog, table) -> last run's shape + timings
+        self.analyzed_tables: dict = {}
 
     def create_catalog(self, name: str, connector: str, config: dict):
         self.catalogs.create_catalog(name, connector, config)
@@ -476,6 +479,8 @@ class Session:
                  "nulls_fraction": nfs, "row_count": rc,
                  "low_value": lows, "high_value": highs},
             )
+        if isinstance(stmt, ast.Analyze):
+            return self.execute_analyze(stmt, identity)
         if isinstance(stmt, ast.ShowCreateTable):
             catalog, schema = self.metadata.resolve_table(
                 stmt.table, self.default_catalog
@@ -828,6 +833,83 @@ class Session:
         with self.tracer.span("optimize"):
             plan = optimize(plan, self.metadata, self.properties)
         return plan
+
+    # -- ANALYZE (stats/ collection) -----------------------------------
+    def execute_analyze(self, stmt, identity=None, execute_plan=None):
+        """ANALYZE <table> [(cols)]: collect, store, register.  The
+        coordinator passes `execute_plan` to run the synthesized
+        aggregations through the distributed fragment scheduler instead
+        of the in-process executor."""
+        if identity is None:
+            identity = self.identity
+        catalog, schema = self.metadata.resolve_table(
+            stmt.table, self.default_catalog
+        )
+        self.access_control.check_can_select(
+            identity, catalog, schema.name,
+            list(stmt.columns) or [c.name for c in schema.columns],
+        )
+        started = time.time()
+        stats = self.collect_statistics(
+            catalog, schema, stmt.columns, execute_plan=execute_plan
+        )
+        version = self.metadata.store_table_statistics(
+            catalog, schema.name, stats
+        )
+        self.record_analyze(
+            catalog, schema.name,
+            stmt.columns or tuple(c.name for c in schema.columns),
+            stats, version, started,
+        )
+        return page_from_pydict(
+            [("rows", T.BIGINT)], {"rows": [int(stats.row_count)]}
+        )
+
+    def collect_statistics(self, catalog: str, schema, columns=(),
+                           execute_plan=None):
+        """Run the synthesized ANALYZE aggregations and assemble a
+        TableStatistics.  The collection is ordinary SQL through the
+        normal planner (QueryPlanner.planStatisticsAggregation analog),
+        so under distributed=true the HLL/KMV partial-final merge rides
+        the mesh like any aggregation; `execute_plan` lets the
+        coordinator dispatch the same plans through its scheduler."""
+        from .stats import analyze_queries, assemble, column_tasks
+
+        buckets = max(1, int(self.properties.get("analyze_histogram_buckets")))
+        tasks = column_tasks(schema, columns)
+        qualified = f"{catalog}.default.{schema.name}"
+        if execute_plan is None:
+            executor = self._executor()
+            execute_plan = executor.execute
+        chunk_results = []
+        with self.tracer.span("analyze-collect", table=qualified):
+            for csql, chunk in analyze_queries(qualified, tasks, buckets):
+                page = execute_plan(self._plan_stmt(parse(csql)))
+                row = [
+                    c.to_python(page.count)[0] if page.count else None
+                    for c in page.columns
+                ]
+                chunk_results.append((chunk, row))
+        return assemble(chunk_results, buckets)
+
+    def record_analyze(self, catalog: str, table: str, columns,
+                       stats, data_version: int, started: float) -> None:
+        """Registry entry + invalidation after statistics storage: cached
+        plans were costed without these stats."""
+        from .utils.metrics import counter
+
+        self.analyzed_tables[(catalog, table)] = {
+            "catalog": catalog,
+            "table": table,
+            "columns": tuple(columns),
+            "row_count": float(stats.row_count),
+            "data_version": int(data_version),
+            "analyzed_at": started,
+            "duration_s": max(0.0, time.time() - started),
+        }
+        self._plan_cache.clear()
+        self._capacity_hints.clear()
+        counter("trino_tpu_stats_analyze_total").inc()
 
 
 def tpch_session(sf: float = 0.01, **config) -> Session:

@@ -43,12 +43,18 @@ class TableSchema:
 
 @dataclasses.dataclass(frozen=True)
 class ColumnStatistics:
-    """Per-column stats for the CBO (spi/statistics/ColumnStatistics)."""
+    """Per-column stats for the CBO (spi/statistics/ColumnStatistics).
+
+    ``histogram`` is an optional equi-height histogram — a tuple of
+    ``(low, high, fraction)`` buckets over the non-null rows (plain
+    tuples: hashable and JSON-round-trippable for persistence) —
+    produced by ANALYZE from the device-sort quantiles."""
 
     distinct_count: Optional[float] = None
     null_fraction: float = 0.0
     min_value: Optional[float] = None
     max_value: Optional[float] = None
+    histogram: Optional[Tuple[Tuple[float, float, float], ...]] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -80,6 +86,19 @@ class ConnectorMetadata:
 
     def get_table_statistics(self, table: str) -> TableStatistics:
         raise NotImplementedError
+
+    def store_table_statistics(
+        self, table: str, stats: TableStatistics, data_version: int
+    ) -> None:
+        """Persist ANALYZE results keyed by the table's data_version
+        (ConnectorMetadata.finishStatisticsCollection analog).  A later
+        get_table_statistics MUST NOT serve these once data_version has
+        moved on — DML invalidates stats exactly like it invalidates the
+        result cache.  Connectors without durable storage may leave this
+        unimplemented; the engine keeps a session-side overlay instead."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not store statistics"
+        )
 
     # -- writes (ConnectorMetadata.beginCreateTable/beginInsert/...; a
     # connector that leaves these unimplemented is read-only) ----------

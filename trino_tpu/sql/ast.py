@@ -563,6 +563,14 @@ class ShowStats(Node):
 
 
 @dataclasses.dataclass(frozen=True)
+class Analyze(Node):
+    """ANALYZE table [(col, ...)] — collect table/column statistics."""
+
+    table: Tuple[str, ...]
+    columns: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
 class ShowCreateTable(Node):
     table: Tuple[str, ...]
 

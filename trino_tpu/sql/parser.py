@@ -166,6 +166,17 @@ class Parser:
             q = self.parse_query()
             self._finish()
             return ast.Explain(q, analyze, plan_type)
+        if self.accept_kw("analyze"):
+            name = self.qualified_name()
+            columns = []
+            if self.accept_op("("):
+                while True:
+                    columns.append(self.ident())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            self._finish()
+            return ast.Analyze(name, tuple(columns))
         if self.accept_kw("show"):
             if self.accept_kw("tables"):
                 self._finish()

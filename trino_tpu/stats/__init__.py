@@ -1,0 +1,28 @@
+"""Table & column statistics subsystem.
+
+Reference parity: the statistics half of the CBO loop — ANALYZE
+(sql/rewrite/StatementRewrite -> QueryPlanner.planStatisticsAggregation
+building a distributed aggregation over the table), the
+spi/statistics surface (TableStatistics / ColumnStatistics /
+TableStatisticsMetadata), and the StatsCalculator consumption side in
+sql/planner/cost/.  Collection here is literally a synthesized SQL
+aggregation run through the normal planner and executors, so the
+on-device reductions (count / min / max / HLL NDV / KMV quantiles)
+split PARTIAL/FINAL across workers like any other aggregation.
+"""
+from .analyze import ColumnTask, analyze_queries, assemble, column_tasks
+from .histogram import (
+    equi_height_from_quantiles,
+    le_fraction,
+    range_fraction,
+)
+
+__all__ = [
+    "ColumnTask",
+    "analyze_queries",
+    "assemble",
+    "column_tasks",
+    "equi_height_from_quantiles",
+    "le_fraction",
+    "range_fraction",
+]

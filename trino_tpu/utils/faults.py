@@ -38,6 +38,8 @@ SITES = (
     "cache_read",           # corrupt a spilled result-cache frame on read
     "oom",                  # memory reservation behaves as if the pool
                             # were exhausted (LocalMemoryManager tier)
+    "stats_estimate",       # skew a fragment's estimated output rows by
+                            # rule field `factor` (adaptive-replan tests)
 )
 
 

@@ -37,6 +37,7 @@ METRIC_SUBSYSTEMS = (
     "kernel",
     "event",
     "memory",
+    "stats",
 )
 
 METRIC_NAME_RE = re.compile(
