@@ -300,6 +300,30 @@ def _backend() -> str:
         return jax.devices()[0].platform
 
 
+def _crash_forensics() -> dict:
+    """Last supervised-dispatch breadcrumb + CPU-fallback tallies from the
+    device supervisor (runtime/supervisor.py).  Persisted for crashed
+    configs so a post-mortem can name the culprit kernel without rerunning
+    the bench; also says whether degraded CPU execution got anywhere."""
+    out = {}
+    try:
+        from trino_tpu.runtime import fallback_counts, last_breadcrumb
+
+        bc = last_breadcrumb()
+        if bc is not None:
+            out["last_dispatch"] = bc
+        fb = fallback_counts()
+        if fb.get("attempted"):
+            out["cpu_fallback"] = {
+                "attempted": fb["attempted"],
+                "completed": fb["completed"],
+                "degraded_run_completed": fb["completed"] >= fb["attempted"],
+            }
+    except Exception:  # noqa: BLE001 — forensics must never mask the crash
+        pass
+    return out
+
+
 def _safe(fn):
     """One config failing (tunnel crash, OOM, budget alarm) must not kill
     the whole bench: record the error and keep measuring the rest."""
@@ -307,9 +331,11 @@ def _safe(fn):
         return fn()
     except BudgetExceeded:
         _STOP["flag"] = True
-        return {"error": "budget_timeout: BENCH_BUDGET_S reached mid-config"}
+        return {"error": "budget_timeout: BENCH_BUDGET_S reached mid-config",
+                **_crash_forensics()}
     except Exception as e:  # noqa: BLE001
-        return {"error": f"{type(e).__name__}: {str(e)[:160]}"}
+        return {"error": f"{type(e).__name__}: {str(e)[:160]}",
+                **_crash_forensics()}
 
 
 def _cache_counts(session):

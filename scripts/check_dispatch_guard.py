@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Lint: no naked device dispatch in the execution or server layers.
+
+Every kernel launch and device sync in ``trino_tpu/exec/`` and
+``trino_tpu/server/`` must go through the fault supervisor
+(``trino_tpu/runtime/supervisor.py``) so that a device loss or wedge is
+attributed to a kernel breadcrumb, quarantines the device, and triggers
+degraded CPU execution — a raw ``jax.jit(...)``/``jax.device_get(...)``
+call site would crash the process with no forensics and no fallback.
+
+A site that is deliberately unsupervised (e.g. the lazy ``jax.jit``
+wrapper whose actual dispatch IS routed through the supervisor, or a
+CPU-only sync) carries a ``# dispatch-guard: ok`` marker on the same
+line, with a comment nearby saying why.
+
+Run standalone (``python scripts/check_dispatch_guard.py``, exit 1 on
+violations) or as a fast test (tests/test_supervisor.py wraps it).
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# device dispatch / sync entry points that must be supervised; matched per
+# line so the opt-out marker can be checked on the same line
+DISPATCH_RE = re.compile(
+    r"\bjax\.(?:jit|device_get|block_until_ready|device_put)\s*\("
+)
+OK_MARKER = "# dispatch-guard: ok"
+
+# only the layers that execute queries on devices; connectors build their
+# own jitted generators (pure data synthesis) and runtime/ IS the guard
+SCAN_DIRS = (
+    os.path.join("trino_tpu", "exec"),
+    os.path.join("trino_tpu", "server"),
+)
+
+
+def iter_source_files(root: str):
+    for d in SCAN_DIRS:
+        base = os.path.join(root, d)
+        for dirpath, _dirnames, filenames in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def check_tree(root: str):
+    """Returns (checked_count, violations) over the guarded layers."""
+    checked = 0
+    violations = []
+    for path in iter_source_files(root):
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+        checked += 1
+        for lineno, line in enumerate(lines, start=1):
+            m = DISPATCH_RE.search(line)
+            if m is None:
+                continue
+            if OK_MARKER in line:
+                continue
+            rel = os.path.relpath(path, root)
+            violations.append((rel, lineno, m.group(0).rstrip("(").strip()))
+    return checked, violations
+
+
+def main() -> int:
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    checked, violations = check_tree(root)
+    if violations:
+        for rel, lineno, call in violations:
+            print(
+                f"{rel}:{lineno}: naked device dispatch {call!r} — route "
+                "through DeviceSupervisor.dispatch()/device_get() or mark "
+                f"the line with '{OK_MARKER}' and justify it"
+            )
+        return 1
+    print(f"ok: {checked} files free of unsupervised device dispatch")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

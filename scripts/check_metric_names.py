@@ -2,8 +2,9 @@
 """Lint: every metric name in the tree follows the naming convention.
 
 Convention: ``trino_tpu_<subsystem>_<name>`` ending in ``_total`` (event
-counts), ``_bytes`` (byte counters), or ``_seconds`` (histograms), with
-``<subsystem>`` drawn from the known set in ``trino_tpu.utils.metrics``.
+counts), ``_bytes`` (byte counters), ``_seconds`` (histograms), or
+``_state`` (state-machine gauges), with ``<subsystem>`` drawn from the
+known set in ``trino_tpu.utils.metrics``.
 The registry enforces this at runtime; this lint catches names at rest in
 the source — including ones on code paths tests never execute.
 
@@ -29,7 +30,7 @@ REGISTRATION_RE = re.compile(
 # bare prefixed literals elsewhere still get a looser check: anything that
 # LOOKS like a metric (ends in a unit suffix) must conform fully
 LITERAL_RE = re.compile(
-    r'["\'](trino_tpu_[a-z0-9_]+_(?:total|bytes|seconds))["\']'
+    r'["\'](trino_tpu_[a-z0-9_]+_(?:total|bytes|seconds|state))["\']'
 )
 # memory-subsystem literals are checked unconditionally (suffix or not):
 # the trino_tpu_memory_* gauges are scraped by dashboards keyed on the
@@ -89,7 +90,7 @@ def main() -> int:
         for rel, lineno, name in violations:
             print(
                 f"{rel}:{lineno}: metric name {name!r} violates "
-                "trino_tpu_<subsystem>_<name>{_total|_bytes|_seconds}"
+                "trino_tpu_<subsystem>_<name>{_total|_bytes|_seconds|_state}"
             )
         return 1
     print(f"ok: {checked} metric-name literals conform")

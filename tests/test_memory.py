@@ -472,6 +472,13 @@ def test_cluster_low_memory_killer_end_to_end():
             what="killer verdict on query A",
         )
         assert qa.error == CLUSTER_OOM_MESSAGE
+        # the kill record lands AFTER the kill callback returns (the
+        # callback fails the query first, then fans the verdict out to
+        # workers) — wait for it instead of racing the enforcement thread
+        _wait_until(
+            lambda: co.cluster_memory.kills, timeout=30.0,
+            what="kill recorded by the cluster manager",
+        )
         kills = co.cluster_memory.kills
         assert [k["queryId"] for k in kills] == [qa.query_id]
         assert kills[0]["policy"] == "total-reservation-on-blocked-nodes"

@@ -176,6 +176,30 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             str, "",
         ),
         PropertyMetadata(
+            "device_fault_max_strikes",
+            "device faults inside the strike window before the device is "
+            "blacklisted for the process lifetime",
+            int, 3,
+        ),
+        PropertyMetadata(
+            "device_probe_backoff_s",
+            "base backoff between canary re-probes of a quarantined "
+            "device (doubles per failure, capped)",
+            float, 1.0,
+        ),
+        PropertyMetadata(
+            "device_watchdog_timeout_s",
+            "watchdog timeout on the supervised kernel-dispatch thread; "
+            "a dispatch exceeding it is treated as a device wedge (0=off)",
+            float, 60.0,
+        ),
+        PropertyMetadata(
+            "device_cpu_fallback",
+            "degraded mode: re-run fragments on the CPU backend after a "
+            "device fault instead of failing the task",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "reorder_joins",
             "stats-based join-graph reordering (ReorderJoins / "
             "EliminateCrossJoins analogs); off keeps the FROM order",

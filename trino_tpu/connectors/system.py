@@ -51,6 +51,10 @@ SCHEMAS: Dict[str, List] = {
         ("node_id", T.VARCHAR),
         ("http_uri", T.VARCHAR),
         ("state", T.VARCHAR),
+        # device-fault supervisor health (runtime/supervisor.py):
+        # ACTIVE/DEGRADED/QUARANTINED + strikes toward the blacklist
+        ("device_state", T.VARCHAR),
+        ("device_strikes", T.BIGINT),
     ],
     "views": [
         ("table_catalog", T.VARCHAR),
@@ -182,23 +186,44 @@ class _SystemSource:
                 "error": [h.get("error") for h in hist],
             }
         if table == "nodes":
+            def device_cols(dev):
+                if not dev:
+                    return "ACTIVE", 0
+                strikes = sum(
+                    int(d.get("strikes", 0))
+                    for d in (dev.get("devices") or [])
+                )
+                return dev.get("state", "ACTIVE"), strikes
+
             nodes = []
             nm = getattr(s, "node_manager", None)
             if nm is not None:
                 alive = {n for n, _ in nm.alive()}
                 with nm.lock:
-                    known = [(n.node_id, n.uri) for n in nm.nodes.values()]
-                for node_id, uri in known:
+                    known = [
+                        (n.node_id, n.uri, n.device)
+                        for n in nm.nodes.values()
+                    ]
+                for node_id, uri, dev in known:
+                    dstate, strikes = device_cols(dev)
                     nodes.append(
                         (node_id, uri,
-                         "active" if node_id in alive else "inactive")
+                         "active" if node_id in alive else "inactive",
+                         dstate, strikes)
                     )
             else:
-                nodes.append(("local", "local://", "active"))
+                sup = getattr(s, "device_supervisor", None)
+                dstate, strikes = device_cols(
+                    sup.snapshot() if sup is not None else None
+                )
+                nodes.append(("local", "local://", "active",
+                              dstate, strikes))
             return {
                 "node_id": [n[0] for n in nodes],
                 "http_uri": [n[1] for n in nodes],
                 "state": [n[2] for n in nodes],
+                "device_state": [n[3] for n in nodes],
+                "device_strikes": [n[4] for n in nodes],
             }
         if table == "session_properties":
             rows = s.properties.show()

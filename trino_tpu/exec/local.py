@@ -40,11 +40,34 @@ from ..ops import sort as sort_ops
 from ..ops import window as window_ops
 from ..page import Column, Page, pad_to
 from ..plan import nodes as P
+from ..runtime import Breadcrumb, DeviceFaultError, default_supervisor
 from ..spi import Split
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 
 DEFAULT_GROUP_CAPACITY = 4096
+
+
+def _shape_summary(tree, limit: int = 24) -> dict:
+    """Compact ``lane -> dtype[shape]`` summary of a dispatch's inputs,
+    recorded in the crash-forensics breadcrumb before the dispatch."""
+    out: dict = {}
+
+    def add(name, v):
+        if len(out) < limit and hasattr(v, "shape") and hasattr(v, "dtype"):
+            out[name] = "%s%s" % (v.dtype, tuple(v.shape))
+
+    for k, lanes in (tree or {}).items():
+        if isinstance(lanes, dict):
+            for s, v in lanes.items():
+                if isinstance(v, tuple):
+                    for i, vi in enumerate(v):
+                        add("%s.%s.%d" % (k, s, i), vi)
+                else:
+                    add("%s.%s" % (k, s), v)
+        else:
+            add(str(k), lanes)
+    return out
 
 
 class DeviceScanCache:
@@ -306,12 +329,106 @@ class LocalExecutor:
         # scan-node id -> on-device generation spec (connector-provided;
         # lanes materialize in HBM, no host arrays exist)
         self._devgen: Dict[int, dict] = {}
+        # supervised dispatch boundary: session/worker-owned supervisor
+        # when wired, process default otherwise (bare executors in tests)
+        self.supervisor = self.config.get("device_supervisor") \
+            or default_supervisor()
+        self.device_bytes = 0
+        # True while re-executing on the CPU backend after a device fault:
+        # dispatches bypass supervision (the watchdog side thread would
+        # escape the thread-local jax.default_device context).  Inherited
+        # through the config so spill/streaming sub-executors created
+        # mid-fallback stay on the CPU path too.
+        self._device_fallback = bool(self.config.get("_in_device_fallback"))
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
         assert isinstance(plan, P.Output)
         if isinstance(plan.source, P.TableWriter):
             return self._execute_write(plan.source)
+        sup = self.supervisor
+        if not self._device_fallback:
+            sup.maybe_probe()
+            if not sup.healthy():
+                # device already out: degrade up front (or refuse with
+                # the structured error when fallback is disabled)
+                bc = Breadcrumb(
+                    "pre-dispatch", query_id=self.query_id,
+                    task_id=str(self.config.get("task_id") or ""),
+                    mode="gate",
+                )
+                fault = DeviceFaultError(
+                    "device_" + sup.device_state().lower(), bc
+                )
+                if not self._cpu_fallback_enabled():
+                    raise fault
+                return self._run_cpu_fallback(plan, fault)
+        try:
+            return self._execute_inner(plan)
+        except DeviceFaultError:
+            if self._device_fallback or not self._cpu_fallback_enabled():
+                raise
+            return self._run_cpu_fallback(plan, None)
+
+    def _cpu_fallback_enabled(self) -> bool:
+        v = self.config.get("device_cpu_fallback", True)
+        if isinstance(v, str):
+            v = v.strip().lower() not in ("false", "0", "no", "off", "")
+        return bool(v)
+
+    def _run_cpu_fallback(self, plan: P.PlanNode, fault) -> Page:
+        """Degraded mode: re-run the whole fragment eagerly on the CPU
+        backend.  The faulted device's compiled programs and cached
+        device arrays are unusable, so jit and the scan cache are
+        disabled for the retry; the supervisor keeps advertising the
+        sick device so schedulers route around this node meanwhile."""
+        sup = self.supervisor
+        sup.note_fallback_attempt()
+        orig_config = self.config
+        cfg = dict(orig_config)
+        cfg["jit_fragments"] = False
+        cfg["scan_cache"] = None
+        cfg["device_generation"] = False
+        cfg["_in_device_fallback"] = True
+        self.config = cfg
+        self._preloaded = None
+        self._device_fallback = True
+        try:
+            with jax.default_device(jax.devices("cpu")[0]):
+                page = self.execute(plan)
+            sup.note_fallback_completed()
+            return page
+        finally:
+            self.config = orig_config
+            self._device_fallback = False
+
+    # -- supervised dispatch helpers -----------------------------------
+    def _dispatch_crumb(self, kernel: str, mode: str, tree=None) -> Breadcrumb:
+        bc = Breadcrumb(
+            kernel,
+            query_id=self.query_id,
+            task_id=str(self.config.get("task_id") or ""),
+            mode=mode,
+            shapes=_shape_summary(tree),
+            hbm_reserved_bytes=getattr(self, "device_bytes", 0),
+        )
+        # forensics ride the per-query kernel profile too (EXPLAIN
+        # ANALYZE / /v1/query/{id}/profile / bench artifacts)
+        self.kernel_profile["last_breadcrumb"] = bc.to_dict()
+        return bc
+
+    def _dispatch(self, thunk, bc: Breadcrumb):
+        if self._device_fallback:
+            return thunk()
+        return self.supervisor.dispatch(thunk, bc)
+
+    def _device_get(self, objs, bc: Breadcrumb):
+        if self._device_fallback:
+            return jax.device_get(objs)  # dispatch-guard: ok
+        return self.supervisor.device_get(objs, bc)
+
+    # ------------------------------------------------------------------
+    def _execute_inner(self, plan: P.PlanNode) -> Page:
         # out-of-core path: when the estimated scan working set exceeds the
         # memory limit and the plan allows it, aggregate in split batches
         # (MemoryRevokingScheduler -> spill, host RAM as the spill tier)
@@ -454,8 +571,12 @@ class LocalExecutor:
                     else:
                         eager_start = time.time()
                         ctx = self.trace_ctx_cls(self, scans, counts)
-                        out_lanes, sel, ordered, checks = self._run(
-                            plan, ctx
+                        bc = self._dispatch_crumb(
+                            "eager-%d" % attempt, "eager", scans
+                        )
+                        self._last_crumb = bc
+                        out_lanes, sel, ordered, checks = self._dispatch(
+                            lambda: self._run(plan, ctx), bc
                         )
                         dups = ctx.dup_checks
                         colls = ctx.collision_checks
@@ -470,12 +591,17 @@ class LocalExecutor:
                             cached=False,
                             mode="eager",
                         )
+                    last = getattr(self, "_last_crumb", None)
                     (dup_vals, check_vals, coll_vals, wide_vals,
-                     sflag_vals, host_lanes, sel_np) = jax.device_get(
+                     sflag_vals, host_lanes, sel_np) = self._device_get(
                         ([d for _, d in dups],
                          [ng for ng, _, _ in checks],
                          list(colls), list(wides), list(sflags),
-                         {s: out_lanes[s] for s in plan.symbols}, sel)
+                         {s: out_lanes[s] for s in plan.symbols}, sel),
+                        self._dispatch_crumb(
+                            last.kernel if last else "device_get",
+                            "device_get",
+                        ),
                     )
                 except jax.errors.JaxRuntimeError as e:
                     # axon tunnel executable-reuse fault: the poisoned
@@ -1268,9 +1394,11 @@ class LocalExecutor:
                 )
 
             compile_start = time.time()
+            bc = self._dispatch_crumb(digest, "jit", prep)
+            self._last_crumb = bc
             with TRACER.span("xla_compile", fragment=digest):
-                fn = jax.jit(raw)
-                out = fn(prep)
+                fn = jax.jit(raw)  # dispatch-guard: ok (lazy wrapper)
+                out = self._dispatch(lambda: fn(prep), bc)
             self._record_kernel(
                 digest, compile_s=time.time() - compile_start, cached=False
             )
@@ -1285,7 +1413,9 @@ class LocalExecutor:
             # execute() loop's device_get, whose handler evicts the
             # poisoned entry and recompiles exactly once (INVALID_ARGUMENT
             # only, never OOM)
-            out = entry["fn"](prep)
+            bc = self._dispatch_crumb(digest, "jit", prep)
+            self._last_crumb = bc
+            out = self._dispatch(lambda: entry["fn"](prep), bc)
             self._record_kernel(digest, compile_s=0.0, cached=True)
         out_lanes, sel, ngroups, dup_vals, colls, wides, sflags = out
         checks = [
@@ -1308,8 +1438,12 @@ class LocalExecutor:
     def _materialize(self, plan: P.Output, lanes, sel, ordered) -> Page:
         # single device->host transfer for the selection mask and every
         # output lane (per-array np.asarray would pay one tunnel RTT each)
-        host_lanes, sel_np = jax.device_get(
-            ({s: lanes[s] for s in plan.symbols}, sel)
+        last = getattr(self, "_last_crumb", None)
+        host_lanes, sel_np = self._device_get(
+            ({s: lanes[s] for s in plan.symbols}, sel),
+            self._dispatch_crumb(
+                last.kernel if last else "materialize", "device_get"
+            ),
         )
         return self._materialize_host(plan, host_lanes, sel_np)
 
@@ -1362,7 +1496,9 @@ class _TraceCtx:
 
         t0 = _time.perf_counter()
         b = m(node)
-        jax.block_until_ready((b.sel,))
+        # EXPLAIN ANALYZE timing sync; runs inside the supervised eager
+        # dispatch, so it is already covered by the boundary
+        jax.block_until_ready((b.sel,))  # dispatch-guard: ok
         wall = _time.perf_counter() - t0
         st = self.ex.node_stats.setdefault(
             id(node), {"rows": 0, "wall_s": 0.0, "calls": 0}

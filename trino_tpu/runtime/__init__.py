@@ -1,0 +1,16 @@
+"""Runtime supervision: the crash-containment boundary around the TPU.
+
+``runtime/supervisor.py`` owns the supervised kernel-dispatch boundary —
+every device execution in ``exec/`` crosses it, so a device loss or wedge
+is attributed (breadcrumb naming the culprit kernel), contained (device
+quarantine + blacklist), and survived (degraded CPU execution).
+"""
+from .supervisor import (  # noqa: F401
+    Breadcrumb,
+    DeviceFaultError,
+    DeviceSupervisor,
+    default_supervisor,
+    fallback_counts,
+    last_breadcrumb,
+    reset_default_supervisor,
+)

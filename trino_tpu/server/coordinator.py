@@ -105,6 +105,9 @@ class Coordinator:
             )
         )
         session.cluster_memory = self.cluster_memory
+        # system.runtime.nodes reads announced node + device health
+        # through the session (coordinator_only system scans)
+        session.node_manager = self.node_manager
         # memory admission gate (resource-group softMemoryLimit role):
         # queries wait in QUEUED until their estimated peak fits
         self.admission = MemoryAdmissionController(self._memory_capacity)
@@ -394,6 +397,15 @@ class Coordinator:
                         props.get("adaptive_replan_factor"),
                     "broadcast_join_threshold_rows":
                         props.get("broadcast_join_threshold_rows"),
+                    # device-fault supervision (runtime/supervisor.py)
+                    "device_fault_max_strikes":
+                        props.get("device_fault_max_strikes"),
+                    "device_probe_backoff_s":
+                        props.get("device_probe_backoff_s"),
+                    "device_watchdog_timeout_s":
+                        props.get("device_watchdog_timeout_s"),
+                    "device_cpu_fallback":
+                        props.get("device_cpu_fallback"),
                 }
                 try:
                     # the query span parents every scheduler dispatch made
@@ -418,6 +430,7 @@ class Coordinator:
                             sched = DistributedScheduler(
                                 self.session.catalogs, workers, task_props,
                                 memory_view=self.cluster_memory,
+                                node_manager=self.node_manager,
                             )
                             page = sched.run(plan, q.query_id)
                             # per-task stats rollup (TaskStats -> QueryStats)
@@ -479,7 +492,8 @@ class Coordinator:
             )
             try:
                 sched = DistributedScheduler(
-                    self.session.catalogs, workers, task_props
+                    self.session.catalogs, workers, task_props,
+                    node_manager=self.node_manager,
                 )
                 page = sched.run(plan, qid)
                 q.task_stats = getattr(sched, "last_task_stats", [])
@@ -659,7 +673,8 @@ class _Handler(BaseHTTPRequestHandler):
             doc = json.loads(self.rfile.read(n))
             if self.coordinator.node_manager is not None:
                 self.coordinator.node_manager.announce(
-                    doc["nodeId"], doc["uri"], memory=doc.get("memory")
+                    doc["nodeId"], doc["uri"], memory=doc.get("memory"),
+                    device=doc.get("device"),
                 )
                 if doc.get("memory"):
                     self.coordinator.cluster_memory.update_node(
@@ -720,6 +735,9 @@ class _Handler(BaseHTTPRequestHandler):
                 "nodeVersion": {"version": "trino-tpu 0.1"},
                 "environment": "tpu",
                 "coordinator": True,
+                # the coordinator's in-process executor dispatches through
+                # the session supervisor; report its device health too
+                "device": co.session.device_supervisor.snapshot(),
                 "uptime": f"{time.time() - co.started:.0f}s",
             })
             return

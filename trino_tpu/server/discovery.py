@@ -29,6 +29,10 @@ class NodeState:
         # latest pool snapshot piggybacked on the announcement (consumed
         # by the coordinator-side ClusterMemoryManager)
         self.memory: Optional[dict] = None
+        # latest device-health snapshot (runtime/supervisor.py): node
+        # state ACTIVE/DEGRADED/QUARANTINED + per-device strike counts,
+        # consumed by scheduler placement and system.runtime.nodes
+        self.device: Optional[dict] = None
 
 
 class NodeManager:
@@ -39,7 +43,8 @@ class NodeManager:
         self.lock = threading.Lock()
 
     def announce(self, node_id: str, uri: str,
-                 memory: Optional[dict] = None):
+                 memory: Optional[dict] = None,
+                 device: Optional[dict] = None):
         with self.lock:
             n = self.nodes.get(node_id)
             if n is None:
@@ -49,6 +54,8 @@ class NodeManager:
             n.last_announced = time.time()
             if memory is not None:
                 n.memory = memory
+            if device is not None:
+                n.device = device
 
     def record_ping(self, node_id: str, ok: bool):
         with self.lock:
@@ -70,6 +77,17 @@ class NodeManager:
                 and n.failure_ratio < FAILURE_RATIO_THRESHOLD
             ]
         return sorted(out)
+
+    def device_states(self) -> Dict[str, dict]:
+        """node_id -> latest announced device-health snapshot (nodes
+        that predate the supervisor, or haven't announced one, are
+        absent — callers treat missing as healthy)."""
+        with self.lock:
+            return {
+                n.node_id: n.device
+                for n in self.nodes.values()
+                if n.device is not None
+            }
 
     def all_nodes(self) -> List[NodeState]:
         """Live view for the heartbeat loop; prunes long-dead entries so

@@ -735,7 +735,17 @@ class FaultTolerantScheduler:
         workers = self.node_manager.alive()
         if not workers:
             raise SchedulerError("NO_NODES_AVAILABLE during retry")
-        candidates = [w for w in workers if w[1] != exclude_uri] or workers
+        # quarantined nodes (device out, no CPU fallback) are skipped;
+        # DEGRADED nodes stay eligible for retries — slow beats failed
+        try:
+            device = self.node_manager.device_states()
+        except Exception:
+            device = {}
+        healthy = [
+            w for w in workers
+            if (device.get(w[0]) or {}).get("state") != "QUARANTINED"
+        ] or workers
+        candidates = [w for w in healthy if w[1] != exclude_uri] or healthy
         node_id, uri = candidates[(task_index + attempt) % len(candidates)]
         sink = self.exchange.sink(query_id, f.id, task_index, attempt)
         task_id = f"{query_id}.{f.id}.{task_index}.{attempt}"
@@ -812,6 +822,9 @@ class FaultTolerantScheduler:
                     return b
             return None
 
+        # after a failed attempt the next primary steers off that worker
+        # (a device-lost task would otherwise land on the same sick node)
+        failed_uri = None
         while next_attempt < max_attempt:
             attempt = next_attempt
             next_attempt += 1
@@ -819,6 +832,7 @@ class FaultTolerantScheduler:
                 uri, task_id, sink = self._start_attempt(
                     query_id, f, task_index, attempt, frag_json, splits,
                     out_buffers, committed, by_id,
+                    exclude_uri=failed_uri,
                 )
             except SchedulerError:
                 raise
@@ -907,6 +921,7 @@ class FaultTolerantScheduler:
                 return sink.path
             except Exception as e:
                 last_error = e
+                failed_uri = uri
                 win = backup_winner()
                 if win is not None:
                     return win["path"]

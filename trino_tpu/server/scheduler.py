@@ -110,6 +110,7 @@ class DistributedScheduler:
         workers: List[Tuple[str, str]],
         properties: Optional[dict] = None,
         memory_view=None,
+        node_manager=None,
     ):
         if not workers:
             raise SchedulerError("no alive workers")
@@ -120,18 +121,50 @@ class DistributedScheduler:
         # the node with the most free pool bytes and avoids blocked
         # nodes (NodeScheduler memory-aware selection)
         self.memory_view = memory_view
+        # optional NodeManager: announced device-health snapshots route
+        # work away from DEGRADED nodes and off QUARANTINED ones
+        self.node_manager = node_manager
+
+    def _device_states(self) -> Dict[str, dict]:
+        if self.node_manager is None:
+            return {}
+        try:
+            return self.node_manager.device_states()
+        except Exception:
+            return {}
+
+    @staticmethod
+    def _health_rank(device: Optional[dict]) -> int:
+        """ACTIVE/unknown=2 > DEGRADED=1 > QUARANTINED=0.  Unknown ranks
+        with ACTIVE: a node that never announced device health predates
+        the supervisor and is presumed fine."""
+        state = (device or {}).get("state", "ACTIVE")
+        return {"QUARANTINED": 0, "DEGRADED": 1}.get(state, 2)
+
+    def _schedulable_workers(self) -> List[Tuple[str, str]]:
+        """Workers eligible for source/hash placement: QUARANTINED nodes
+        (all devices out, no CPU fallback) are excluded unless every
+        node is quarantined — then degrade to the full set rather than
+        refuse outright."""
+        device = self._device_states()
+        ok = [
+            w for w in self.workers
+            if self._health_rank(device.get(w[0])) > 0
+        ]
+        return ok or list(self.workers)
 
     def _pick_single_worker(self, query_id: str) -> Tuple[str, str]:
         fallback = self.workers[hash(query_id) % len(self.workers)]
-        if self.memory_view is None:
-            return fallback
-        try:
-            nodes = {
-                n.get("nodeId"): n
-                for n in self.memory_view.nodes_view()
-            }
-        except Exception:
-            return fallback
+        device = self._device_states()
+        nodes: Dict[str, dict] = {}
+        if self.memory_view is not None:
+            try:
+                nodes = {
+                    n.get("nodeId"): n
+                    for n in self.memory_view.nodes_view()
+                }
+            except Exception:
+                nodes = {}
 
         def headroom(w: Tuple[str, str]) -> int:
             snap = nodes.get(w[0])
@@ -144,10 +177,26 @@ class DistributedScheduler:
                 for p in (snap.get("pools") or {}).values()
             )
 
-        best = max(headroom(w) for w in self.workers)
-        if best < 0:
+        # device health dominates memory headroom: a DEGRADED node (CPU
+        # fallback) ranks below ANY ACTIVE node regardless of free bytes,
+        # and QUARANTINED nodes are excluded entirely
+        pool = [
+            w for w in self.workers
+            if self._health_rank(device.get(w[0])) > 0
+        ]
+        if not pool:
             return fallback
-        candidates = [w for w in self.workers if headroom(w) == best]
+        best = max(
+            (self._health_rank(device.get(w[0])), headroom(w))
+            for w in pool
+        )
+        if best[1] < 0 and len(pool) == len(self.workers) and best[0] >= 2:
+            # no memory signal and no health signal: keep the hash pick
+            return fallback
+        candidates = [
+            w for w in pool
+            if (self._health_rank(device.get(w[0])), headroom(w)) == best
+        ]
         return candidates[hash(query_id) % len(candidates)]
 
     # ------------------------------------------------------------------
@@ -165,7 +214,7 @@ class DistributedScheduler:
         placement: Dict[int, List[Tuple[str, str]]] = {}
         for f in fragments:
             if f.partitioning in (SOURCE, HASH, ARBITRARY):
-                placement[f.id] = list(self.workers)
+                placement[f.id] = self._schedulable_workers()
             else:  # SINGLE; memory-aware pick, hash spread as fallback
                 placement[f.id] = [self._pick_single_worker(query_id)]
             ntasks[f.id] = len(placement[f.id])

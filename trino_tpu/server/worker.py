@@ -75,9 +75,17 @@ class TaskExecution:
 class TaskManager:
     """Executes tasks against this worker's catalogs (SqlTaskManager)."""
 
-    def __init__(self, catalogs: CatalogManager, memory_manager=None):
+    def __init__(
+        self,
+        catalogs: CatalogManager,
+        memory_manager=None,
+        supervisor=None,
+    ):
         self.catalogs = catalogs
         self.memory_manager = memory_manager
+        # node-level device supervisor: every fragment on this worker
+        # dispatches through it, so quarantine outlives any one task
+        self.supervisor = supervisor
         self.tasks: Dict[str, TaskExecution] = {}
         self.lock = threading.Lock()
         # worker-level injector: serves the /v1/task/{id}/fail endpoint's
@@ -201,6 +209,21 @@ class TaskManager:
                 config["query_id"] = t.task_id.rsplit(".", 2)[0]
                 if inj.enabled():
                     self.memory_manager.fault_injector = inj
+            config["task_id"] = t.task_id
+            if self.supervisor is not None:
+                # same sharing rule as memory: one supervisor per node,
+                # configured by whichever task runs next (session props
+                # are uniform across a query's tasks)
+                self.supervisor.configure(config)
+                fb = config.get("device_cpu_fallback", True)
+                if isinstance(fb, str):
+                    fb = fb.strip().lower() not in (
+                        "false", "0", "no", "off", ""
+                    )
+                self.supervisor.cpu_fallback_enabled = bool(fb)
+                if inj.enabled():
+                    self.supervisor.fault_injector = inj
+                config["device_supervisor"] = self.supervisor
             ex = FragmentExecutor(
                 self.catalogs, config, splits_by_scan, remote_pages, dfs
             )
@@ -386,12 +409,19 @@ class _WorkerHandler(BaseHTTPRequestHandler):
             self.wfile.write(body)
             return
         if self.path == "/v1/info":
+            device = w.supervisor.snapshot()
+            state = w.state
+            if state == "ACTIVE" and device["state"] != "ACTIVE":
+                # a sick device downgrades the advertised node state
+                # (DEGRADED keeps serving via CPU; QUARANTINED refuses)
+                state = device["state"]
             self._json(200, {
                 "nodeId": w.node_id,
                 "nodeVersion": {"version": "trino-tpu 0.1"},
                 "environment": "tpu",
                 "coordinator": False,
-                "state": w.state,
+                "state": state,
+                "device": device,
                 "uptime": f"{time.time() - w.started:.0f}s",
             })
             return
@@ -497,8 +527,13 @@ class WorkerServer:
             ),
             node_id=self.node_id,
         )
+        from ..runtime import DeviceSupervisor
+
+        self.supervisor = DeviceSupervisor(node_id=self.node_id)
         self.task_manager = TaskManager(
-            catalogs, memory_manager=self.memory_manager
+            catalogs,
+            memory_manager=self.memory_manager,
+            supervisor=self.supervisor,
         )
         if fault_injection:
             # operator-configured chaos (heartbeat drops etc.) rides the
@@ -568,13 +603,18 @@ class WorkerServer:
                 self._stop.wait(self.announce_interval)
                 continue
             try:
+                # quarantined devices re-probe on the announcer cadence:
+                # recovery is discovered even while no tasks arrive
+                self.supervisor.maybe_probe()
                 # rebuilt every round: the announcement piggybacks this
                 # node's live pool snapshot for the coordinator-side
-                # ClusterMemoryManager (heartbeat memory view)
+                # ClusterMemoryManager (heartbeat memory view) and the
+                # device-health snapshot for scheduler routing
                 body = json.dumps({
                     "nodeId": self.node_id,
                     "uri": self.uri,
                     "memory": self.memory_manager.snapshot(),
+                    "device": self.supervisor.snapshot(),
                 }).encode()
                 req = urllib.request.Request(
                     f"{self.coordinator_uri}/v1/announcement",

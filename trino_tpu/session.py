@@ -72,6 +72,11 @@ class Session:
             node_id="session",
         )
         self.memory_pool = self.memory_manager.general
+        # supervised kernel-dispatch boundary (runtime/): one per node,
+        # like the memory manager — device quarantine is node-local
+        from .runtime import DeviceSupervisor
+
+        self.device_supervisor = DeviceSupervisor(node_id="session")
         self.tracer = TRACER
         # PREPARE name FROM ... statements (QueryPreparer / prepared
         # statement store; the reference keeps these per client session)
@@ -140,8 +145,18 @@ class Session:
         # SET SESSION query_max_memory_bytes resizes the pool for later
         # queries (the pool object is shared; only its budget moves)
         self.memory_pool.size = self.properties.get("query_max_memory_bytes")
-        self.memory_manager.fault_injector = self._fault_injector()
+        inj = self._fault_injector()
+        self.memory_manager.fault_injector = inj
+        sup = self.device_supervisor.configure(self.properties)
+        sup.fault_injector = inj
+        sup.cpu_fallback_enabled = bool(
+            self.properties.get("device_cpu_fallback")
+        )
         exec_config = {
+            "device_supervisor": sup,
+            "device_cpu_fallback": self.properties.get(
+                "device_cpu_fallback"
+            ),
             "group_capacity": self.properties.get("group_capacity"),
             "memory_limit_bytes": self.properties.get(
                 "query_max_memory_bytes"

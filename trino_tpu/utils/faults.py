@@ -40,6 +40,10 @@ SITES = (
                             # were exhausted (LocalMemoryManager tier)
     "stats_estimate",       # skew a fragment's estimated output rows by
                             # rule field `factor` (adaptive-replan tests)
+    "device_loss",          # kernel dispatch dies UNAVAILABLE (TPU worker
+                            # crash) at the supervised boundary
+    "device_wedge",         # kernel dispatch stalls past the watchdog
+                            # timeout (rule field `stall_s` overrides)
 )
 
 

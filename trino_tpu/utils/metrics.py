@@ -17,8 +17,9 @@ workers in one process, the module-level ``REGISTRY`` is intentionally
 process-global: every ``/metrics`` endpoint serves the same truth.
 
 Metric names must match ``trino_tpu_<subsystem>_<name>`` and end in
-``_total``, ``_bytes``, or ``_seconds`` — enforced here at registration
-time and over the source tree by ``scripts/check_metric_names.py``.
+``_total``, ``_bytes``, ``_seconds``, or ``_state`` (state-machine
+gauges) — enforced here at registration time and over the source tree by
+``scripts/check_metric_names.py``.
 """
 from __future__ import annotations
 
@@ -38,10 +39,12 @@ METRIC_SUBSYSTEMS = (
     "event",
     "memory",
     "stats",
+    "device",
 )
 
 METRIC_NAME_RE = re.compile(
-    r"^trino_tpu_(%s)_[a-z0-9_]*(_total|_bytes|_seconds)$" % "|".join(METRIC_SUBSYSTEMS)
+    r"^trino_tpu_(%s)(_[a-z0-9]+)*_(total|bytes|seconds|state)$"
+    % "|".join(METRIC_SUBSYSTEMS)
 )
 
 # Latency buckets in seconds; tuned for sub-millisecond kernels up to
@@ -264,7 +267,7 @@ class MetricsRegistry:
         if not METRIC_NAME_RE.match(name):
             raise ValueError(
                 "metric name %r violates trino_tpu_<subsystem>_<name>"
-                "{_total|_bytes|_seconds} convention" % name
+                "{_total|_bytes|_seconds|_state} convention" % name
             )
         with self._lock:
             m = self._metrics.get(name)
