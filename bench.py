@@ -95,6 +95,19 @@ CACHE_PROPS = {
     "warm": {},
 }[CACHE_MODE]
 
+# observability (trino_tpu/obs/): every bench session writes the
+# crash-safe on-disk dispatch flight recorder (it survives SIGKILL;
+# scripts/flightrec.py dumps/replays it) and runs the HBM bandwidth
+# ledger so slow configs carry their per-kernel GB/s breakdown.
+# BENCH_FLIGHTREC=0 / BENCH_LEDGER=0 opt out.
+if os.environ.get("BENCH_FLIGHTREC") != "0":
+    CACHE_PROPS = dict(
+        CACHE_PROPS,
+        flight_recorder_dir=os.path.join(REPO, ".flightrec"),
+    )
+if os.environ.get("BENCH_LEDGER") != "0":
+    CACHE_PROPS = dict(CACHE_PROPS, bandwidth_ledger=True)
+
 
 def _stats_mode() -> str:
     """--stats {off,analyzed} (also BENCH_STATS env).
@@ -321,6 +334,19 @@ def _crash_forensics() -> dict:
             }
     except Exception:  # noqa: BLE001 — forensics must never mask the crash
         pass
+    try:
+        # the in-memory mirror of the dispatch flight recorder: the last
+        # ~20 records name every kernel in flight around the failure (the
+        # on-disk ring additionally survives when THIS process dies)
+        from trino_tpu.obs.flight_recorder import last_recorder
+
+        rec = last_recorder()
+        if rec is not None:
+            tail = rec.tail(20)
+            if tail:
+                out["flight_recorder_tail"] = tail
+    except Exception:  # noqa: BLE001
+        pass
     return out
 
 
@@ -391,6 +417,19 @@ def _time_config(session, sql, rows, iters):
     prof = getattr(session, "last_kernel_profile", None) or {}
     if prof.get("summary"):
         out["profile"] = prof["summary"]
+    # slow configs carry their per-kernel bandwidth breakdown — under
+    # ~10 GB/s effective the query is memory-starved, and the ledger's
+    # heaviest movers say which operator to blame
+    bw = prof.get("bandwidth") or []
+    if bw and (gbps < 10.0 or out["bandwidth_suspect"]):
+        out["bandwidth_top"] = [
+            {
+                k: e.get(k)
+                for k in ("kernel", "mode", "executions", "totalBytes",
+                          "deviceWallS", "gbps", "rooflinePct")
+            }
+            for e in bw[:5]
+        ]
     return out
 
 

@@ -1,0 +1,288 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff the BENCH_r*.json trajectory.
+
+Each PR round leaves a BENCH_r<NN>.json artifact: a wrapper
+``{"n", "cmd", "rc", "tail", "parsed"}`` whose ``parsed`` is the bench
+output document when the run printed valid JSON — and ``None`` when the
+run timed out, crashed, or its output was head-truncated into ``tail``.
+The sentinel reads the whole trajectory and issues one verdict per
+round:
+
+    baseline          first round with recoverable metrics
+    crash-introduced  hard-crash signatures appeared where earlier
+                      rounds had none (TPU worker death, device loss)
+    regression        non-zero exit / no recoverable metrics / headline
+                      throughput dropped past the threshold
+    improved          headline throughput rose past the threshold
+    steady            comparable and within thresholds
+    unknown           ran clean but shares no metric names with any
+                      earlier round (nothing to diff)
+
+Throughputs are compared as the geometric mean of per-config ratios
+over the metric names a round shares with the most recent earlier round
+that had data.  When ``parsed`` is None the sentinel recovers complete
+per-config objects from the truncated ``tail`` by brace-matching —
+partial leading objects are skipped, not guessed at.
+
+Output: a markdown report (stdout) and, with ``--json``, the verdict
+list as JSON for CI gates.  Exit code 1 when the NEWEST round is a
+regression or crash-introduced, else 0.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+REGRESSION_RATIO = 0.70   # geomean throughput below this => regression
+IMPROVED_RATIO = 1.25     # ...above this => improved
+
+# hard-crash signatures: runtime death, not ordinary query errors (a
+# compile HTTP 500 is a failure, but nobody's process died)
+CRASH_SIGNATURES = (
+    "UNAVAILABLE",
+    "worker process crashed",
+    "DeviceFaultError",
+    "device_loss",
+    "core dumped",
+    "SIGKILL",
+)
+
+
+def _balanced_object(text: str, start: int) -> Optional[str]:
+    """The balanced ``{...}`` substring starting at ``start``, or None
+    if the text ends (truncation) before it closes."""
+    depth = 0
+    in_str = False
+    esc = False
+    for i in range(start, len(text)):
+        c = text[i]
+        if esc:
+            esc = False
+        elif c == "\\":
+            esc = True
+        elif c == '"':
+            in_str = not in_str
+        elif not in_str:
+            if c == "{":
+                depth += 1
+            elif c == "}":
+                depth -= 1
+                if depth == 0:
+                    return text[start:i + 1]
+    return None
+
+
+def recover_configs(text: str) -> Dict[str, dict]:
+    """Complete ``"name": {...}`` config objects from a (possibly
+    head- or tail-truncated) bench output fragment."""
+    out: Dict[str, dict] = {}
+    for m in re.finditer(r'"([A-Za-z0-9_.]+)"\s*:\s*\{', text or ""):
+        obj = _balanced_object(text, m.end() - 1)
+        if obj is None:
+            continue
+        try:
+            doc = json.loads(obj)
+        except ValueError:
+            continue
+        if not isinstance(doc, dict):
+            continue
+        # config-shaped objects only: measured, errored, or skipped runs
+        if ("rows_per_sec" in doc or "steady_s" in doc
+                or "error" in doc or "skipped" in doc):
+            out[m.group(1)] = doc
+    return out
+
+
+def load_round(path: str) -> dict:
+    with open(path) as f:
+        wrapper = json.load(f)
+    tail = wrapper.get("tail") or ""
+    parsed = wrapper.get("parsed")
+    configs: Dict[str, dict] = {}
+    metrics: Dict[str, float] = {}
+    if isinstance(parsed, dict):
+        cfg = parsed.get("configs")
+        if isinstance(cfg, dict):
+            configs = cfg
+        elif isinstance(parsed.get("value"), (int, float)):
+            # flat single-metric doc (early rounds)
+            metrics[str(parsed.get("metric", "headline"))] = float(
+                parsed["value"]
+            )
+    else:
+        configs = recover_configs(tail)
+    for name, cfg in configs.items():
+        rps = cfg.get("rows_per_sec") if isinstance(cfg, dict) else None
+        if isinstance(rps, (int, float)):
+            metrics[name] = float(rps)
+    blob = tail + (json.dumps(parsed) if parsed else "")
+    crashes = sum(blob.count(sig) for sig in CRASH_SIGNATURES)
+    errors = sum(
+        1 for c in configs.values()
+        if isinstance(c, dict) and "error" in c
+    )
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return {
+        "round": int(m.group(1)) if m else wrapper.get("n", 0),
+        "file": os.path.basename(path),
+        "rc": int(wrapper.get("rc") or 0),
+        "metrics": metrics,
+        "crashes": crashes,
+        "errors": errors,
+    }
+
+
+def _geomean_ratio(cur: Dict[str, float], prev: Dict[str, float]):
+    common = [
+        k for k in cur
+        if k in prev and prev[k] > 0 and cur[k] > 0
+    ]
+    if not common:
+        return None, []
+    logs = [math.log(cur[k] / prev[k]) for k in common]
+    return math.exp(sum(logs) / len(logs)), sorted(common)
+
+
+def judge(rounds: List[dict]) -> List[dict]:
+    """One verdict per round, in trajectory order."""
+    verdicts = []
+    for i, r in enumerate(rounds):
+        v = {
+            "round": r["round"],
+            "file": r["file"],
+            "rc": r["rc"],
+            "crashes": r["crashes"],
+            "verdict": "steady",
+            "reason": "",
+            "ratio": None,
+            "compared_to": None,
+        }
+        prior = rounds[:i]
+        prior_crashes = max((p["crashes"] for p in prior), default=0)
+        baseline = next(
+            (p for p in reversed(prior) if p["metrics"]), None
+        )
+        if r["crashes"] and prior and prior_crashes == 0:
+            v["verdict"] = "crash-introduced"
+            v["reason"] = (
+                "%d hard-crash signature(s) in a trajectory that had "
+                "none (%d errored config(s))" % (r["crashes"], r["errors"])
+            )
+        elif r["rc"] != 0:
+            v["verdict"] = "regression"
+            v["reason"] = (
+                "exit code %d%s" % (
+                    r["rc"],
+                    " (timeout)" if r["rc"] == 124 else "",
+                )
+                + ("; no recoverable metrics" if not r["metrics"] else "")
+            )
+        elif not r["metrics"]:
+            if prior:
+                v["verdict"] = "regression"
+                v["reason"] = "ran clean but produced no metrics"
+            else:
+                v["verdict"] = "unknown"
+                v["reason"] = "no recoverable metrics"
+        elif baseline is None:
+            v["verdict"] = "baseline"
+            v["reason"] = "first round with metrics"
+        else:
+            ratio, common = _geomean_ratio(
+                r["metrics"], baseline["metrics"]
+            )
+            v["compared_to"] = baseline["round"]
+            if ratio is None:
+                v["verdict"] = "unknown"
+                v["reason"] = (
+                    "no metric names in common with round %d"
+                    % baseline["round"]
+                )
+            else:
+                v["ratio"] = round(ratio, 4)
+                detail = "geomean x%.2f over %d config(s) vs round %d" % (
+                    ratio, len(common), baseline["round"],
+                )
+                if ratio < REGRESSION_RATIO:
+                    v["verdict"] = "regression"
+                elif ratio > IMPROVED_RATIO:
+                    v["verdict"] = "improved"
+                v["reason"] = detail
+        verdicts.append(v)
+    return verdicts
+
+
+def to_markdown(verdicts: List[dict]) -> str:
+    lines = [
+        "# Bench trajectory sentinel",
+        "",
+        "| round | file | rc | crashes | verdict | detail |",
+        "|---|---|---|---|---|---|",
+    ]
+    for v in verdicts:
+        lines.append(
+            "| r%02d | %s | %d | %d | **%s** | %s |" % (
+                v["round"], v["file"], v["rc"], v["crashes"],
+                v["verdict"], v["reason"],
+            )
+        )
+    flagged = [
+        v for v in verdicts
+        if v["verdict"] in ("regression", "crash-introduced")
+    ]
+    lines.append("")
+    if flagged:
+        lines.append(
+            "Flagged: "
+            + ", ".join(
+                "r%02d (%s)" % (v["round"], v["verdict"]) for v in flagged
+            )
+        )
+    else:
+        lines.append("Flagged: none")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_sentinel", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "dir", nargs="?",
+        default=os.path.join(os.path.dirname(__file__), ".."),
+        help="directory holding BENCH_r*.json (default: repo root)",
+    )
+    ap.add_argument(
+        "--json", metavar="PATH", default=None,
+        help="also write the verdict list as JSON ('-' for stdout)",
+    )
+    args = ap.parse_args(argv)
+
+    paths = sorted(glob.glob(os.path.join(args.dir, "BENCH_r*.json")))
+    if not paths:
+        print("no BENCH_r*.json under %s" % args.dir, file=sys.stderr)
+        return 1
+    rounds = [load_round(p) for p in paths]
+    rounds.sort(key=lambda r: r["round"])
+    verdicts = judge(rounds)
+    print(to_markdown(verdicts))
+    if args.json == "-":
+        print(json.dumps(verdicts, indent=2))
+    elif args.json:
+        with open(args.json, "w") as f:
+            json.dump(verdicts, f, indent=2)
+    newest = verdicts[-1]
+    return (
+        1 if newest["verdict"] in ("regression", "crash-introduced") else 0
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

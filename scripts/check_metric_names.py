@@ -38,6 +38,14 @@ LITERAL_RE = re.compile(
 # violation, not an unrelated string
 MEMORY_LITERAL_RE = re.compile(r'["\'](trino_tpu_memory_[a-z0-9_]*)["\']')
 
+# one naming regime across the observability surface: metric names above,
+# span names at tracer call sites (snake_case, like the metric stems),
+# and flight-recorder record fields (lowerCamelCase, like breadcrumb
+# to_dict() keys and every other JSON surface the server emits)
+SPAN_CALL_RE = re.compile(r'\.span\(\s*["\']([^"\']+)["\']')
+SPAN_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+RECORD_FIELD_RE = re.compile(r"^[a-z][a-zA-Z0-9]*$")
+
 SCAN_DIRS = ("trino_tpu", "tests", "scripts")
 SCAN_FILES = ("bench.py",)
 
@@ -80,6 +88,26 @@ def check_tree(root: str):
                     rel = os.path.relpath(path, root)
                     lineno = text.count("\n", 0, m.start(1)) + 1
                     violations.append((rel, lineno, name))
+        for m in SPAN_CALL_RE.finditer(text):
+            name = m.group(1)
+            checked += 1
+            if not SPAN_NAME_RE.match(name):
+                rel = os.path.relpath(path, root)
+                lineno = text.count("\n", 0, m.start(1)) + 1
+                violations.append((rel, lineno, "span:" + name))
+    # the flight-recorder schema is data, not literals-at-rest: lint the
+    # authoritative RECORD_FIELDS tuple the recorder writes from
+    try:
+        sys.path.insert(0, root)
+        from trino_tpu.obs.flight_recorder import RECORD_FIELDS
+    except Exception:
+        RECORD_FIELDS = ()
+    for field in RECORD_FIELDS:
+        checked += 1
+        if not RECORD_FIELD_RE.match(field):
+            violations.append(
+                ("trino_tpu/obs/flight_recorder.py", 0, "field:" + field)
+            )
     return checked, violations
 
 
@@ -88,12 +116,25 @@ def main() -> int:
     checked, violations = check_tree(root)
     if violations:
         for rel, lineno, name in violations:
-            print(
-                f"{rel}:{lineno}: metric name {name!r} violates "
-                "trino_tpu_<subsystem>_<name>{_total|_bytes|_seconds|_state}"
-            )
+            if name.startswith("span:"):
+                print(
+                    f"{rel}:{lineno}: span name {name[5:]!r} violates "
+                    "snake_case ^[a-z][a-z0-9_]*$"
+                )
+            elif name.startswith("field:"):
+                print(
+                    f"{rel}:{lineno}: flight-recorder field {name[6:]!r} "
+                    "violates lowerCamelCase ^[a-z][a-zA-Z0-9]*$"
+                )
+            else:
+                print(
+                    f"{rel}:{lineno}: metric name {name!r} violates "
+                    "trino_tpu_<subsystem>_<name>{_total|_bytes|_seconds|_state}"
+                )
         return 1
-    print(f"ok: {checked} metric-name literals conform")
+    print(
+        f"ok: {checked} metric/span/record-field name literals conform"
+    )
     return 0
 
 

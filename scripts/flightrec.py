@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""flightrec: read and replay the on-disk dispatch flight recorder.
+
+After a hard TPU crash (SIGKILL from the runtime, host OOM, wedged
+device) the process is gone but the mmap'd flight-recorder segments the
+device supervisor wrote survive in the page cache / on disk.  This tool
+turns them back into an incident narrative:
+
+    dump <dir>      every recovered record, oldest first (JSONL)
+    last <dir>      the culprit: newest dispatch with no matching
+                    complete/fault record (the one in flight at death)
+    replay <dir>    re-execute the culprit kernel standalone — synthesize
+                    inputs of the recorded shapes/dtypes and push a
+                    touch-every-byte reduction through a fresh
+                    DeviceSupervisor.dispatch, so the crash either
+                    reproduces under supervision or the device is cleared
+
+``replay --backend cpu`` (the default) runs the smoke path on the CPU
+backend: it cannot reproduce a TPU-side fault, but proves the recorded
+shapes rebuild and the dispatch plumbing executes them — the bisectable,
+CI-testable half of a crash investigation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# "int64(1024,)" / "float32(64, 128)" / "bool()" — the exact format
+# _shape_summary records into breadcrumb shapes
+_SHAPE_RE = re.compile(r"^(?P<dtype>[A-Za-z0-9_\[\]]+)\((?P<dims>[^)]*)\)$")
+
+
+def parse_shape(spec: str):
+    """'dtype(d0, d1, ...)' -> (dtype, (d0, d1, ...)), or None."""
+    m = _SHAPE_RE.match(str(spec).strip())
+    if not m:
+        return None
+    dims = tuple(
+        int(d) for d in m.group("dims").split(",") if d.strip()
+    )
+    return m.group("dtype"), dims
+
+
+def synthesize_inputs(shapes: dict):
+    """Deterministic host arrays matching the recorded lane shapes."""
+    import numpy as np
+
+    out = {}
+    for name, spec in sorted((shapes or {}).items()):
+        parsed = parse_shape(spec)
+        if parsed is None:
+            continue
+        dtype, dims = parsed
+        n = 1
+        for d in dims:
+            n *= d
+        try:
+            dt = np.dtype(dtype)
+        except TypeError:
+            continue
+        if dt.kind == "b":
+            arr = (np.arange(n) % 2).astype(dt)
+        elif dt.kind in ("i", "u"):
+            arr = np.arange(n, dtype=dt)
+        elif dt.kind == "f":
+            arr = (np.arange(n) % 997).astype(dt)
+        else:
+            continue
+        out[name] = arr.reshape(dims)
+    return out
+
+
+def replay_record(record: dict, backend: str = "cpu") -> dict:
+    """Rebuild the recorded dispatch and run it under a fresh supervisor.
+
+    The replay kernel is a touch-every-byte reduction over all recorded
+    input lanes — the same memory traffic shape as the original program
+    without its (unrecoverable) plan, which is what device-level crash
+    reproduction needs."""
+    if backend == "cpu" and "jax" not in sys.modules:
+        # only honorable before jax picks a backend; callers that already
+        # initialized jax (tests run on a forced-CPU harness) keep theirs
+        import trino_tpu
+
+        trino_tpu.force_cpu(1)
+    import jax
+    import jax.numpy as jnp
+
+    from trino_tpu.runtime.supervisor import Breadcrumb, DeviceSupervisor
+
+    inputs = synthesize_inputs(record.get("shapes") or {})
+    if not inputs:
+        raise SystemExit(
+            "no replayable shapes in record seq=%s kernel=%s"
+            % (record.get("seq"), record.get("kernel"))
+        )
+
+    def kernel(arrays):
+        total = jnp.asarray(0.0, dtype=jnp.float64)
+        for a in arrays.values():
+            total = total + jnp.sum(a.astype(jnp.float64))
+        return total
+
+    sup = DeviceSupervisor(node_id="flightrec-replay")
+    bc = Breadcrumb(
+        str(record.get("kernel") or "replay"),
+        query_id=str(record.get("queryId") or ""),
+        task_id=str(record.get("taskId") or ""),
+        node_id="flightrec-replay",
+        mode="probe",
+        shapes=dict(record.get("shapes") or {}),
+    )
+    fn = jax.jit(kernel)
+    out = sup.dispatch(lambda: fn(inputs), bc)
+    checksum = float(jax.device_get(out))
+    return {
+        "kernel": record.get("kernel"),
+        "seq": record.get("seq"),
+        "backend": jax.devices()[0].platform,
+        "lanes": len(inputs),
+        "bytes": int(sum(a.nbytes for a in inputs.values())),
+        "checksum": checksum,
+        "ok": True,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="flightrec", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("dump", "last", "replay"):
+        p = sub.add_parser(name)
+        p.add_argument("dir", help="flight-recorder directory")
+    sub.choices["dump"].add_argument(
+        "-n", type=int, default=0, help="only the newest N records"
+    )
+    sub.choices["replay"].add_argument(
+        "--seq", type=int, default=None,
+        help="replay this seq instead of the unmatched culprit",
+    )
+    sub.choices["replay"].add_argument(
+        "--backend", choices=("cpu", "native"), default="cpu",
+        help="cpu: force the CPU backend (smoke path); native: whatever "
+        "backend the environment provides",
+    )
+    args = ap.parse_args(argv)
+
+    from trino_tpu.obs.flight_recorder import last_unmatched, read_dir
+
+    records = read_dir(args.dir)
+    if not records:
+        print("no flight-recorder records in %s" % args.dir,
+              file=sys.stderr)
+        return 1
+    if args.cmd == "dump":
+        tail = records[-args.n:] if args.n else records
+        for r in tail:
+            print(json.dumps(r, sort_keys=True))
+        return 0
+    if args.cmd == "last":
+        culprit = last_unmatched(records)
+        if culprit is None:
+            print("no dispatch records recovered", file=sys.stderr)
+            return 1
+        print(json.dumps(culprit, indent=2, sort_keys=True))
+        return 0
+    # replay
+    if args.seq is not None:
+        matches = [
+            r for r in records
+            if r.get("seq") == args.seq and r.get("recordType") == "dispatch"
+        ]
+        culprit = matches[-1] if matches else None
+    else:
+        culprit = last_unmatched(records)
+        if culprit is not None and not culprit.get("shapes"):
+            # the in-flight record can be a sync/device_get bracket that
+            # carries no lanes — fall back to the newest dispatch that does
+            with_shapes = [
+                r for r in records
+                if r.get("recordType") == "dispatch" and r.get("shapes")
+            ]
+            if with_shapes:
+                culprit = with_shapes[-1]
+    if culprit is None or not culprit.get("shapes"):
+        print("no replayable dispatch record", file=sys.stderr)
+        return 1
+    print(
+        "replaying seq=%s kernel=%s mode=%s (%d recorded lanes)"
+        % (culprit.get("seq"), culprit.get("kernel"),
+           culprit.get("mode"), len(culprit.get("shapes") or {})),
+        file=sys.stderr,
+    )
+    result = replay_record(culprit, backend=args.backend)
+    print(json.dumps(result, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -202,6 +202,33 @@ def test_flush_exports_and_drops(tmp_path):
     assert spans[0]["name"] == "unit"
 
 
+def test_failed_query_still_flushes_spans(tmp_path):
+    """Regression: the span ring must export on EVERY query completion
+    path — a failing query used to strand its spans in memory until the
+    next successful one flushed them."""
+    s = tpch_session(SF)
+    path = str(tmp_path / "spans.jsonl")
+    exporter = OtlpFileExporter(path)
+    prev = s.tracer.exporter
+    s.tracer.attach_exporter(exporter)
+    try:
+        with pytest.raises(Exception):
+            s.execute("select no_such_column from lineitem")
+        names = set()
+        with open(path) as f:
+            for line in f:
+                doc = json.loads(line)
+                for rs in doc["resourceSpans"]:
+                    for ss in rs["scopeSpans"]:
+                        names.update(sp["name"] for sp in ss["spans"])
+        assert "query" in names and "parse" in names
+        # nothing stranded for the next query to inherit
+        assert len(s.tracer.spans) == 0
+    finally:
+        s.tracer.exporter = prev
+        s.tracer.clear()
+
+
 # --- fault counters ------------------------------------------------------
 
 

@@ -57,7 +57,7 @@ def test_tracing_spans():
     s.tracer.clear()
     s.execute("select count(*) from region")
     names = [sp.name for sp in s.tracer.spans]
-    assert {"parse", "analyze+plan", "optimize", "execute", "query"} <= set(names)
+    assert {"parse", "analyze_plan", "optimize", "execute", "query"} <= set(names)
     q = [sp for sp in s.tracer.spans if sp.name == "query"][0]
     children = [sp for sp in s.tracer.spans if sp.parent_id == q.span_id]
     assert len(children) >= 2

@@ -200,6 +200,26 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             _bool, True,
         ),
         PropertyMetadata(
+            "flight_recorder_dir",
+            "directory for the crash-safe on-disk dispatch ring (mmap'd "
+            "JSONL segments, scripts/flightrec.py reads them); empty "
+            "keeps the flight recorder in-memory only",
+            str, "",
+        ),
+        PropertyMetadata(
+            "flight_recorder_max_records",
+            "bound on the flight-recorder dispatch ring (oldest records "
+            "rotate out)",
+            int, 512,
+        ),
+        PropertyMetadata(
+            "bandwidth_ledger",
+            "bracket every supervised dispatch with block_until_ready "
+            "and account bytes-touched / device wall into per-kernel "
+            "effective GB/s (EXPLAIN ANALYZE always collects it)",
+            _bool, False,
+        ),
+        PropertyMetadata(
             "reorder_joins",
             "stats-based join-graph reordering (ReorderJoins / "
             "EliminateCrossJoins analogs); off keeps the FROM order",

@@ -104,6 +104,40 @@ SCHEMAS: Dict[str, List] = {
         ("analyzed_at", T.DOUBLE),
         ("duration_s", T.DOUBLE),
     ],
+    # one row per kernel digest from the last ledger-enabled query's HBM
+    # bandwidth accounting (obs/bandwidth.py; session.last_kernel_profile)
+    "kernel_bandwidth": [
+        ("kernel", T.VARCHAR),
+        ("mode", T.VARCHAR),
+        ("task_id", T.VARCHAR),
+        ("executions", T.BIGINT),
+        ("input_bytes", T.BIGINT),
+        ("output_bytes", T.BIGINT),
+        ("intermediate_bytes", T.BIGINT),
+        ("total_bytes", T.BIGINT),
+        ("device_wall_s", T.DOUBLE),
+        ("gbps", T.DOUBLE),
+        ("roofline_pct", T.DOUBLE),
+    ],
+    # the in-memory tail of the dispatch flight recorder
+    # (obs/flight_recorder.py via the process device supervisor) —
+    # seq-paired dispatch/complete/fault records, oldest first
+    "flight_recorder": [
+        ("seq", T.BIGINT),
+        ("record_type", T.VARCHAR),
+        ("kernel", T.VARCHAR),
+        ("mode", T.VARCHAR),
+        ("query_id", T.VARCHAR),
+        ("task_id", T.VARCHAR),
+        ("node_id", T.VARCHAR),
+        ("shapes", T.VARCHAR),
+        ("hbm_reserved_bytes", T.BIGINT),
+        ("hbm_peak_bytes", T.BIGINT),
+        ("wall_s", T.DOUBLE),
+        ("fault_kind", T.VARCHAR),
+        ("error", T.VARCHAR),
+        ("ts", T.DOUBLE),
+    ],
     # one row per metric series from the process-global MetricsRegistry —
     # the plugin/trino-jmx "metrics as SQL" surface; histograms expose
     # interpolated p50/p95/p99 alongside the observation count
@@ -276,6 +310,53 @@ class _SystemSource:
                 "data_version": [str(e["data_version"]) for e in entries],
                 "analyzed_at": [e["analyzed_at"] for e in entries],
                 "duration_s": [e["duration_s"] for e in entries],
+            }
+        if table == "kernel_bandwidth":
+            prof = getattr(s, "last_kernel_profile", None) or {}
+            entries = prof.get("bandwidth") or []
+            return {
+                "kernel": [e["kernel"] for e in entries],
+                "mode": [e["mode"] for e in entries],
+                "task_id": [e.get("taskId", "") for e in entries],
+                "executions": [e["executions"] for e in entries],
+                "input_bytes": [e["inputBytes"] for e in entries],
+                "output_bytes": [e["outputBytes"] for e in entries],
+                "intermediate_bytes": [
+                    e["intermediateBytes"] for e in entries
+                ],
+                "total_bytes": [e["totalBytes"] for e in entries],
+                "device_wall_s": [e["deviceWallS"] for e in entries],
+                "gbps": [e["gbps"] for e in entries],
+                "roofline_pct": [e["rooflinePct"] for e in entries],
+            }
+        if table == "flight_recorder":
+            import json as _json
+
+            sup = getattr(s, "device_supervisor", None)
+            rec = getattr(sup, "flight_recorder", None)
+            tail = rec.tail() if rec is not None else []
+            return {
+                "seq": [r.get("seq", 0) for r in tail],
+                "record_type": [r.get("recordType", "") for r in tail],
+                "kernel": [r.get("kernel", "") for r in tail],
+                "mode": [r.get("mode", "") for r in tail],
+                "query_id": [r.get("queryId", "") for r in tail],
+                "task_id": [r.get("taskId", "") for r in tail],
+                "node_id": [r.get("nodeId", "") for r in tail],
+                "shapes": [
+                    _json.dumps(r.get("shapes") or {}, sort_keys=True)
+                    for r in tail
+                ],
+                "hbm_reserved_bytes": [
+                    int(r.get("hbmReservedBytes") or 0) for r in tail
+                ],
+                "hbm_peak_bytes": [
+                    int(r.get("hbmPeakBytes") or 0) for r in tail
+                ],
+                "wall_s": [float(r.get("wallS") or 0.0) for r in tail],
+                "fault_kind": [r.get("faultKind", "") for r in tail],
+                "error": [r.get("error", "") for r in tail],
+                "ts": [float(r.get("ts") or 0.0) for r in tail],
             }
         if table == "metrics":
             from ..utils.metrics import REGISTRY

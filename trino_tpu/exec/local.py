@@ -37,6 +37,8 @@ from ..expr.lower import LoweringContext, compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
+from ..obs.bandwidth import BandwidthLedger
+from ..ops import tree_nbytes
 from ..ops import window as window_ops
 from ..page import Column, Page, pad_to
 from ..plan import nodes as P
@@ -333,6 +335,14 @@ class LocalExecutor:
         # when wired, process default otherwise (bare executors in tests)
         self.supervisor = self.config.get("device_supervisor") \
             or default_supervisor()
+        # HBM bandwidth ledger (obs/bandwidth.py): per-kernel bytes/wall
+        # accounting behind the bandwidth_ledger session property (EXPLAIN
+        # ANALYZE forces it on) — the block_until_ready bracketing
+        # serializes the async dispatch pipeline, so it stays opt-in
+        self.bandwidth_ledger = (
+            BandwidthLedger()
+            if self.config.get("bandwidth_ledger") else None
+        )
         self.device_bytes = 0
         # True while re-executing on the CPU backend after a device fault:
         # dispatches bypass supervision (the watchdog side thread would
@@ -426,6 +436,53 @@ class LocalExecutor:
         if self._device_fallback:
             return jax.device_get(objs)  # dispatch-guard: ok
         return self.supervisor.device_get(objs, bc)
+
+    # -- HBM bandwidth ledger ------------------------------------------
+    def _ledger_input_bytes(self, scans) -> int:
+        """Unpadded host bytes fed to the program: the scan (and merged
+        exchange) arrays as loaded, before capacity padding — comparable
+        to hand-computed scan bytes for the fragment."""
+        total = 0
+        for arrays in scans.values():
+            for v, ok in arrays.values():
+                total += int(getattr(v, "nbytes", 0) or 0)
+                if ok is not None:
+                    total += int(getattr(ok, "nbytes", 0) or 0)
+        return total
+
+    def _ledger_bracket(self, out, digest, mode, plan, scans, start):
+        """Close one ledger observation: drain the async dispatch
+        pipeline (supervised, so a wedge/loss during the sync still
+        breadcrumbs and flight-records) and fold bytes over the wall."""
+        led = self.bandwidth_ledger
+        if led is None:
+            return
+        bc = self._dispatch_crumb(digest, "sync")
+        self._dispatch(
+            lambda: jax.block_until_ready(out), bc  # dispatch-guard: ok
+        )
+        wall = time.perf_counter() - start
+        from . import streaming
+
+        try:
+            scan_est = streaming.estimate_plan_scan_bytes(self, plan)
+            inter = int(max(
+                0.0,
+                streaming.estimate_program_bytes(self, plan) - scan_est,
+            ))
+        except Exception:
+            # estimators reject exotic plans (e.g. UNNEST) — the ledger
+            # then reports input+output only rather than nothing
+            inter = 0
+        led.record(
+            digest,
+            mode,
+            input_bytes=self._ledger_input_bytes(scans),
+            output_bytes=tree_nbytes(out),
+            intermediate_bytes=inter,
+            wall_s=wall,
+            task_id=str(self.config.get("task_id") or ""),
+        )
 
     # ------------------------------------------------------------------
     def _execute_inner(self, plan: P.PlanNode) -> Page:
@@ -575,8 +632,13 @@ class LocalExecutor:
                             "eager-%d" % attempt, "eager", scans
                         )
                         self._last_crumb = bc
+                        led_t0 = time.perf_counter()
                         out_lanes, sel, ordered, checks = self._dispatch(
                             lambda: self._run(plan, ctx), bc
+                        )
+                        self._ledger_bracket(
+                            (out_lanes, sel), "eager-%d" % attempt,
+                            "eager", plan, scans, led_t0,
                         )
                         dups = ctx.dup_checks
                         colls = ctx.collision_checks
@@ -1318,6 +1380,16 @@ class LocalExecutor:
         REGISTRY.counter(
             "trino_tpu_kernel_d2h_bytes", "Estimated device-to-host result bytes"
         ).inc(d2h)
+        led = self.bandwidth_ledger
+        if led is not None:
+            s = led.summary()
+            self.kernel_profile["bandwidth"] = led.entries()
+            self.kernel_profile["summary"].update(
+                effectiveGbps=s["effectiveGbps"],
+                rooflinePct=s["rooflinePct"],
+                ledgerBytes=s["totalBytes"],
+                deviceWallS=s["deviceWallS"],
+            )
 
     # ------------------------------------------------------------------
     def _run_jitted(self, plan: P.Output, scans, counts):
@@ -1398,7 +1470,12 @@ class LocalExecutor:
             self._last_crumb = bc
             with TRACER.span("xla_compile", fragment=digest):
                 fn = jax.jit(raw)  # dispatch-guard: ok (lazy wrapper)
+                led_t0 = time.perf_counter()
                 out = self._dispatch(lambda: fn(prep), bc)
+                # cold entry: the bracketing wall includes trace+compile
+                # (inseparable under jax.jit); warm executions dominate
+                # the accumulated GB/s
+                self._ledger_bracket(out, digest, "jit", plan, scans, led_t0)
             self._record_kernel(
                 digest, compile_s=time.time() - compile_start, cached=False
             )
@@ -1415,7 +1492,9 @@ class LocalExecutor:
             # only, never OOM)
             bc = self._dispatch_crumb(digest, "jit", prep)
             self._last_crumb = bc
+            led_t0 = time.perf_counter()
             out = self._dispatch(lambda: entry["fn"](prep), bc)
+            self._ledger_bracket(out, digest, "jit", plan, scans, led_t0)
             self._record_kernel(digest, compile_s=0.0, cached=True)
         out_lanes, sel, ngroups, dup_vals, colls, wides, sflags = out
         checks = [

@@ -1,0 +1,35 @@
+"""Observability subsystem: black-box flight recorder + HBM bandwidth ledger.
+
+Two always-on production-profiling surfaces in the spirit of Kanev et al.
+(*Profiling a Warehouse-Scale Computer*, ISCA 2015) and Dean & Barroso
+(*The Tail at Scale*, CACM 2013):
+
+- :mod:`.flight_recorder` — a per-process bounded ring of dispatch
+  records (in-memory always; crash-safe mmap'd JSONL segments on disk
+  when ``flight_recorder_dir`` is set) so the last N device dispatches
+  survive a hard TPU crash and ``scripts/flightrec.py`` can bisect the
+  culprit kernel offline;
+- :mod:`.bandwidth` — per-kernel bytes-touched / device-wall accounting
+  yielding effective GB/s and %-of-roofline per compiled program
+  (``bandwidth_ledger`` session property), surfaced through EXPLAIN
+  ANALYZE, ``/v1/query/{id}/profile``, ``system.runtime.kernel_bandwidth``
+  and the ``trino_tpu_kernel_bandwidth_*`` histograms.
+"""
+from .bandwidth import BandwidthLedger, roofline_bytes_per_s
+from .flight_recorder import (
+    RECORD_FIELDS,
+    FlightRecorder,
+    last_recorder,
+    last_unmatched,
+    read_dir,
+)
+
+__all__ = [
+    "BandwidthLedger",
+    "roofline_bytes_per_s",
+    "RECORD_FIELDS",
+    "FlightRecorder",
+    "last_recorder",
+    "last_unmatched",
+    "read_dir",
+]

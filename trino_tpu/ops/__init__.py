@@ -1,0 +1,32 @@
+"""Kernel building blocks (aggregation, join, sort, window, ...).
+
+Shared byte-accounting helpers live here: the bandwidth ledger
+(``trino_tpu/obs/bandwidth.py``) charges every supervised dispatch with
+the bytes its operator tree touches, and the lane pytrees it must walk
+are the same nested dict/tuple shapes the ops modules produce.
+"""
+from __future__ import annotations
+
+
+def tree_nbytes(tree) -> int:
+    """Total ``nbytes`` across every array leaf of a lane pytree.
+
+    Accepts the nested dict/tuple/list shapes dispatches produce (output
+    lane maps, ``(values, validity)`` pairs, check-scalar tuples); leaves
+    without ``nbytes`` (python scalars, None validity) count as zero.
+    """
+    total = 0
+    stack = [tree]
+    while stack:
+        node = stack.pop()
+        if node is None:
+            continue
+        if isinstance(node, dict):
+            stack.extend(node.values())
+        elif isinstance(node, (tuple, list)):
+            stack.extend(node)
+        else:
+            nb = getattr(node, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+    return total

@@ -218,6 +218,15 @@ class DeviceSupervisor:
         self.breadcrumbs: deque = deque(maxlen=32)
         self.fallback_attempted = 0
         self.fallback_completed = 0
+        # black-box dispatch ring (obs/flight_recorder.py): memory-only by
+        # default — the system.runtime.flight_recorder table and bench
+        # forensics read the live tail; configure() upgrades it to the
+        # crash-safe mmap'd on-disk ring when flight_recorder_dir is set
+        from ..obs.flight_recorder import FlightRecorder
+
+        self.flight_recorder = FlightRecorder(
+            None, max_records=256, name=node_id
+        )
         self._publish_state()
 
     # -- configuration -------------------------------------------------
@@ -237,7 +246,33 @@ class DeviceSupervisor:
                     setattr(self, attr, cast(v))
                 except (TypeError, ValueError):
                     pass
+        self._configure_flight_recorder(
+            get("flight_recorder_dir"), get("flight_recorder_max_records")
+        )
         return self
+
+    def _configure_flight_recorder(self, directory, max_records):
+        """Re-point the dispatch ring: an empty dir keeps the in-memory
+        mirror; a directory turns on the crash-safe on-disk segments."""
+        from ..obs.flight_recorder import FlightRecorder
+
+        directory = str(directory or "").strip() or None
+        try:
+            max_records = int(max_records or 0) or 512
+        except (TypeError, ValueError):
+            max_records = 512
+        cur = self.flight_recorder
+        if (
+            cur is not None
+            and cur.directory == directory
+            and (directory is None or cur.max_records == max_records)
+        ):
+            return
+        if cur is not None:
+            cur.close()
+        self.flight_recorder = FlightRecorder(
+            directory, max_records=max_records, name=self.node_id
+        )
 
     # -- state queries -------------------------------------------------
     def _device(self, device_id: int = 0) -> _DeviceHealth:
@@ -415,12 +450,16 @@ class DeviceSupervisor:
         exception — including the JaxRuntimeErrors the executor handles
         itself (INVALID_ARGUMENT, compile OOM) — passes through."""
         self._record(bc)
+        rec = self.flight_recorder
+        seq = rec.record_dispatch(bc) if rec is not None else 0
         with self._lock:
             d = self._device(device_id)
             state = d.state
         if state != ACTIVE:
             # no probe here: dispatch is the hot path; probing happens at
             # execute() entry and in the worker's announce loop
+            if rec is not None:
+                rec.record_fault(seq, bc, "device_" + state.lower())
             raise DeviceFaultError("device_" + state.lower(), bc)
         inj = self.fault_injector
         timeout = self.watchdog_timeout_s
@@ -444,16 +483,30 @@ class DeviceSupervisor:
                     )
             return thunk()
 
+        start = time.time()
         try:
             if timeout and timeout > 0:
-                return self._with_watchdog(supervised, timeout)
-            return supervised()
+                out = self._with_watchdog(supervised, timeout)
+            else:
+                out = supervised()
         except _WedgeTimeout as e:
+            if rec is not None:
+                rec.record_fault(seq, bc, "device_wedge", str(e))
             raise self._fault(bc, "device_wedge", e, device_id) from None
         except Exception as e:
             if _is_device_loss(e):
+                if rec is not None:
+                    rec.record_fault(seq, bc, "device_loss", str(e))
                 raise self._fault(bc, "device_loss", e, device_id) from e
+            if rec is not None:
+                # not a device fault (INVALID_ARGUMENT, compile OOM, plan
+                # errors): still attributed — the ring shows which kernel
+                # the error surfaced under before the executor handles it
+                rec.record_fault(seq, bc, "error", str(e))
             raise
+        if rec is not None:
+            rec.record_complete(seq, bc, time.time() - start)
+        return out
 
     def device_get(self, objs, bc: Breadcrumb, device_id: int = 0):
         """Supervised device->host transfer (the sync point where async

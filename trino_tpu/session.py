@@ -195,6 +195,9 @@ class Session:
         exec_config["jit_cache"] = (
             cc if self.properties.get("compile_cache") else {}
         )
+        exec_config["bandwidth_ledger"] = bool(
+            self.properties.get("bandwidth_ledger")
+        )
         exec_config["capacity_hints"] = self._capacity_hints
         exec_config["fragment_cache"] = self._fragment_cache
         if self.properties.get("distributed"):
@@ -257,6 +260,11 @@ class Session:
                 error=str(e),
             )
             raise
+        finally:
+            # batch-export completed spans on EVERY completion path —
+            # success, failure, and non-Query statements alike (no-op
+            # without an attached OTLP exporter)
+            self.tracer.flush()
 
     def _execute_statement(self, stmt, sql: str, query_id: str,
                            identity=None) -> Page:
@@ -681,8 +689,6 @@ class Session:
             self.store_result(rkey, page, plan)
         if not isinstance(stmt, ast.Query):
             self._invalidate_written_tables(plan)
-        # batch-export completed spans when an OTLP exporter is attached
-        self.tracer.flush()
         return page
 
     # -- fragment result cache (cache/result_cache) --------------------
@@ -776,6 +782,9 @@ class Session:
                 "collect_node_stats": True,
                 "spill_enabled": False,
                 "query_id": query_id,
+                # EXPLAIN ANALYZE always collects the HBM bandwidth
+                # ledger: its whole point is per-operator accounting
+                "bandwidth_ledger": True,
             },
         )
         t0 = time.perf_counter()
@@ -809,6 +818,23 @@ class Session:
                     f"executions {k['executions']}, "
                     f"compiles {k['compiles']}"
                 )
+        bandwidth = prof.get("bandwidth") or []
+        if bandwidth:
+            text += (
+                "\n\nHBM bandwidth ledger "
+                f"(roofline {summary.get('effectiveGbps', 0.0):.2f} GB/s "
+                f"effective, {summary.get('rooflinePct', 0.0):.3f}% of "
+                "peak):"
+            )
+            for e in bandwidth:
+                text += (
+                    f"\n  kernel {e['kernel']} [{e['mode']}]: "
+                    f"{e['gbps']:.2f} GB/s "
+                    f"({e['rooflinePct']:.3f}% roofline), "
+                    f"in {e['inputBytes']}B, out {e['outputBytes']}B, "
+                    f"inter {e['intermediateBytes']}B over "
+                    f"{e['deviceWallS'] * 1000:.2f}ms device wall"
+                )
         col = column_from_pylist(T.VARCHAR, text.split("\n"))
         return Page([col], len(text.split("\n")), ["Query Plan"])
 
@@ -841,7 +867,7 @@ class Session:
         walk(plan)
 
     def _plan_stmt(self, stmt) -> P.PlanNode:
-        with self.tracer.span("analyze+plan"):
+        with self.tracer.span("analyze_plan"):
             analyzer = Analyzer(self.metadata, self.default_catalog,
                             self.sql_functions)
             plan = analyzer.plan_statement(stmt)
@@ -897,7 +923,7 @@ class Session:
             executor = self._executor()
             execute_plan = executor.execute
         chunk_results = []
-        with self.tracer.span("analyze-collect", table=qualified):
+        with self.tracer.span("analyze_collect", table=qualified):
             for csql, chunk in analyze_queries(qualified, tasks, buckets):
                 page = execute_plan(self._plan_stmt(parse(csql)))
                 row = [
