@@ -1075,6 +1075,46 @@ def main():
             anchors["q6_steady_s"] / q6_cfg["steady_s"], 2
         )
 
+    # per-operator timeline of the slowest completed TPC-H config (BENCH
+    # "operator_timeline"): one eager operator_stats pass at SF1 so a
+    # regression verdict can name the operator whose wall grew most
+    # (scripts/bench_sentinel.py drills into this)
+    try:
+        done = {
+            n: c for n, c in state["configs"].items()
+            if isinstance(c, dict) and c.get("steady_s")
+            and n.startswith(("q1", "q3", "q6"))
+        }
+        if done and remaining() > 30:
+            slowest = max(done, key=lambda n: done[n]["steady_s"])
+            sql = (
+                Q1 if slowest.startswith("q1") else
+                Q3 if slowest.startswith("q3") else Q6
+            )
+            ts = tpch_session(1.0, operator_stats=True, **CACHE_PROPS)
+            ts.execute(sql)
+            tl = ts.last_timeline or {}
+            state["operator_timeline"] = {
+                "config": slowest,
+                "wall_s": tl.get("wallS"),
+                "operators": [
+                    {
+                        "operator": f.get("operatorType"),
+                        "plan_node_id": f.get("planNodeId"),
+                        "output_rows": f.get("outputRows"),
+                        "output_bytes": f.get("outputBytes"),
+                        "wall_s": f.get("wallS"),
+                        "device_wall_s": f.get("deviceWallS"),
+                    }
+                    for f in tl.get("operators") or ()
+                ],
+            }
+            _drop_session(ts)
+    except Exception as e:
+        state["operator_timeline"] = {
+            "error": f"{type(e).__name__}: {e}"
+        }
+
     try:  # write back observed costs as the next run's estimates
         est.update(actual)
         with open(EST_FILE, "w") as f:

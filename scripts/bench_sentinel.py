@@ -127,6 +127,19 @@ def load_round(path: str) -> dict:
         1 for c in configs.values()
         if isinstance(c, dict) and "error" in c
     )
+    # per-operator walls of the round's slowest config (bench.py
+    # "operator_timeline"): regression verdicts drill down to the
+    # operator whose wall grew most
+    op_walls: Dict[str, float] = {}
+    if isinstance(parsed, dict):
+        tl = parsed.get("operator_timeline")
+        if isinstance(tl, dict):
+            for fr in tl.get("operators") or ():
+                if isinstance(fr, dict) and fr.get("wall_s"):
+                    key = "%s:%s" % (
+                        fr.get("operator"), fr.get("plan_node_id"),
+                    )
+                    op_walls[key] = float(fr["wall_s"])
     m = re.search(r"r(\d+)", os.path.basename(path))
     return {
         "round": int(m.group(1)) if m else wrapper.get("n", 0),
@@ -135,7 +148,24 @@ def load_round(path: str) -> dict:
         "metrics": metrics,
         "crashes": crashes,
         "errors": errors,
+        "op_walls": op_walls,
     }
+
+
+def _worst_operator(cur, prev):
+    """(label, prev_wall_s, cur_wall_s, growth) of the operator whose
+    wall grew MOST between two rounds' operator timelines, or None."""
+    if not cur or not prev:
+        return None
+    worst = None
+    for k, w in cur.items():
+        pw = prev.get(k)
+        if not pw or pw <= 0 or w <= 0:
+            continue
+        g = w / pw
+        if worst is None or g > worst[3]:
+            worst = (k, pw, w, g)
+    return worst
 
 
 def _geomean_ratio(cur: Dict[str, float], prev: Dict[str, float]):
@@ -211,6 +241,15 @@ def judge(rounds: List[dict]) -> List[dict]:
                 )
                 if ratio < REGRESSION_RATIO:
                     v["verdict"] = "regression"
+                    culprit = _worst_operator(
+                        r.get("op_walls"), baseline.get("op_walls")
+                    )
+                    if culprit:
+                        v["culprit_operator"] = culprit[0]
+                        detail += (
+                            "; operator %s wall grew most "
+                            "(%.3fs -> %.3fs, x%.2f)" % culprit
+                        )
                 elif ratio > IMPROVED_RATIO:
                     v["verdict"] = "improved"
                 v["reason"] = detail

@@ -95,19 +95,29 @@ def check_tree(root: str):
                 rel = os.path.relpath(path, root)
                 lineno = text.count("\n", 0, m.start(1)) + 1
                 violations.append((rel, lineno, "span:" + name))
-    # the flight-recorder schema is data, not literals-at-rest: lint the
-    # authoritative RECORD_FIELDS tuple the recorder writes from
-    try:
-        sys.path.insert(0, root)
-        from trino_tpu.obs.flight_recorder import RECORD_FIELDS
-    except Exception:
-        RECORD_FIELDS = ()
-    for field in RECORD_FIELDS:
-        checked += 1
-        if not RECORD_FIELD_RE.match(field):
-            violations.append(
-                ("trino_tpu/obs/flight_recorder.py", 0, "field:" + field)
-            )
+    # wire-record schemas are data, not literals-at-rest: lint each
+    # authoritative field tuple its writer serializes from (flight
+    # recorder, OperatorStats frames, query history records)
+    sys.path.insert(0, root)
+    field_schemas = (
+        ("trino_tpu/obs/flight_recorder.py",
+         "trino_tpu.obs.flight_recorder", "RECORD_FIELDS"),
+        ("trino_tpu/obs/opstats.py",
+         "trino_tpu.obs.opstats", "OPERATOR_FIELDS"),
+        ("trino_tpu/obs/history.py",
+         "trino_tpu.obs.history", "HISTORY_FIELDS"),
+    )
+    for rel, mod, attr in field_schemas:
+        try:
+            import importlib
+
+            fields = getattr(importlib.import_module(mod), attr)
+        except Exception:
+            fields = ()
+        for field in fields:
+            checked += 1
+            if not RECORD_FIELD_RE.match(field):
+                violations.append((rel, 0, "field:" + field))
     return checked, violations
 
 
@@ -123,7 +133,7 @@ def main() -> int:
                 )
             elif name.startswith("field:"):
                 print(
-                    f"{rel}:{lineno}: flight-recorder field {name[6:]!r} "
+                    f"{rel}:{lineno}: wire-record field {name[6:]!r} "
                     "violates lowerCamelCase ^[a-z][a-zA-Z0-9]*$"
                 )
             else:

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Run every repo linter (``scripts/check_*.py``) in one pass.
+
+Aggregates the three source linters:
+
+  - ``check_dispatch_guard.py``  — no unguarded device dispatch
+  - ``check_metric_names.py``    — metric/span/wire-record naming
+  - ``check_session_props.py``   — session-property hygiene
+
+Exit code is non-zero when ANY linter fails; each linter's own output is
+printed under a header.  Wired into tier-1 via tests/test_lint.py, so a
+naming or dead-config violation fails the suite, not just CI.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import check_dispatch_guard  # noqa: E402
+import check_metric_names  # noqa: E402
+import check_session_props  # noqa: E402
+
+LINTERS = (
+    ("check_dispatch_guard", check_dispatch_guard),
+    ("check_metric_names", check_metric_names),
+    ("check_session_props", check_session_props),
+)
+
+
+def main() -> int:
+    rc = 0
+    for name, mod in LINTERS:
+        print(f"-- {name}")
+        try:
+            r = int(mod.main() or 0)
+        except SystemExit as e:  # a linter that sys.exit()s directly
+            r = int(e.code or 0)
+        if r:
+            rc = 1
+    print("lint:", "FAIL" if rc else "ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,11 +58,6 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 4096,
         ),
         PropertyMetadata(
-            "join_expansion_factor",
-            "initial expansion-join output capacity as a multiple of probe rows",
-            int, 1,
-        ),
-        PropertyMetadata(
             "query_max_memory_bytes",
             "per-query device memory reservation limit",
             int, 8 << 30,
@@ -102,16 +97,6 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
-            "explain_analyze_rows",
-            "collect per-operator row counts during execution",
-            _bool, False,
-        ),
-        PropertyMetadata(
-            "join_build_side",
-            "build-side selection: auto | right (disable stats swap)",
-            str, "auto",
-        ),
-        PropertyMetadata(
             "join_distribution_type",
             "automatic | broadcast | partitioned "
             "(DetermineJoinDistributionType analog)",
@@ -123,11 +108,6 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "hash-partitioned instead of replicated (join-max-broadcast-"
             "table-size analog, in rows)",
             int, BROADCAST_JOIN_THRESHOLD_ROWS,
-        ),
-        PropertyMetadata(
-            "split_count",
-            "scan splits per table (0 = one per device)",
-            int, 0,
         ),
         PropertyMetadata(
             "spill_enabled",
@@ -361,6 +341,30 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "FTE: launch backup attempts for straggler tasks "
             "(EventDrivenFaultTolerantQueryScheduler SPECULATIVE class)",
             _bool, True,
+        ),
+        PropertyMetadata(
+            "operator_stats",
+            "collect per-operator OperatorStats frames (rows/bytes/wall/"
+            "blocked) on every execution; forces eager per-node timing",
+            _bool, False,
+        ),
+        PropertyMetadata(
+            "query_history_dir",
+            "directory for the crash-safe persisted query history store "
+            "(mmap'd JSONL segments); empty = process-memory only",
+            str, "",
+        ),
+        PropertyMetadata(
+            "query_history_max_bytes",
+            "byte budget of the persisted query history store (oldest "
+            "completed queries evicted first)",
+            int, 1 << 20,
+        ),
+        PropertyMetadata(
+            "straggler_dispersion_factor",
+            "flag/hedge a task when its wall sits this many robust "
+            "deviations (MAD units) above the sibling median",
+            float, 2.0,
         ),
     ]
 }

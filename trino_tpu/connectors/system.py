@@ -138,6 +138,39 @@ SCHEMAS: Dict[str, List] = {
         ("error", T.VARCHAR),
         ("ts", T.DOUBLE),
     ],
+    # one row per operator frame of the last instrumented execution
+    # (EXPLAIN ANALYZE / operator_stats=true; session.last_timeline) —
+    # the operator/OperatorStats.java "as SQL" surface
+    "operator_stats": [
+        ("operator_id", T.BIGINT),
+        ("plan_node_id", T.VARCHAR),
+        ("operator_type", T.VARCHAR),
+        ("input_rows", T.BIGINT),
+        ("input_bytes", T.BIGINT),
+        ("output_rows", T.BIGINT),
+        ("output_bytes", T.BIGINT),
+        ("wall_s", T.DOUBLE),
+        ("device_wall_s", T.DOUBLE),
+        ("host_wall_s", T.DOUBLE),
+        ("blocked_memory_s", T.DOUBLE),
+        ("blocked_exchange_s", T.DOUBLE),
+        ("estimated_rows", T.DOUBLE),
+        ("calls", T.BIGINT),
+    ],
+    # one row per completed query in the persisted history store
+    # (obs/history.py): survives coordinator restart up to the torn tail
+    "completed_queries": [
+        ("query_id", T.VARCHAR),
+        ("state", T.VARCHAR),
+        ("query", T.VARCHAR),
+        ("user", T.VARCHAR),
+        ("created", T.DOUBLE),
+        ("finished", T.DOUBLE),
+        ("rows", T.BIGINT),
+        ("wall_s", T.DOUBLE),
+        ("error", T.VARCHAR),
+        ("operators", T.BIGINT),
+    ],
     # one row per metric series from the process-global MetricsRegistry —
     # the plugin/trino-jmx "metrics as SQL" surface; histograms expose
     # interpolated p50/p95/p99 alongside the observation count
@@ -357,6 +390,69 @@ class _SystemSource:
                 "fault_kind": [r.get("faultKind", "") for r in tail],
                 "error": [r.get("error", "") for r in tail],
                 "ts": [float(r.get("ts") or 0.0) for r in tail],
+            }
+        if table == "operator_stats":
+            tl = getattr(s, "last_timeline", None) or {}
+            frames = tl.get("operators") or []
+            return {
+                "operator_id": [
+                    int(f.get("operatorId") or 0) for f in frames
+                ],
+                "plan_node_id": [
+                    str(f.get("planNodeId") or "") for f in frames
+                ],
+                "operator_type": [
+                    f.get("operatorType", "") for f in frames
+                ],
+                "input_rows": [
+                    int(f.get("inputRows") or 0) for f in frames
+                ],
+                "input_bytes": [
+                    int(f.get("inputBytes") or 0) for f in frames
+                ],
+                "output_rows": [
+                    int(f.get("outputRows") or 0) for f in frames
+                ],
+                "output_bytes": [
+                    int(f.get("outputBytes") or 0) for f in frames
+                ],
+                "wall_s": [
+                    float(f.get("wallS") or 0.0) for f in frames
+                ],
+                "device_wall_s": [
+                    float(f.get("deviceWallS") or 0.0) for f in frames
+                ],
+                "host_wall_s": [
+                    float(f.get("hostWallS") or 0.0) for f in frames
+                ],
+                "blocked_memory_s": [
+                    float(f.get("blockedMemoryS") or 0.0) for f in frames
+                ],
+                "blocked_exchange_s": [
+                    float(f.get("blockedExchangeS") or 0.0)
+                    for f in frames
+                ],
+                "estimated_rows": [
+                    f.get("estimatedRows") for f in frames
+                ],
+                "calls": [int(f.get("calls") or 0) for f in frames],
+            }
+        if table == "completed_queries":
+            hist = getattr(s, "history", None)
+            recs = hist.completed() if hist is not None else []
+            return {
+                "query_id": [r.get("queryId") for r in recs],
+                "state": [r.get("state") for r in recs],
+                "query": [(r.get("sql") or "")[:200] for r in recs],
+                "user": [r.get("user") or "user" for r in recs],
+                "created": [r.get("created") for r in recs],
+                "finished": [r.get("finished") for r in recs],
+                "rows": [int(r.get("rows") or 0) for r in recs],
+                "wall_s": [float(r.get("wallS") or 0.0) for r in recs],
+                "error": [r.get("error") for r in recs],
+                "operators": [
+                    len(r.get("operators") or ()) for r in recs
+                ],
             }
         if table == "metrics":
             from ..utils.metrics import REGISTRY

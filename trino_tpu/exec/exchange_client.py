@@ -152,6 +152,10 @@ class ExchangeClient:
         # W3C trace context of the hosting task: fetch spans run on pool
         # threads with empty span stacks, so the link must be explicit
         self.traceparent = traceparent
+        # OperatorStats blocked-on-exchange: wall of the last
+        # fetch_sources call (the worker attributes it to the task's
+        # RemoteSource frames)
+        self.last_fetch_wall_s = 0.0
 
     def fetch_sources(
         self, sources: Dict[int, List[dict]]
@@ -162,11 +166,13 @@ class ExchangeClient:
         propagates as SpoolCorruptionError so the hosting task FAILS and
         the FTE retry loop owns the recovery."""
         out: Dict[int, List[Page]] = {}
+        self.last_fetch_wall_s = 0.0
         flat = [
             (fid, loc) for fid, locs in sources.items() for loc in locs
         ]
         if not flat:
             return out
+        fetch_t0 = time.time()
 
         fetch_seconds = REGISTRY.histogram(
             "trino_tpu_exchange_fetch_seconds", "Wall time of one exchange source fetch"
@@ -207,4 +213,5 @@ class ExchangeClient:
             futures = [(fid, pool.submit(fetch, loc)) for fid, loc in flat]
             for fid, fut in futures:
                 out.setdefault(fid, []).extend(fut.result())
+        self.last_fetch_wall_s = time.time() - fetch_t0
         return out

@@ -315,9 +315,17 @@ class LocalExecutor:
         self.config = config or {}
         self.query_id = str(self.config.get("query_id", "query"))
         self.scan_bytes = 0
-        # EXPLAIN ANALYZE: id(plan node) -> {rows, wall_s, calls}
-        # (OperatorStats analog, filled when collect_node_stats is set)
+        # EXPLAIN ANALYZE: id(plan node) -> {rows, bytes, wall_s,
+        # device_wall_s, calls} (OperatorStats analog, filled when
+        # collect_node_stats is set; obs/opstats.frames_from_plan turns
+        # these into the wire-shape timeline frames)
         self.node_stats: Dict[int, dict] = {}
+        # query-level blocked time (OperatorStats blocked walls): waiting
+        # on a memory reservation / on exchange pages.  Attributed at the
+        # task rollup; exchange wait is set by the worker around
+        # ExchangeClient.fetch_sources
+        self.blocked_memory_s = 0.0
+        self.blocked_exchange_s = 0.0
         # per-query TPU kernel profile: one record per compiled (or eager)
         # fragment program — compile wall, recompiles, padded-vs-actual
         # rows, host<->device byte estimates.  Surfaced via EXPLAIN
@@ -542,12 +550,14 @@ class LocalExecutor:
             est = int(max(self.scan_bytes,
                           estimate_program_bytes(self, plan)))
             try:
+                _blk_t0 = time.perf_counter()
                 manager.reserve(
                     self.query_id, est, tier="device",
                     timeout=float(
                         self.config.get("memory_blocked_timeout_s") or 0.0
                     ),
                 )
+                self.blocked_memory_s += time.perf_counter() - _blk_t0
                 self.device_bytes = est
             except ExceededMemoryLimitError as exc:
                 manager.free(self.query_id, self.scan_bytes, tier="host")
@@ -972,13 +982,18 @@ class LocalExecutor:
         if manager is not None:
             # revoke -> block -> clean-error semantics (and the seeded
             # `oom` fault site) live in the manager; freed after
-            # materialize alongside the device-tier reservation
+            # materialize alongside the device-tier reservation.  Time
+            # spent blocked in reserve is OperatorStats blocked-on-memory
+            import time as _time
+
+            _blk_t0 = _time.perf_counter()
             manager.reserve(
                 self.query_id, total, tier="host",
                 timeout=float(
                     self.config.get("memory_blocked_timeout_s") or 0.0
                 ),
             )
+            self.blocked_memory_s += _time.perf_counter() - _blk_t0
             return
         pool = self.config.get("memory_pool")
         if pool is not None:
@@ -1570,20 +1585,41 @@ class _TraceCtx:
         if not self.ex.config.get("collect_node_stats"):
             return m(node)
         # EXPLAIN ANALYZE instrumentation (OperatorContext timing analog);
-        # wall time is inclusive of children — the printer subtracts
+        # wall time is inclusive of children — the printer (and
+        # obs/opstats.frames_from_plan) subtracts.  The dispatch-to-sync
+        # split approximates host (trace + dispatch) vs device (waiting
+        # on the computation) wall in eager mode.
         import time as _time
 
         t0 = _time.perf_counter()
         b = m(node)
+        t1 = _time.perf_counter()
         # EXPLAIN ANALYZE timing sync; runs inside the supervised eager
         # dispatch, so it is already covered by the boundary
         jax.block_until_ready((b.sel,))  # dispatch-guard: ok
-        wall = _time.perf_counter() - t0
+        t2 = _time.perf_counter()
         st = self.ex.node_stats.setdefault(
-            id(node), {"rows": 0, "wall_s": 0.0, "calls": 0}
+            id(node),
+            {"rows": 0, "bytes": 0, "wall_s": 0.0,
+             "device_wall_s": 0.0, "calls": 0},
         )
-        st["rows"] = int(jnp.sum(b.sel))
-        st["wall_s"] += wall
+        rows = int(jnp.sum(b.sel))
+        cap = int(b.sel.shape[0]) if getattr(b.sel, "shape", None) else 0
+        lane_bytes = 0
+        for v in b.lanes.values():
+            parts = v if isinstance(v, tuple) else (v,)
+            lane_bytes += sum(
+                int(getattr(p, "nbytes", 0))
+                for p in parts if p is not None
+            )
+        st["rows"] = rows
+        # logical (unpadded) bytes: padded lane footprint scaled by the
+        # live-row fraction, matching rows x width hand-computation
+        st["bytes"] = (
+            int(lane_bytes * rows / cap) if cap else lane_bytes
+        )
+        st["wall_s"] += t2 - t0
+        st["device_wall_s"] = st.get("device_wall_s", 0.0) + (t2 - t1)
         st["calls"] += 1
         return b
 

@@ -13,7 +13,15 @@ Two always-on production-profiling surfaces in the spirit of Kanev et al.
   yielding effective GB/s and %-of-roofline per compiled program
   (``bandwidth_ledger`` session property), surfaced through EXPLAIN
   ANALYZE, ``/v1/query/{id}/profile``, ``system.runtime.kernel_bandwidth``
-  and the ``trino_tpu_kernel_bandwidth_*`` histograms.
+  and the ``trino_tpu_kernel_bandwidth_*`` histograms;
+- :mod:`.opstats` — per-operator OperatorStats frames (rows/bytes/wall/
+  blocked-time, estimated vs observed rows) rolled up pipeline -> task ->
+  stage -> query into the EXPLAIN ANALYZE / ``system.runtime.operator_stats``
+  timeline, plus the live wall-dispersion straggler detector feeding FTE
+  hedging (``trino_tpu_straggler_*`` metrics);
+- :mod:`.history` — crash-safe byte-bounded persisted query history
+  (``query_history_dir``), same torn-tail-tolerant mmap'd JSONL shape as
+  the flight recorder, backing ``system.runtime.completed_queries``.
 """
 from .bandwidth import BandwidthLedger, roofline_bytes_per_s
 from .flight_recorder import (
@@ -22,6 +30,21 @@ from .flight_recorder import (
     last_recorder,
     last_unmatched,
     read_dir,
+)
+from .history import (
+    HISTORY_FIELDS,
+    QueryHistoryStore,
+    get_store,
+    read_history_dir,
+)
+from .opstats import (
+    OPERATOR_FIELDS,
+    StragglerDetector,
+    format_timeline,
+    frames_from_plan,
+    merge_frames,
+    task_rollup,
+    timeline_from_tasks,
 )
 
 __all__ = [
@@ -32,4 +55,15 @@ __all__ = [
     "last_recorder",
     "last_unmatched",
     "read_dir",
+    "HISTORY_FIELDS",
+    "QueryHistoryStore",
+    "get_store",
+    "read_history_dir",
+    "OPERATOR_FIELDS",
+    "StragglerDetector",
+    "format_timeline",
+    "frames_from_plan",
+    "merge_frames",
+    "task_rollup",
+    "timeline_from_tasks",
 ]

@@ -61,6 +61,11 @@ class QueryExecution:
         self.error: Optional[str] = None
         self.retry_count = 0  # whole-query re-runs under retry_policy=query
         self.adaptive_actions: list = []  # FTE mid-query replan records
+        self.task_stats: list = []  # per-task stats docs (TaskInfo rollup)
+        self.timeline: Optional[dict] = None  # merged operator timeline
+        self.straggler_flags: list = []  # dispersion-detector verdicts
+        self.session_executed = False  # ran via session.execute (history
+        #                                already recorded there)
         self.page: Optional[Page] = None
         self.types = None
         self.created = time.time()
@@ -111,6 +116,22 @@ class Coordinator:
         # memory admission gate (resource-group softMemoryLimit role):
         # queries wait in QUEUED until their estimated peak fits
         self.admission = MemoryAdmissionController(self._memory_capacity)
+        # live straggler detector fed by announcement-piggybacked task
+        # rollups (obs/opstats); one summary per task id, ever
+        from ..obs.opstats import StragglerDetector
+
+        self.straggler_detector = StragglerDetector(
+            factor=float(
+                session.properties.get("straggler_dispersion_factor")
+                or 2.0
+            ),
+            min_s=float(
+                session.properties.get("fte_speculation_min_s") or 0.75
+            ),
+        )
+        self._opstats_seen: set = set()
+        self._opstats_by_stage: Dict[tuple, list] = {}
+        self._opstats_lock = threading.Lock()
         self._stop_enforcement = threading.Event()
         if distributed:
             threading.Thread(
@@ -283,8 +304,74 @@ class Coordinator:
             REGISTRY.histogram(
                 "trino_tpu_query_wall_seconds", "End-to-end query wall time"
             ).observe((q.finished or time.time()) - q.created)
+            try:
+                self._finalize_query(q)
+            except Exception:
+                pass  # observability must never fail the query
             if q.group is not None:
                 q.group.finish()
+
+    def ingest_opstats(self, node_id: str, summaries) -> None:
+        """Heartbeat piggyback: each worker announce carries its recent
+        per-task rollups.  New task ids are grouped by stage and replayed
+        through the live straggler detector so dispersion flags exist
+        while the query still runs (not just at the terminal merge)."""
+        from ..obs.opstats import _stage_of
+
+        changed = {}
+        with self._opstats_lock:
+            for s in summaries or ():
+                tid = s.get("taskId")
+                if not tid or tid in self._opstats_seen:
+                    continue
+                self._opstats_seen.add(tid)
+                entry = dict(s)
+                entry["nodeId"] = node_id
+                stage = _stage_of(tid)
+                self._opstats_by_stage.setdefault(stage, []).append(entry)
+                changed[stage] = list(self._opstats_by_stage[stage])
+        for stage, entries in changed.items():
+            self.straggler_detector.observe_stage(stage, entries)
+
+    def _finalize_query(self, q: QueryExecution) -> None:
+        """Terminal observability: merge per-task operator rollups into
+        the query timeline (QueryStats.operatorSummaries analog) and
+        persist the completed query into the crash-safe history store."""
+        from ..obs import opstats as _opstats
+        from ..obs.history import get_store
+
+        tasks = getattr(q, "task_stats", None) or []
+        if tasks and q.timeline is None:
+            # fresh detector per merge so the timeline's straggler list
+            # reflects this query alone (the live detector accumulates
+            # across queries for metrics/announce flags)
+            det = _opstats.StragglerDetector(
+                factor=self.straggler_detector.factor,
+                min_s=self.straggler_detector.min_s,
+            )
+            q.timeline = _opstats.timeline_from_tasks(tasks, detector=det)
+            q.straggler_flags = list(q.straggler_flags or []) + det.flags
+        if q.session_executed:
+            return  # session.execute already recorded this query
+        store = get_store(
+            self.session.properties.get("query_history_dir") or None,
+            max_bytes=int(
+                self.session.properties.get("query_history_max_bytes")
+                or (1 << 20)
+            ),
+        )
+        store.put({
+            "query_id": q.query_id,
+            "state": q.state,
+            "sql": q.sql,
+            "user": q.user,
+            "created": q.created,
+            "finished": q.finished,
+            "rows": int(q.page.count) if q.page is not None else 0,
+            "wall_s": (q.finished or time.time()) - q.created,
+            "error": q.error,
+            "operators": (q.timeline or {}).get("operators") or None,
+        })
 
     def _plan_is_coordinator_only(self, plan) -> bool:
         """True when the plan scans a connector marked coordinator_only
@@ -354,6 +441,7 @@ class Coordinator:
                     q.kernel_profile = getattr(
                         self.session, "last_kernel_profile", None
                     )
+                    q.session_executed = True
                     return page
                 workers = self.node_manager.alive()
                 if not workers:
@@ -406,6 +494,11 @@ class Coordinator:
                         props.get("device_watchdog_timeout_s"),
                     "device_cpu_fallback":
                         props.get("device_cpu_fallback"),
+                    # per-operator timeline (obs/opstats): workers run
+                    # eager with node stats and roll frames into TaskInfo
+                    "operator_stats": props.get("operator_stats"),
+                    "straggler_dispersion_factor":
+                        props.get("straggler_dispersion_factor"),
                 }
                 try:
                     # the query span parents every scheduler dispatch made
@@ -422,6 +515,15 @@ class Coordinator:
                             )
                             page = fte.run(plan, q.query_id)
                             q.adaptive_actions = fte.adaptive_actions
+                            q.task_stats = getattr(
+                                fte, "task_stats", []
+                            )
+                            q.straggler_flags = list(
+                                getattr(
+                                    getattr(fte, "straggler", None),
+                                    "flags", (),
+                                )
+                            )
                         elif props.get("retry_policy") == "query":
                             page = self._run_with_query_retries(
                                 q, plan, workers, task_props, props
@@ -445,6 +547,7 @@ class Coordinator:
         # in-process execution: the session-side executor's kernel profile
         # feeds /v1/query/{id}/profile for coordinator-only clusters
         q.kernel_profile = getattr(self.session, "last_kernel_profile", None)
+        q.session_executed = True
         return page
 
     def _run_with_query_retries(
@@ -695,6 +798,12 @@ class _Handler(BaseHTTPRequestHandler):
                     self.coordinator.cluster_memory.update_node(
                         doc["nodeId"], doc["memory"]
                     )
+                if doc.get("opstats"):
+                    # heartbeat-piggybacked per-task rollups feed the
+                    # live straggler detector
+                    self.coordinator.ingest_opstats(
+                        doc["nodeId"], doc["opstats"]
+                    )
             self._json(202, {})
         else:
             self._json(404, {"error": "not found"})
@@ -831,6 +940,10 @@ class _Handler(BaseHTTPRequestHandler):
                         ),
                         "tasks": getattr(q, "task_stats", []),
                     },
+                    # merged per-operator timeline (stage -> task -> op)
+                    # with dispersion-detector straggler verdicts
+                    "timeline": getattr(q, "timeline", None),
+                    "stragglers": getattr(q, "straggler_flags", []),
                 })
             return
         if self.path == "/v1/query":

@@ -134,6 +134,19 @@ class FaultTolerantScheduler:
         self.spec_min_s = float(
             p.get("fte_speculation_min_s") or SPECULATION_MIN_S
         )
+        # dispersion-aware speculation (obs/opstats): a primary is hedged
+        # when its elapsed wall sits `straggler_dispersion_factor` robust
+        # deviations above the sibling median, replacing the fixed
+        # spec_factor*median age rule (which ignored how tight the
+        # sibling distribution actually was)
+        from ..obs.opstats import StragglerDetector
+
+        self.straggler = StragglerDetector(
+            factor=float(
+                p.get("straggler_dispersion_factor") or 2.0
+            ),
+            min_s=self.spec_min_s,
+        )
 
     # ------------------------------------------------------------------
     def run(self, plan: P.Output, query_id: Optional[str] = None) -> Page:
@@ -152,6 +165,8 @@ class FaultTolerantScheduler:
         self.output_rows: Dict[int, int] = {}
         self.fragment_estimates: Dict[int, float] = {}
         self.adaptive_actions: List[dict] = []
+        # winning-attempt TaskInfo stats (operator timeline merge input)
+        self.task_stats: List[dict] = []
         # committed stages survive a replan when the new topology contains
         # a structurally identical fragment: spools are reused by signature
         committed_by_sig: Dict[str, List[str]] = {}
@@ -870,13 +885,15 @@ class FaultTolerantScheduler:
                         and not launched_backup
                         and next_attempt < max_attempt
                         and sibling_times
-                        and time.time() - t0
-                        > max(
-                            self.spec_min_s,
-                            self.spec_factor * _median(sibling_times),
+                        and self.straggler.should_hedge(
+                            time.time() - t0, list(sibling_times)
                         )
                     ):
                         launched_backup = True
+                        self.straggler.record_hedge(
+                            f.id, task_id, uri,
+                            time.time() - t0, list(sibling_times),
+                        )
                         battempt = next_attempt
                         next_attempt += 1
                         b = {"done": False, "path": None, "duration": 0.0,
@@ -911,6 +928,19 @@ class FaultTolerantScheduler:
                     raise SchedulerError(
                         f"task {task_id} finished without committing spool"
                     )
+                # winning attempt: pull its TaskInfo stats (operator
+                # frames ride "operatorStats") for the query timeline
+                try:
+                    with urllib.request.urlopen(
+                        f"{uri}/v1/task/{task_id}", timeout=5.0
+                    ) as resp:
+                        info = json.loads(resp.read())
+                    self.task_stats.append({
+                        "taskId": task_id, "uri": uri,
+                        "stats": info.get("stats") or {},
+                    })
+                except Exception:
+                    pass
                 # primary won: abort any still-running backup (frees the
                 # worker; the loser's spool dir is never read)
                 for b in backups:

@@ -95,6 +95,11 @@ class TaskManager:
         # property: all tasks of a query share the spec, hence the
         # injector, hence one deterministic call counter per worker
         self._injectors: Dict[str, FaultInjector] = {}
+        # bounded ring of completed-task OperatorStats summaries: rides
+        # the announce loop to the coordinator's live straggler detector
+        from collections import deque
+
+        self.recent_opstats: deque = deque(maxlen=64)
 
     def create_or_update(self, task_id: str, doc: dict) -> TaskExecution:
         with self.lock:
@@ -224,23 +229,61 @@ class TaskManager:
                 if inj.enabled():
                     self.supervisor.fault_injector = inj
                 config["device_supervisor"] = self.supervisor
+            if config.get("operator_stats"):
+                # per-operator timeline: forces the eager (non-jitted)
+                # path so _TraceCtx can bracket every operator visit
+                config["collect_node_stats"] = True
             ex = FragmentExecutor(
                 self.catalogs, config, splits_by_scan, remote_pages, dfs
+            )
+            # blocked-on-exchange: the wall this task spent pulling its
+            # remote source pages before any operator could run
+            ex.blocked_exchange_s = float(
+                getattr(client, "last_fetch_wall_s", 0.0)
             )
             import time as _time
 
             _t0 = _time.time()
             with TRACER.span("fragment_execute", task_id=t.task_id):
                 page = ex.execute(plan)
+            wall_s = _time.time() - _t0
+            from ..obs import opstats as _opstats
+
+            frames = (
+                _opstats.frames_from_plan(
+                    plan, ex.node_stats,
+                    blocked_memory_s=ex.blocked_memory_s,
+                    blocked_exchange_s=ex.blocked_exchange_s,
+                )
+                if ex.node_stats else []
+            )
+            op_rollup = _opstats.task_rollup(
+                frames, wall_s=wall_s,
+                blocked_memory_s=ex.blocked_memory_s,
+                blocked_exchange_s=ex.blocked_exchange_s,
+            )
+            op_rollup["outputRows"] = page.count
             t.stats = {
                 "dynamicFilterRowsPruned": ex.df_rows_pruned,
                 "scanBytes": ex.scan_bytes,
                 "outputRows": page.count,
-                "wallMillis": int((_time.time() - _t0) * 1000),
+                "wallMillis": int(wall_s * 1000),
                 # per-kernel compile wall / recompiles / padding — rides
                 # the existing stats rollup back to the coordinator
                 "kernelProfile": getattr(ex, "kernel_profile", None),
+                # pipeline -> task OperatorStats rollup (frames only when
+                # operator_stats forced the instrumented eager path)
+                "operatorStats": op_rollup,
             }
+            # stage-rollup summaries piggyback on the next announcement
+            # round (the coordinator's live straggler detector input)
+            self.recent_opstats.append({
+                "taskId": t.task_id,
+                "wallS": wall_s,
+                "outputRows": int(page.count),
+                "blockedExchangeS": ex.blocked_exchange_s,
+                "blockedMemoryS": ex.blocked_memory_s,
+            })
             out = doc.get("output") or {}
             part = out.get("partitioning", "single")
             nbuffers = int(out.get("nbuffers", 1))
@@ -615,6 +658,9 @@ class WorkerServer:
                     "uri": self.uri,
                     "memory": self.memory_manager.snapshot(),
                     "device": self.supervisor.snapshot(),
+                    # completed-task wall/row rollups for the
+                    # coordinator's live straggler detector
+                    "opstats": list(self.task_manager.recent_opstats),
                 }).encode()
                 req = urllib.request.Request(
                     f"{self.coordinator_uri}/v1/announcement",

@@ -87,8 +87,23 @@ class Session:
 
         self.identity = Identity(user)
         self.access_control = AccessControlManager()
-        # system.runtime.queries backing store (QueryTracker history)
-        self.query_history: list = []
+        # system.runtime.queries / completed_queries backing store
+        # (QueryTracker history): crash-safe persisted store shared by
+        # ALL sessions, bounded by bytes (not count) — mmap'd JSONL
+        # segments in obs/history survive kill -9 up to the torn tail
+        from .obs.history import get_store as _history_store
+
+        self.history = _history_store(
+            self.properties.get("query_history_dir") or None,
+            max_bytes=int(
+                self.properties.get("query_history_max_bytes")
+                or (1 << 20)
+            ),
+        )
+        # operator timeline of the last instrumented execution (EXPLAIN
+        # ANALYZE / operator_stats=true), backing
+        # system.runtime.operator_stats
+        self.last_timeline: Optional[dict] = None
         # the built-in system catalog (system.runtime.* etc.)
         from .connectors.system import SystemConnectorFactory
 
@@ -140,6 +155,25 @@ class Session:
         if self.default_catalog is None:
             self.default_catalog = name
 
+    @property
+    def query_history(self) -> list:
+        """Legacy-shaped view over the persisted history store (the
+        system.runtime.queries backing read): latest record per query,
+        across every session sharing the store."""
+        out = []
+        for r in self.history.entries():
+            out.append({
+                "query_id": r.get("queryId"),
+                "state": r.get("state"),
+                "sql": r.get("sql"),
+                "user": r.get("user"),
+                "created": r.get("created"),
+                "finished": r.get("finished"),
+                "rows": r.get("rows"),
+                "error": r.get("error"),
+            })
+        return out
+
     # ------------------------------------------------------------------
     def _executor(self):
         # SET SESSION query_max_memory_bytes resizes the pool for later
@@ -173,6 +207,11 @@ class Session:
             ),
             "topn_initial_factor": self.properties.get(
                 "topn_initial_factor"
+            ),
+            # operator_stats=true runs eager with per-node timing (jit
+            # would fuse the fragment and hide the operator boundaries)
+            "collect_node_stats": bool(
+                self.properties.get("operator_stats")
             ),
         }
         exec_config["jit_fragments"] = bool(
@@ -233,8 +272,7 @@ class Session:
             "query_id": query_id, "sql": sql, "state": "RUNNING",
             "user": identity.user, "created": created,
         }
-        self.query_history.append(entry)
-        del self.query_history[:-1000]  # bounded history
+        self.history.put(entry)
         try:
             with self.tracer.span("query", query_id=query_id):
                 with self.tracer.span("parse"):
@@ -248,8 +286,14 @@ class Session:
             )
             entry.update(
                 state="FINISHED", finished=time.time(),
-                rows=page.count,
+                rows=page.count, wall_s=time.time() - created,
             )
+            # only THIS query's timeline (last_timeline is kept across
+            # queries so system.runtime.operator_stats can read it)
+            tl = self.last_timeline
+            if tl and tl.get("queryId") == query_id:
+                entry["operators"] = tl.get("operators")
+            self.history.put(entry)
             return page
         except Exception as e:
             self.events.query_completed(
@@ -257,8 +301,9 @@ class Session:
             )
             entry.update(
                 state="FAILED", finished=time.time(),
-                error=str(e),
+                error=str(e), wall_s=time.time() - created,
             )
+            self.history.put(entry)
             raise
         finally:
             # batch-export completed spans on EVERY completion path —
@@ -679,12 +724,32 @@ class Session:
                 return page
         executor = self._executor()
         with self.tracer.span("execute", query_id=query_id):
+            _t0 = time.time()
             page = executor.execute(plan)
+            _exec_wall = time.time() - _t0
         # input working-set size of the last query (bench + stats surface)
         self.last_scan_bytes = getattr(executor, "scan_bytes", 0)
         # per-query TPU kernel profile (compile wall / recompiles /
         # padding), surfaced via /v1/query/{id}/profile and bench output
         self.last_kernel_profile = getattr(executor, "kernel_profile", None)
+        if getattr(executor, "node_stats", None):
+            # operator_stats=true: node stats -> OperatorStats frames
+            # (system.runtime.operator_stats + history "operators")
+            from .obs import opstats as _opstats
+
+            self.last_timeline = {
+                "queryId": query_id,
+                "wallS": _exec_wall,
+                "operators": _opstats.frames_from_plan(
+                    plan, executor.node_stats,
+                    blocked_memory_s=getattr(
+                        executor, "blocked_memory_s", 0.0
+                    ),
+                    blocked_exchange_s=getattr(
+                        executor, "blocked_exchange_s", 0.0
+                    ),
+                ),
+            }
         if rkey is not None:
             self.store_result(rkey, page, plan)
         if not isinstance(stmt, ast.Query):
@@ -796,6 +861,29 @@ class Session:
             f"\n\nQuery: {page.count} output rows in {wall * 1000:.2f}ms "
             f"(single node)"
         )
+        # per-operator timeline (OperatorStats frames): estimated rows
+        # come from the cost model so estimate-vs-observed divergence is
+        # visible per operator
+        from .obs import opstats as _opstats
+
+        costs = None
+        try:
+            from .plan.cost import annotate
+
+            costs = annotate(plan, self.metadata, self.properties)
+        except Exception:
+            pass
+        frames = _opstats.frames_from_plan(
+            plan, executor.node_stats, costs=costs,
+            blocked_memory_s=getattr(executor, "blocked_memory_s", 0.0),
+            blocked_exchange_s=getattr(
+                executor, "blocked_exchange_s", 0.0
+            ),
+        )
+        self.last_timeline = {
+            "queryId": query_id, "wallS": wall, "operators": frames,
+        }
+        text += "\n\n" + _opstats.format_timeline(frames, wall)
         prof = self.last_kernel_profile or {}
         summary = prof.get("summary") or {}
         if summary:

@@ -40,6 +40,7 @@ METRIC_SUBSYSTEMS = (
     "memory",
     "stats",
     "device",
+    "straggler",
 )
 
 METRIC_NAME_RE = re.compile(
