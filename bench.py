@@ -430,6 +430,27 @@ def _time_config(session, sql, rows, iters):
             }
             for e in bw[:5]
         ]
+    # fusion / donation / double-buffer engagement: wall time alone cannot
+    # say whether the fused megakernel path, page donation, or the staged
+    # H2D pipeline actually ran for this config, so the counters travel
+    # with every BENCH artifact (bench_sentinel diffs effective GB/s)
+    counters = {
+        k: prof[k]
+        for k in ("fusedAggregates", "fusedTerms", "fusionRejects",
+                  "donated_dispatches", "donated_bytes",
+                  "preuploads", "preupload_bytes")
+        if prof.get(k)
+    }
+    if prof.get("lastFusionReject"):
+        counters["lastFusionReject"] = prof["lastFusionReject"]
+    try:
+        counters["double_buffer_depth"] = int(
+            session.properties.get("double_buffer_depth") or 1
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    if counters:
+        out["exec_counters"] = counters
     return out
 
 

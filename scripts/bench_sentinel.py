@@ -15,6 +15,13 @@ round:
                       throughput dropped past the threshold
     improved          headline throughput rose past the threshold
     steady            comparable and within thresholds
+    bandwidth-regression
+                      wall time held, but the bandwidth ledger's
+                      effective GB/s dropped past the threshold — the
+                      same answer is moving more bytes (fusion fell
+                      back, donation stopped, pages re-uploading); only
+                      issued when both rounds carry per-config
+                      effective_gbps data
     unknown           ran clean but shares no metric names with any
                       earlier round (nothing to diff)
 
@@ -41,6 +48,7 @@ from typing import Dict, List, Optional
 
 REGRESSION_RATIO = 0.70   # geomean throughput below this => regression
 IMPROVED_RATIO = 1.25     # ...above this => improved
+BW_REGRESSION_RATIO = 0.70  # effective GB/s below this while wall holds
 
 # hard-crash signatures: runtime death, not ordinary query errors (a
 # compile HTTP 500 is a failure, but nobody's process died)
@@ -117,10 +125,16 @@ def load_round(path: str) -> dict:
             )
     else:
         configs = recover_configs(tail)
+    bandwidth: Dict[str, float] = {}
     for name, cfg in configs.items():
-        rps = cfg.get("rows_per_sec") if isinstance(cfg, dict) else None
+        if not isinstance(cfg, dict):
+            continue
+        rps = cfg.get("rows_per_sec")
         if isinstance(rps, (int, float)):
             metrics[name] = float(rps)
+        gbps = cfg.get("effective_gbps")
+        if isinstance(gbps, (int, float)) and gbps > 0:
+            bandwidth[name] = float(gbps)
     blob = tail + (json.dumps(parsed) if parsed else "")
     crashes = sum(blob.count(sig) for sig in CRASH_SIGNATURES)
     errors = sum(
@@ -146,6 +160,7 @@ def load_round(path: str) -> dict:
         "file": os.path.basename(path),
         "rc": int(wrapper.get("rc") or 0),
         "metrics": metrics,
+        "bandwidth": bandwidth,
         "crashes": crashes,
         "errors": errors,
         "op_walls": op_walls,
@@ -253,6 +268,24 @@ def judge(rounds: List[dict]) -> List[dict]:
                 elif ratio > IMPROVED_RATIO:
                     v["verdict"] = "improved"
                 v["reason"] = detail
+                if v["verdict"] in ("steady", "improved"):
+                    # wall held — but did the bytes? a round that keeps
+                    # rows/s while its ledger GB/s collapses is moving
+                    # more bytes for the same answer (fusion fell back,
+                    # donation stopped, pages re-uploading each tile)
+                    bw_ratio, bw_common = _geomean_ratio(
+                        r.get("bandwidth") or {},
+                        baseline.get("bandwidth") or {},
+                    )
+                    if bw_ratio is not None:
+                        v["bw_ratio"] = round(bw_ratio, 4)
+                        if bw_ratio < BW_REGRESSION_RATIO:
+                            v["verdict"] = "bandwidth-regression"
+                            v["reason"] = detail + (
+                                "; effective GB/s geomean x%.2f over %d "
+                                "config(s) despite wall holding"
+                                % (bw_ratio, len(bw_common))
+                            )
         verdicts.append(v)
     return verdicts
 
@@ -273,7 +306,9 @@ def to_markdown(verdicts: List[dict]) -> str:
         )
     flagged = [
         v for v in verdicts
-        if v["verdict"] in ("regression", "crash-introduced")
+        if v["verdict"] in (
+            "regression", "crash-introduced", "bandwidth-regression",
+        )
     ]
     lines.append("")
     if flagged:

@@ -1,11 +1,13 @@
 #!/usr/bin/env python
 """Run every repo linter (``scripts/check_*.py``) in one pass.
 
-Aggregates the three source linters:
+Aggregates the source linters:
 
   - ``check_dispatch_guard.py``  — no unguarded device dispatch
   - ``check_metric_names.py``    — metric/span/wire-record naming
   - ``check_session_props.py``   — session-property hygiene
+  - ``check_donation.py``        — hot-path jits declare donation (or a
+    ``# no-donate:`` reason); pallas kernels are registry-attributed
 
 Exit code is non-zero when ANY linter fails; each linter's own output is
 printed under a header.  Wired into tier-1 via tests/test_lint.py, so a
@@ -19,6 +21,7 @@ import sys
 sys.path.insert(0, os.path.dirname(__file__))
 
 import check_dispatch_guard  # noqa: E402
+import check_donation  # noqa: E402
 import check_metric_names  # noqa: E402
 import check_session_props  # noqa: E402
 
@@ -26,6 +29,7 @@ LINTERS = (
     ("check_dispatch_guard", check_dispatch_guard),
     ("check_metric_names", check_metric_names),
     ("check_session_props", check_session_props),
+    ("check_donation", check_donation),
 )
 
 

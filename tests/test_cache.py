@@ -365,7 +365,7 @@ def test_poisoned_entry_recompiled_exactly_once():
     entry = cc._entries[key]
     real_fn, calls = entry["fn"], {"n": 0}
 
-    def faulting(prep):
+    def faulting(resident_prep, tile_prep):
         calls["n"] += 1
         raise jax.errors.JaxRuntimeError(
             "INVALID_ARGUMENT: executable reuse fault (injected)"

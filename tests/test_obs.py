@@ -291,6 +291,37 @@ def test_sentinel_synthetic_trajectory(tmp_path):
     }
 
 
+def test_sentinel_flags_bandwidth_regression_when_wall_holds(tmp_path):
+    """Rows/s steady but the ledger's effective GB/s collapses: the same
+    answer is moving more bytes (fusion fell back, donation stopped) —
+    the sentinel must flag it even though wall-clock verdicts say steady.
+    Rounds without bandwidth data are never judged on it."""
+    cfg = lambda rps, gbps=None: {"configs": {"q6": dict(  # noqa: E731
+        {"rows_per_sec": rps},
+        **({"effective_gbps": gbps} if gbps is not None else {}),
+    )}}
+    _write_rounds(tmp_path, [
+        (1, _wrap(1, 0, cfg(100.0, 30.0))),   # baseline
+        (2, _wrap(2, 0, cfg(101.0, 12.0))),   # wall holds, GB/s x0.40
+        (3, _wrap(3, 0, cfg(100.0, 11.9))),   # vs r02: both hold now
+        (4, _wrap(4, 0, cfg(102.0))),         # no ledger data: no verdict
+    ])
+    rounds = [
+        bench_sentinel.load_round(p)
+        for p in sorted(glob.glob(str(tmp_path / "BENCH_r*.json")))
+    ]
+    verdicts = bench_sentinel.judge(rounds)
+    by_round = {v["round"]: v for v in verdicts}
+    assert by_round[2]["verdict"] == "bandwidth-regression"
+    assert by_round[2]["bw_ratio"] == 0.4
+    assert "despite wall holding" in by_round[2]["reason"]
+    assert by_round[3]["verdict"] == "steady"
+    assert by_round[4]["verdict"] == "steady"
+    assert "bw_ratio" not in by_round[4]
+    md = bench_sentinel.to_markdown(verdicts)
+    assert "r02 (bandwidth-regression)" in md
+
+
 def test_sentinel_timeout_round_is_regression(tmp_path):
     _write_rounds(tmp_path, [
         (1, _wrap(1, 0, {"configs": {"q6": {"rows_per_sec": 10.0}}})),

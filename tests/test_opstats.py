@@ -261,7 +261,13 @@ def test_seeded_slow_worker_is_flagged_and_hedged():
             if f.get("action") == "hedge"
         ]
         assert hedged, "stalled task was never hedged"
-        q, flag = hedged[-1]
+        # under a loaded host other stages can trip the dispersion
+        # trigger too — assert on the SEEDED task's flag, not the last
+        seeded = [
+            (q, f) for q, f in hedged if ".1.0." in f.get("task", "")
+        ]
+        assert seeded, "the seeded stalled task was never hedged"
+        q, flag = seeded[-1]
         assert flag["stage"] == "1"
         assert ".1.0." in flag["task"]
         assert flag["elapsedS"] >= 0.3
@@ -305,8 +311,43 @@ def test_sentinel_regression_names_worst_operator():
 # --- lint wiring ---------------------------------------------------------
 
 
-def test_lint_runs_all_three_checkers_clean(capsys):
+def test_lint_runs_all_checkers_clean(capsys):
     assert lint.main() == 0
     out = capsys.readouterr().out
     for name, _ in lint.LINTERS:
         assert name in out
+    assert "check_donation" in out
+
+
+def test_donation_lint_flags_bare_jit_and_unregistered_kernel(tmp_path):
+    """A bare hot-path jit (no donate_argnums, no waiver) and a kernel
+    missing from KERNEL_REGISTRY must both be violations; the waiver
+    comment and a donate_argnums continuation line must both pass."""
+    import check_donation
+
+    root = str(tmp_path)
+    ops = os.path.join(root, "trino_tpu", "ops")
+    os.makedirs(os.path.join(root, "trino_tpu", "exec"))
+    os.makedirs(os.path.join(root, "trino_tpu", "connectors"))
+    os.makedirs(ops)
+    with open(os.path.join(root, "trino_tpu", "exec", "hot.py"), "w") as f:
+        f.write(
+            "bad = jax.jit(fn)\n"
+            "ok1 = jax.jit(\n"
+            "    fn, donate_argnums=(1,)\n"
+            ")\n"
+            "# no-donate: scalar args only\n"
+            "ok2 = jax.jit(fn)\n"
+        )
+    with open(os.path.join(ops, "pallas_kernels.py"), "w") as f:
+        f.write(
+            "def _good_kernel(ref):\n    pass\n\n"
+            "def _rogue_kernel(ref):\n    pass\n\n"
+            'KERNEL_REGISTRY = {\n    "_good_kernel": {},\n}\n'
+        )
+    checked, violations = check_donation.check_tree(root)
+    assert checked == 5  # 3 jit sites + 2 kernel defs
+    msgs = {(r, n) for r, n, _m in violations}
+    assert (os.path.join("trino_tpu", "exec", "hot.py"), 1) in msgs
+    assert (os.path.join("trino_tpu", "ops", "pallas_kernels.py"), 4) in msgs
+    assert len(violations) == 2

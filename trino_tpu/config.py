@@ -35,6 +35,13 @@ def _retry_policy(s: str) -> str:
     return v
 
 
+def _megakernels(s: str) -> str:
+    v = str(s).strip().lower()
+    if v not in ("auto", "on", "off"):
+        raise ValueError(f"megakernels must be auto|on|off, got: {s}")
+    return v
+
+
 def _join_distribution(s: str) -> str:
     v = str(s).strip().lower()
     if v not in ("automatic", "broadcast", "partitioned"):
@@ -308,6 +315,27 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "device_generation",
             "materialize counter-based generator scans (tpch) directly "
             "in HBM instead of host numpy + upload",
+            _bool, True,
+        ),
+        PropertyMetadata(
+            "megakernels",
+            "fused scan->filter->aggregate pallas megakernels (one VMEM "
+            "pass per scan column): auto (TPU only) | on (forces "
+            "interpret mode off-TPU, for parity tests) | off",
+            _megakernels, "auto",
+        ),
+        PropertyMetadata(
+            "double_buffer_depth",
+            "streaming tiles staged (host-decoded + H2D-uploaded) ahead "
+            "of the executing tile; each staged tile holds its scan "
+            "working set in HBM",
+            int, 1,
+        ),
+        PropertyMetadata(
+            "donate_pages",
+            "donate per-dispatch scan-page buffers to the fused program "
+            "(jit donate_argnums) so XLA reuses their HBM in place; "
+            "cache-resident pages are never donated",
             _bool, True,
         ),
         PropertyMetadata(

@@ -704,6 +704,9 @@ class TpchMetadata(ConnectorMetadata):
             "l_commitdate": (8065.0, 10530.0),  # orderdate+30..90
             "l_receiptdate": (8037.0, 10591.0),  # shipdate+1..30
             "l_quantity": (100.0, 5000.0),      # 1..50 (x100 lanes)
+            # qty x retail price cents: [1x90000, 50x209900] — the
+            # megakernel's interval proofs need this bound
+            "l_extendedprice": (90000.0, 10495000.0),
             "l_discount": (0.0, 10.0),          # 0.00..0.10 (x100)
             "l_tax": (0.0, 8.0),                # 0.00..0.08 (x100)
             "l_linenumber": (1.0, 7.0),

@@ -282,6 +282,17 @@ def _lineitem(cols, oj, ln, n: Dict[str, int]):
 _JIT_CACHE: Dict[tuple, object] = {}
 
 
+def clear_jit_cache() -> int:
+    """Drop every compiled generator executable.  Called by the executor
+    on poisoned-executable eviction and on CPU-fallback entry: these
+    executables are bound to the faulted device, and this module-level
+    cache was exempt from the executor's jit-cache eviction until the
+    BENCH_r05 crash traced back to a re-dispatched stale generator."""
+    n = len(_JIT_CACHE)
+    _JIT_CACHE.clear()
+    return n
+
+
 def _gen_flat(table: str, cols: tuple, cap: int, sf: float):
     n = H._counts(sf)
 
@@ -295,6 +306,7 @@ def _gen_flat(table: str, cols: tuple, cap: int, sf: float):
             for c, v in vals.items()
         }
 
+    # no-donate: generator args are two scalars (lo, hi); lanes are outputs
     return jax.jit(fn)
 
 
@@ -321,6 +333,7 @@ def _gen_lineitem(cols: tuple, cap_orders: int, cap_rows: int, sf: float):
             for c, v in vals.items()
         }
 
+    # no-donate: generator args are two scalars (lo, hi); lanes are outputs
     return jax.jit(fn)
 
 

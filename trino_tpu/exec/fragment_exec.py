@@ -82,6 +82,62 @@ class FragmentExecutor(LocalExecutor):
         self._load_scans(plan, scans, dicts, counts)
         self._preloaded = (plan, scans, dicts, counts)
 
+    def preupload(self, plan: P.PlanNode) -> None:
+        """Stage this tile's device lanes from the prefetch thread: pad +
+        enqueue the H2D copies (and devgen generator dispatches) NOW, so
+        the transfers overlap the previous tile's kernel instead of
+        serializing in front of the next dispatch.  jax transfers are
+        async — this returns once the copies are enqueued, and the
+        execute thread consumes the staged lanes from `_preuploaded`.
+        Supervised like any other device work (mode "h2d"), so a
+        transfer fault breadcrumbs and flight-records instead of wedging
+        the prefetch thread silently."""
+        if self._preloaded is None or self._device_fallback:
+            return
+        _plan, scans, _dicts, counts = self._preloaded
+        staged = getattr(self, "_preuploaded", None)
+        if staged is None:
+            staged = self._preuploaded = {}
+        for nid, arrays in scans.items():
+            if nid in staged:
+                continue
+            node = self._scan_node_by_id(plan, nid)
+            bc = self._dispatch_crumb(
+                "h2d:%s" % getattr(node, "table", "remote"), "h2d",
+                tree={"scan": arrays},
+            )
+            lanes = self._dispatch(
+                lambda a=arrays, n=node, c=counts[nid], i=nid:
+                    self._device_lanes(n, a, c, nid=i),
+                bc,
+            )
+            nbytes = sum(
+                int(getattr(v, "nbytes", 0) or 0)
+                + int(getattr(ok, "nbytes", 0) or 0)
+                for v, ok in lanes.values()
+            )
+            staged[nid] = lanes
+            self.kernel_profile["preuploads"] = (
+                self.kernel_profile.get("preuploads", 0) + 1
+            )
+            self.kernel_profile["preupload_bytes"] = (
+                self.kernel_profile.get("preupload_bytes", 0) + nbytes
+            )
+
+    @staticmethod
+    def _scan_node_by_id(plan: P.PlanNode, nid: int):
+        found = [None]
+
+        def walk(n):
+            if id(n) == nid:
+                found[0] = n
+                return
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        return found[0]
+
     def _load_scans(self, node: P.PlanNode, scans, dicts, counts):
         self._scan_idx = 0
         self._load_walk(node, scans, dicts, counts)

@@ -411,6 +411,11 @@ class LocalExecutor:
         self.config = cfg
         self._preloaded = None
         self._device_fallback = True
+        # compiled devgen generators are bound to the faulted device;
+        # drop them so a recovered device recompiles fresh executables
+        from ..connectors import tpch_device
+
+        tpch_device.clear_jit_cache()
         try:
             with jax.default_device(jax.devices("cpu")[0]):
                 page = self.execute(plan)
@@ -444,6 +449,23 @@ class LocalExecutor:
         if self._device_fallback:
             return jax.device_get(objs)  # dispatch-guard: ok
         return self.supervisor.device_get(objs, bc)
+
+    def _megakernel_mode(self) -> str:
+        """Effective fused scan->filter->aggregate mode: 'on'/'off'.
+        Session prop `megakernels`: 'auto' fuses only where the pallas
+        TPU path is live (interpret-mode fusion on CPU would just slow
+        eager tests down); 'on' forces fusion (interpret mode off-TPU —
+        how the parity tests drive the fused path); 'off' disables."""
+        v = str(self.config.get("megakernels", "auto") or "auto").lower()
+        if v not in ("auto", "on", "off"):
+            v = "auto"
+        if v == "auto":
+            from ..ops import pallas_kernels
+
+            if self._device_fallback or not pallas_kernels.enabled():
+                return "off"
+            return "on"
+        return v
 
     # -- HBM bandwidth ledger ------------------------------------------
     def _ledger_input_bytes(self, scans) -> int:
@@ -766,6 +788,14 @@ class LocalExecutor:
                                     if dev:
                                         grave.append(dict(dev))
                                         dev.clear()
+                            # the devgen generators keep their own
+                            # module-level executable cache
+                            # (tpch_device._JIT_CACHE) that this eviction
+                            # used to miss: a poisoned generator would be
+                            # re-dispatched verbatim on retry (BENCH_r05)
+                            from ..connectors import tpch_device
+
+                            tpch_device.clear_jit_cache()
                             continue
                     raise
                 fell_back = False
@@ -1181,17 +1211,38 @@ class LocalExecutor:
 
     def _generate_device_scan(self, spec: dict, syms, sym_to_col, cap):
         """Run the connector's on-device generator for one scan at padded
-        capacity `cap`; returns {symbol: (values, ok)} resident in HBM."""
+        capacity `cap`; returns {symbol: (values, ok)} resident in HBM.
+
+        The generator is a first-dispatch kernel (fresh Mosaic compile per
+        new (table, cols, cap) shape), so it runs under the supervisor like
+        every other device program: the BENCH_r05 worker crash happened
+        exactly here, outside any breadcrumb, which left the flight
+        recorder blind to the culprit kernel.  The breadcrumb carries
+        synthetic output-lane shapes (the generator has no host input
+        arrays) so `scripts/flightrec.py replay` can reconstruct it."""
         from ..connectors import tpch_device
 
         cols = [sym_to_col.get(s, s) for s in syms]
         span = max(int(spec["hi"]) - int(spec["lo"]), 1)
-        lanes = tpch_device.device_lanes(
-            spec["table"], cols, int(spec["lo"]), int(spec["hi"]), cap,
-            float(spec["sf"]), int(spec["count"]),
-            cap_orders=(
-                _pad_capacity(span) if spec["table"] == "lineitem" else None
+        widths = spec.get("widths") or {}
+        bc = self._dispatch_crumb(
+            "devgen:%s" % spec["table"], "devgen"
+        )
+        bc.shapes = {
+            c: "int%d(%d,)" % (8 * int(widths.get(c, 8)), cap)
+            for c in cols
+        }
+        self.kernel_profile["last_breadcrumb"] = bc.to_dict()
+        lanes = self._dispatch(
+            lambda: tpch_device.device_lanes(
+                spec["table"], cols, int(spec["lo"]), int(spec["hi"]), cap,
+                float(spec["sf"]), int(spec["count"]),
+                cap_orders=(
+                    _pad_capacity(span)
+                    if spec["table"] == "lineitem" else None
+                ),
             ),
+            bc,
         )
         return {s: lanes[c] for s, c in zip(syms, cols)}
 
@@ -1210,6 +1261,12 @@ class LocalExecutor:
         ) or getattr(self, "_streaming_cache", None)
         if nid is None and node is not None:
             nid = id(node)
+        # lanes staged ahead by FragmentExecutor.preupload (prefetch
+        # thread): consume them instead of re-uploading.  Donatability
+        # was recorded when they were staged.
+        staged = getattr(self, "_preuploaded", None)
+        if staged and nid in staged:
+            return staged.pop(nid)
         key = self._scan_keys.get(nid) if nid is not None else None
         entry = (
             cache.get(key, record=False)
@@ -1220,6 +1277,15 @@ class LocalExecutor:
         sym_to_col = {
             s: c for s, c in getattr(node, "assignments", None) or ()
         }
+        # lanes with no cache entry are per-dispatch uploads nothing else
+        # references: the fused jit may donate their buffers back to XLA
+        # (cache-resident lanes are reused across tiles/queries and must
+        # survive the dispatch)
+        donatable = getattr(self, "_lane_donatable", None)
+        if donatable is None:
+            donatable = self._lane_donatable = {}
+        if nid is not None:
+            donatable[nid] = entry is None
         lanes = {}
         gen_out = None
         for sym, (arr, valid) in arrays.items():
@@ -1431,13 +1497,12 @@ class LocalExecutor:
         key, order, by_ord = fragment_key(
             self, plan, scans, counts, _pad_capacity
         )
-        digest = stable_key_digest(key)[:12]
-        self._last_jit_key = key
         # prep is keyed by plan ordinal, NOT id(node): dict keys are part
         # of the jit pytree structure, so id-based keys would force a
         # retrace (into the WRONG captured plan) for every session sharing
         # an entry; ordinals make the structure session-invariant
         prep = {}
+        donatable_ords = set()
         for nid, arrays in scans.items():
             lanes = dict(self._device_lanes(
                 self._scan_nodes.get(nid), arrays, counts[nid], nid
@@ -1447,14 +1512,60 @@ class LocalExecutor:
             # (streaming tiles differ by a few rows while sharing the
             # padded shape — they must share one program)
             lanes["__count__"] = jnp.asarray(counts[nid], dtype=jnp.int64)
-            prep[order.get(nid, nid)] = lanes
+            o = order.get(nid, nid)
+            prep[o] = lanes
+            if getattr(self, "_lane_donatable", {}).get(nid):
+                donatable_ords.add(o)
+        # donation split: per-dispatch scan uploads ride in a separate
+        # pytree arg the compiled program may consume in place
+        # (donate_argnums, per the pjit residency protocol) — the
+        # copy-on-write round trip for every tile page disappears.
+        # Cache-resident lanes (scan cache hits, streaming build tables)
+        # stay in the non-donated arg.  CPU donation is a no-op warning,
+        # so only a real accelerator backend donates.
+        donate = (
+            bool(self.config.get("donate_pages", True))
+            and not self._device_fallback
+            and jax.default_backend() != "cpu"
+        )
+        if not donate:
+            donatable_ords = set()
+        # the split is part of the traced structure AND of the executable
+        # contract, so it keys the cache alongside the fused-agg mode
+        key = key + (
+            ("donate", donate, tuple(sorted(donatable_ords))),
+            ("megakernels", self._megakernel_mode()),
+        )
+        digest = stable_key_digest(key)[:12]
+        self._last_jit_key = key
+        resident_prep = {
+            o: v for o, v in prep.items() if o not in donatable_ords
+        }
+        tile_prep = {
+            o: v for o, v in prep.items() if o in donatable_ords
+        }
+        if donate and tile_prep:
+            self.kernel_profile["donated_dispatches"] = (
+                self.kernel_profile.get("donated_dispatches", 0) + 1
+            )
+            self.kernel_profile["donated_bytes"] = (
+                self.kernel_profile.get("donated_bytes", 0)
+                + sum(
+                    int(getattr(x, "nbytes", 0) or 0)
+                    for lanes in tile_prep.values()
+                    for lane in lanes.values()
+                    for x in (lane if isinstance(lane, tuple) else (lane,))
+                )
+            )
         entry = cache.get(key)
         if entry is None:
             cell: Dict[str, object] = {}
             # ordinal -> id(node) of the TRACING plan, for the closure
             ids = {o: i for i, o in order.items()}
 
-            def raw(prep_arg):
+            def raw(resident_arg, tile_arg):
+                prep_arg = dict(resident_arg)
+                prep_arg.update(tile_arg)
                 ctx = self.trace_ctx_cls(
                     self,
                     {ids.get(o, o): v for o, v in prep_arg.items()},
@@ -1484,9 +1595,17 @@ class LocalExecutor:
             bc = self._dispatch_crumb(digest, "jit", prep)
             self._last_crumb = bc
             with TRACER.span("xla_compile", fragment=digest):
-                fn = jax.jit(raw)  # dispatch-guard: ok (lazy wrapper)
+                if donate and donatable_ords:
+                    fn = jax.jit(  # dispatch-guard: ok (lazy wrapper)
+                        raw, donate_argnums=(1,)
+                    )
+                else:
+                    # no-donate: cpu backend / every lane cache-resident
+                    fn = jax.jit(raw)  # dispatch-guard: ok (lazy wrapper)
                 led_t0 = time.perf_counter()
-                out = self._dispatch(lambda: fn(prep), bc)
+                out = self._dispatch(
+                    lambda: fn(resident_prep, tile_prep), bc
+                )
                 # cold entry: the bracketing wall includes trace+compile
                 # (inseparable under jax.jit); warm executions dominate
                 # the accumulated GB/s
@@ -1508,7 +1627,9 @@ class LocalExecutor:
             bc = self._dispatch_crumb(digest, "jit", prep)
             self._last_crumb = bc
             led_t0 = time.perf_counter()
-            out = self._dispatch(lambda: entry["fn"](prep), bc)
+            out = self._dispatch(
+                lambda: entry["fn"](resident_prep, tile_prep), bc
+            )
             self._ledger_bracket(out, digest, "jit", plan, scans, led_t0)
             self._record_kernel(digest, compile_s=0.0, cached=True)
         out_lanes, sel, ngroups, dup_vals, colls, wides, sflags = out
@@ -1986,6 +2107,12 @@ class _TraceCtx:
         PARTIAL accumulate raw rows; FINAL merges shipped accumulator
         columns (the distributed merge path)."""
         if b is None:
+            if node.step in ("single", "partial"):
+                from ..ops import megakernel
+
+                fused = megakernel.try_fused(self, node)
+                if fused is not None:
+                    return fused
             b = self.visit(node.source)
         types = node.source.output_types()
         b, aggs = self._agg_dict_setup(node, b)
@@ -2040,14 +2167,8 @@ class _TraceCtx:
                 lanes[hs.output] = self._host_agg_lanes(
                     hs, b.lanes, gid, b.sel, 1
                 )
-            sel = jnp.ones(1, dtype=bool)
-            # pad to 128 for consistency
-            from ..ops.wide_decimal import pad_rows
-
-            return Batch(
-                {k: (pad_rows(v, 127), jnp.pad(ok, (0, 127)))
-                 for k, (v, ok) in lanes.items()},
-                jnp.pad(sel, (0, 127)),
+            return self._finish_aggregate(
+                node, [], lanes, jnp.ones(1, dtype=bool), 1
             )
         key_lanes = [b.lanes[k] for k in node.keys]
         domains = self._direct_domains(node.keys, types)
@@ -2081,6 +2202,11 @@ class _TraceCtx:
         out = out_lanes(accs)
         for hs in host_specs:
             out[hs.output] = self._host_agg_lanes(hs, *host_src, cap)
+        return self._finish_aggregate(node, keys_out, out, present, cap)
+
+    def _finish_aggregate(self, node, keys_out, out, present, cap):
+        """Shared aggregate tail (unfused and megakernel paths): merge
+        key and output lanes, pad to the static 128-aligned capacity."""
         lanes = {}
         for k, kl in zip(node.keys, keys_out):
             lanes[k] = kl
@@ -2579,9 +2705,19 @@ class _TraceCtx:
             if d is not None and len(d) == 0:
                 d = None  # zero-row split: codes are all sentinels
             if d is not None:
-                order = np.argsort(np.asarray(d, dtype=str), kind="stable")
+                # DENSE ranks: generated dictionaries can carry duplicate
+                # strings under distinct codes, and ordinal ranks would
+                # order equal values by dictionary layout — hiding the
+                # next sort key and making the order differ between the
+                # monolithic and tiled (merged-dictionary) paths
+                dd = np.asarray(d, dtype=str)
+                order = np.argsort(dd, kind="stable")
+                sd = dd[order]
+                dense = np.zeros(len(d), dtype=np.int64)
+                if len(d) > 1:
+                    dense[1:] = np.cumsum(sd[1:] != sd[:-1])
                 ranks = np.empty(len(d), dtype=np.int64)
-                ranks[order] = np.arange(len(d))
+                ranks[order] = dense
                 v, ok = b.lanes[k.column]
                 rank_tbl = jnp.asarray(ranks)
                 safe = jnp.clip(v, 0, len(d) - 1)

@@ -390,7 +390,13 @@ def plan_sort_spill(executor, plan: P.Output, memory_limit: int):
     if scan is None or _single(plan, P.TableScan) is None:
         return None
     if _est_side(executor, scan) <= memory_limit:
-        return None
+        # the scan side fits, but reserve-before-dispatch gates on the
+        # whole compiled program (devgen temporaries included) — spill
+        # rather than let the in-core path fail its HBM reservation
+        from .streaming import estimate_program_bytes
+
+        if estimate_program_bytes(executor, plan) <= memory_limit:
+            return None
     return (sort, scan)
 
 
@@ -425,10 +431,16 @@ def execute_spilled_sort(executor, plan, sort, scan):
             oks = np.ones(total, bool)
         d = dicts.get(k.column)
         if d is not None:
-            # dictionary codes -> lexicographic ranks
-            order = np.argsort(np.asarray(d).astype(str))
+            # dictionary codes -> DENSE lexicographic ranks (duplicate
+            # values under distinct codes must tie so later keys apply)
+            dd = np.asarray(d).astype(str)
+            order = np.argsort(dd, kind="stable")
+            sd = dd[order]
+            dense = np.zeros(len(order), dtype=np.int64)
+            if len(order) > 1:
+                dense[1:] = np.cumsum(sd[1:] != sd[:-1])
             rank = np.empty(len(order), dtype=np.int64)
-            rank[order] = np.arange(len(order))
+            rank[order] = dense
             safe = np.clip(vals, 0, max(len(order) - 1, 0)).astype(np.int64)
             v = rank[safe]
         else:
@@ -495,7 +507,11 @@ def plan_window_spill(executor, plan: P.Output, memory_limit: int):
         return None
     est = _est_side(executor, scan)
     if est <= memory_limit:
-        return None
+        from .streaming import estimate_program_bytes
+
+        if estimate_program_bytes(executor, plan) <= memory_limit:
+            return None
+        est = max(est, float(memory_limit))
     npart = max(2, math.ceil(est * 2 / memory_limit))
     return (win, scan, npart)
 

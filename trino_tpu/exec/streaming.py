@@ -105,16 +105,73 @@ def _wide_agg_count(plan: P.PlanNode) -> int:
     return n
 
 
+# u64 lanes the generator program keeps live per row on top of its
+# output lanes: the row-index lane, the splitmix64 hash state, one value
+# lane (reused across columns), and lineitem's cumsum/searchsorted slot
+# machinery
+DEVGEN_TEMP_LANES = 4
+
+
+def _devgen_temp_bytes(executor, plan: P.PlanNode) -> float:
+    """HBM temporaries of on-device scan generation.  These were the
+    BENCH_r05 blind spot: estimate_program_bytes covered scan lanes and
+    wide-agg chunk temporaries, but a device-generated scan ALSO runs a
+    splitmix64 hash chain over the full padded row range, and its u64
+    intermediates sat outside the reserve-before-dispatch accounting —
+    so the first q6_sf100 generator compile exceeded the reservation and
+    killed the worker process."""
+    if not executor.config.get("device_generation", True):
+        return 0.0
+    total = 0.0
+    for sc in _find_scan_nodes(plan):
+        conn = executor.catalogs.get(sc.catalog)
+        if getattr(conn, "device_generation", None) is None:
+            continue
+        try:
+            stats = conn.metadata().get_table_statistics(sc.table)
+        except Exception:  # noqa: BLE001 — unknown stats: assume small
+            continue
+        total += float(stats.row_count) * 8.0 * DEVGEN_TEMP_LANES
+    return total
+
+
 def estimate_program_bytes(executor, plan: P.PlanNode) -> float:
     """Estimated HBM peak of the MONOLITHIC compiled program: scan lanes
-    plus wide-decimal accumulation temporaries.  Calibrated against the
+    plus wide-decimal accumulation temporaries plus on-device generator
+    temporaries.  Calibrated against the
     one measured data point — Q1 SF20 (scan est 7.1 GB, 7 wide aggs)
     compiled to a 20.6 GB buffer assignment (r04's q1_sf20 hard error:
     XLA's own message, reproduced 2026-07-31) — so the gate streams
     BEFORE submitting a compile whose OOM would crash the TPU worker
     process and poison the tunnel for the fallback."""
     scan = estimate_plan_scan_bytes(executor, plan)
-    return scan * (1.0 + 0.28 * _wide_agg_count(plan))
+    return (
+        scan * (1.0 + 0.28 * _wide_agg_count(plan))
+        + _devgen_temp_bytes(executor, plan)
+    )
+
+
+# additive per-dispatch counters a tile executor accumulates that must
+# surface in the PARENT executor's kernel profile (the session and bench
+# read only the outer profile; tile FragmentExecutors are discarded)
+_TILE_COUNTERS = (
+    "preuploads", "preupload_bytes", "donated_dispatches",
+    "donated_bytes", "fusedAggregates", "fusedTerms", "fusionRejects",
+)
+
+
+def _merge_tile_counters(executor, fe) -> None:
+    prof = fe.kernel_profile
+    for k in _TILE_COUNTERS:
+        v = prof.get(k)
+        if v:
+            executor.kernel_profile[k] = (
+                executor.kernel_profile.get(k, 0) + v
+            )
+    if prof.get("lastFusionReject"):
+        executor.kernel_profile["lastFusionReject"] = (
+            prof["lastFusionReject"]
+        )
 
 
 def plan_streaming(executor, plan: P.Output, memory_limit: int,
@@ -267,25 +324,45 @@ def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Pag
                 )
                 fe._streaming_cache = run_cache
                 fe.preload(f.root)
+                # start the next tile's H2D copies on this (prefetch)
+                # thread: jnp.asarray enqueues the transfer async, so it
+                # overlaps the CURRENT tile's kernel instead of
+                # serializing in front of the next dispatch
+                fe.preupload(f.root)
                 return fe
 
             # double-buffered tile pipeline: while tile i computes on the
             # device (the execute thread blocks in device_get), tile i+1's
-            # host arrays generate/decode on the prefetch thread — the
-            # steady state is bound by max(host, device), not their sum
-            # (SURVEY §7 hard part 6)
+            # host arrays generate/decode AND upload on the prefetch
+            # thread(s) — the steady state is bound by
+            # max(host, H2D, device), not their sum (SURVEY §7 hard part
+            # 6).  `double_buffer_depth` is how many tiles may be staged
+            # ahead of the executing one (each staged tile holds its scan
+            # working set in HBM, so depth multiplies tile residency).
+            from collections import deque
             from concurrent.futures import ThreadPoolExecutor
 
+            depth = max(
+                1, int(executor.config.get("double_buffer_depth", 1) or 1)
+            )
             out: List[Page] = []
-            with ThreadPoolExecutor(max_workers=1) as prefetch:
-                nxt = prefetch.submit(make_loaded, tile_starts[0])
-                for t, i in enumerate(tile_starts):
-                    fe = nxt.result()
-                    if t + 1 < len(tile_starts):
-                        nxt = prefetch.submit(
-                            make_loaded, tile_starts[t + 1]
+            with ThreadPoolExecutor(max_workers=depth) as prefetch:
+                pending = deque(
+                    prefetch.submit(make_loaded, i)
+                    for i in tile_starts[:depth]
+                )
+                nexti = depth
+                while pending:
+                    fe = pending.popleft().result()
+                    if nexti < len(tile_starts):
+                        pending.append(
+                            prefetch.submit(
+                                make_loaded, tile_starts[nexti]
+                            )
                         )
+                        nexti += 1
                     out.append(fe.execute(f.root))
+                    _merge_tile_counters(executor, fe)
             pages_by_fragment[fid] = out
         else:
             splits_by_scan = {}
@@ -299,6 +376,7 @@ def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Pag
             )
             fe._streaming_cache = run_cache
             pages_by_fragment[fid] = [fe.execute(f.root)]
+            _merge_tile_counters(executor, fe)
         done.add(fid)
 
     run_fragment(0)

@@ -185,6 +185,94 @@ def grouped_count(
     return jnp.sum(acc, axis=1).astype(jnp.int64)[:groups]
 
 
+def _fused_agg_kernel(*refs, names, gpad, rpad, emit):
+    """No-grid megakernel: one [CHUNK_ROWS, 128] tile of every
+    referenced scan column -> per-(term, group) int32 partial sums,
+    [rpad, 128].  `emit` is the plan-time-compiled closure producing
+    (predicate tile | None, group-id tile | None, term value tiles);
+    all of its arithmetic is interval-proven int32 (ops/megakernel).
+    One VMEM pass: each column is read exactly once per chunk and the
+    filter, group codes and every aggregate plane come out of it."""
+    live = refs[0][...]
+    cols = {nm: r[...] for nm, r in zip(names, refs[1:-1])}
+    o_ref = refs[-1]
+    pred, gid, vals = emit(cols)
+    mask = live != 0
+    if pred is not None:
+        mask = mask & pred
+    zero = jnp.zeros((), dtype=jnp.int32)
+    outs = []
+    for tv in vals:
+        tvm = jnp.where(mask, tv, zero)
+        if gid is None:  # global aggregate: one group, no compare
+            # dtype pinned to int32 (in-kernel int64 conversion
+            # recurses in Mosaic lowering, same as _plane_kernel)
+            outs.append(jnp.sum(tvm, axis=0, dtype=jnp.int32))
+        else:
+            for g in range(gpad):  # static unroll; gpad <= MAX_GROUPS
+                outs.append(
+                    jnp.sum(
+                        jnp.where(gid == g, tvm, zero), axis=0,
+                        dtype=jnp.int32,
+                    )
+                )
+    zrow = jnp.zeros((LANES,), dtype=jnp.int32)
+    while len(outs) < rpad:  # sublane-align the stacked output
+        outs.append(zrow)
+    o_ref[...] = jnp.stack(outs)
+
+
+def fused_agg_sums(
+    cols: dict, live: jnp.ndarray, emit, n_terms: int, groups: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused scan->filter->aggregate: stream every column once, return
+    exact int64 per-(term, group) sums, [n_terms, groups].
+
+    Same streaming scheme as grouped_sum_i64: the grid-free kernel is
+    wrapped in an XLA `lax.scan` over [CHUNK_ROWS, 128] chunks (the
+    recorded Mosaic tunnel constraint), per-chunk partials accumulate
+    in int32 (term bounds proven by ops/megakernel keep them exact),
+    cross-chunk accumulation runs in int64."""
+    if not HAVE_PALLAS:
+        raise RuntimeError("pallas is unavailable")
+    assert groups <= MAX_GROUPS, groups
+    names = tuple(sorted(cols))
+    n = live.shape[0]
+    gpad = 1 if groups == 1 else max(8, ((groups + 7) // 8) * 8)
+    nrows = n_terms * gpad
+    rpad = max(8, ((nrows + 7) // 8) * 8)
+    per_chunk = CHUNK_ROWS * LANES
+    nchunks = max(1, -(-n // per_chunk))
+    padded = nchunks * per_chunk
+
+    def tiles(a):
+        return (
+            jnp.zeros(padded, dtype=jnp.int32)
+            .at[:n].set(a.astype(jnp.int32))
+            .reshape(nchunks, CHUNK_ROWS, LANES)
+        )
+
+    l3 = tiles(live)
+    c3 = [tiles(cols[nm]) for nm in names]
+    call = pl.pallas_call(
+        functools.partial(
+            _fused_agg_kernel, names=names,
+            gpad=(None if groups == 1 else gpad), rpad=rpad, emit=emit,
+        ),
+        out_shape=jax.ShapeDtypeStruct((rpad, LANES), jnp.int32),
+        interpret=interpret,
+    )
+
+    def body(acc, xs):
+        return acc + call(*xs).astype(jnp.int64), None
+
+    acc0 = jnp.zeros((rpad, LANES), dtype=jnp.int64)
+    acc, _ = jax.lax.scan(body, acc0, (l3, *c3))
+    lane_sums = jnp.sum(acc, axis=1)[:nrows]
+    return lane_sums.reshape(n_terms, gpad)[:, :groups]
+
+
 def seg_count_maybe(flags: jnp.ndarray, gid: jnp.ndarray, cap: int):
     """Pallas-or-None per-group count of 0/1 flags; None = caller falls
     back to the XLA segment sum."""
@@ -196,3 +284,25 @@ def seg_count_maybe(flags: jnp.ndarray, gid: jnp.ndarray, cap: int):
     ):
         return None
     return grouped_count(flags, gid, cap)
+
+
+# Every pallas kernel body registers here (scripts/check_donation.py
+# enforces it): the entry keys must match the `def *_kernel` names and
+# the mode strings join the executor's kernel profile.
+KERNEL_REGISTRY = {
+    "_plane_kernel": {
+        "mode": "pallas",
+        "wrapper": "grouped_sum_i64",
+        "what": "per-group 16-bit plane sums (exact int64 segment sum)",
+    },
+    "_count_kernel": {
+        "mode": "pallas",
+        "wrapper": "grouped_count",
+        "what": "per-group single-f32-plane mask counts",
+    },
+    "_fused_agg_kernel": {
+        "mode": "megakernel",
+        "wrapper": "fused_agg_sums",
+        "what": "fused scan->filter->aggregate per-(term, group) sums",
+    },
+}

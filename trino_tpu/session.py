@@ -220,6 +220,11 @@ class Session:
         exec_config["device_generation"] = bool(
             self.properties.get("device_generation")
         )
+        exec_config["megakernels"] = self.properties.get("megakernels")
+        exec_config["double_buffer_depth"] = self.properties.get(
+            "double_buffer_depth"
+        )
+        exec_config["donate_pages"] = self.properties.get("donate_pages")
         exec_config["broadcast_join_threshold_rows"] = self.properties.get(
             "broadcast_join_threshold_rows"
         )
