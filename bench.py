@@ -87,7 +87,21 @@ def _cache_mode() -> str:
     return mode
 
 
+def _chaos_churn() -> bool:
+    """--chaos-churn (also BENCH_CHAOS_CHURN=1).
+
+    Opt-in node-churn chaos config: spin up a distributed cluster, kill
+    -9 a real worker process mid-query each round, and record how many
+    queries survive the churn (the robustness analog of the throughput
+    configs).  Off by default — it measures recovery, not speed.
+    """
+    if os.environ.get("BENCH_CHAOS_CHURN") == "1":
+        return True
+    return "--chaos-churn" in sys.argv[1:]
+
+
 CACHE_MODE = _cache_mode()
+CHAOS_CHURN = _chaos_churn()
 CACHE_PROPS = {
     "off": {"result_cache": False, "compile_cache": False,
             "scan_cache_enabled": False},
@@ -965,6 +979,54 @@ def main():
             _drop_session(hs)
         return r
 
+    def _cfg_chaos_churn():
+        # node-churn chaos (--chaos-churn): two in-process workers plus a
+        # killable subprocess worker per round; kill -9 the subprocess
+        # mid-query and count queries that still answer correctly via
+        # FTE reassignment after the lifecycle machine retires the corpse
+        import threading
+
+        from trino_tpu.testing.runner import DistributedQueryRunner
+
+        t0 = time.perf_counter()
+        killed = attempted = survived = 0
+        with DistributedQueryRunner(
+            workers=2,
+            catalogs=(("tpch", "tpch", {"tpch.scale-factor": 0.01}),),
+            properties={
+                "retry_policy": "task",
+                "node_gone_grace_s": 1.5,
+                **CACHE_PROPS,
+            },
+        ) as runner:
+            for round_no in range(2):
+                runner.add_subprocess_worker()
+                sql = (
+                    "select count(*), sum(l_extendedprice * l_discount) "
+                    f"from lineitem where l_quantity > {round_no}"
+                )
+
+                def _kill():
+                    time.sleep(0.3)
+                    runner.sigkill_subprocess_worker()
+
+                killer = threading.Thread(target=_kill, daemon=True)
+                killer.start()
+                attempted += 1
+                try:
+                    runner.rows(sql)
+                    survived += 1
+                except Exception:
+                    pass
+                killer.join()
+                killed += 1
+        return {
+            "nodes_killed": killed,
+            "queries_attempted": attempted,
+            "queries_survived": survived,
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+
     # (name, fn, default_estimate_s, shared sessions to drop afterwards)
     # NORTH-STAR FIRST (r04 weak #2: SF100 was never reached): the spec-
     # scale configs spend the budget before the SF1 smoke tail
@@ -998,6 +1060,10 @@ def main():
         plan = [p for p in plan
                 if p[0] in ("q6_tiny_sf0.01", "q6_sf1", "q1_sf1", "q3_sf1",
                             "anchors_arrow_sf1")]
+    if CHAOS_CHURN:
+        # appended after the CPU filter: the churn config runs on any
+        # backend when explicitly requested
+        plan.append(("chaos_churn_sf0.01", _cfg_chaos_churn, 90, []))
 
     only = os.environ.get("BENCH_ONLY")
     if only:

@@ -37,6 +37,10 @@ LITERAL_RE = re.compile(
 # full convention, so even a suffixless literal in a test or helper is a
 # violation, not an unrelated string
 MEMORY_LITERAL_RE = re.compile(r'["\'](trino_tpu_memory_[a-z0-9_]*)["\']')
+# node-lifecycle literals get the same unconditional treatment: the
+# trino_tpu_node_* series drive churn dashboards and the chaos harness
+# asserts on them by full name
+NODE_LITERAL_RE = re.compile(r'["\'](trino_tpu_node_[a-z0-9_]*)["\']')
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -73,7 +77,9 @@ def check_tree(root: str):
         with open(path, "r", encoding="utf-8") as f:
             text = f.read()
         seen_spans = set()
-        for regex in (REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE):
+        for regex in (
+            REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE, NODE_LITERAL_RE
+        ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
                     continue
@@ -106,6 +112,8 @@ def check_tree(root: str):
          "trino_tpu.obs.opstats", "OPERATOR_FIELDS"),
         ("trino_tpu/obs/history.py",
          "trino_tpu.obs.history", "HISTORY_FIELDS"),
+        ("trino_tpu/server/discovery.py",
+         "trino_tpu.server.discovery", "NODE_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

@@ -1,0 +1,406 @@
+"""Node lifecycle state machine + node-churn chaos harness.
+
+Reference parity: NodeState.java (ACTIVE/DRAINING/DRAINED lifecycle),
+failuredetector/HeartbeatFailureDetector.java (decayed failure ratio with
+a suspicion window before a node is written off), and Project Tardigrade's
+BaseFailureRecoveryTest worker-kill scenarios — a worker killed with
+kill -9 mid-query must never produce a wrong answer: FTE reassigns its
+unfinished tasks onto survivors reusing committed spools, and the
+pipelined path fails structurally and recovers via retry_policy=query.
+
+The chaos victims are REAL child processes (server/worker_main.py): an
+in-process worker shares its fate with the test runner, so true SIGKILL
+semantics (no drain, no goodbye, refused sockets) need a subprocess.
+"""
+import json
+import socket
+import sqlite3
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from tpch_sql import QUERIES, oracle_dialect
+from trino_tpu.server import discovery
+from trino_tpu.server.discovery import NodeManager
+from trino_tpu.server.fte import FaultTolerantScheduler
+from trino_tpu.server.scheduler import DistributedScheduler, SchedulerError
+from trino_tpu.server.worker import WorkerServer
+from trino_tpu.sql.parser import parse
+from trino_tpu.testing import DistributedQueryRunner
+from trino_tpu.testing.runner import _build_catalogs
+from trino_tpu.utils.faults import FaultInjector
+
+SF = 0.001
+TPCH = (("tpch", "tpch", {"tpch.scale-factor": SF}),)
+Q3 = QUERIES[3][0]
+Q6 = QUERIES[6][0]
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["customer", "orders", "lineitem"])
+    return conn
+
+
+def _put_state(uri: str, state: str) -> dict:
+    req = urllib.request.Request(
+        f"{uri}/v1/info/state", data=json.dumps(state).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT",
+    )
+    with urllib.request.urlopen(req, timeout=5.0) as resp:
+        return json.loads(resp.read())
+
+
+def _status(uri: str) -> dict:
+    with urllib.request.urlopen(f"{uri}/v1/status", timeout=5.0) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _kill_when_busy(runner, victim_uri, fired):
+    """Killer thread body: SIGKILL the last subprocess worker the moment
+    it reports at least one active task (true mid-query death)."""
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        try:
+            if _status(victim_uri)["activeTasks"] >= 1:
+                break
+        except Exception:
+            break  # already dead somehow: still kill below for cleanup
+        time.sleep(0.02)
+    runner.sigkill_subprocess_worker()
+    fired.append(time.time())
+
+
+# --- state machine units --------------------------------------------------
+
+
+def test_drain_walk_and_rejoin():
+    nm = NodeManager(gone_grace=0.3)
+    gone = []
+    nm.add_gone_listener(lambda nid, uri: gone.append((nid, uri)))
+    nm.announce("w1", "http://w1:1")
+    assert nm.lifecycle_states() == {"w1": "ACTIVE"}
+    assert nm.alive() == [("w1", "http://w1:1")]
+
+    nm.announce("w1", "http://w1:1", state="DRAINING")
+    assert nm.lifecycle_states() == {"w1": "DRAINING"}
+    assert nm.alive() == []  # zero placements while draining
+
+    nm.announce("w1", "http://w1:1", state="DRAINED")
+    assert nm.lifecycle_states() == {"w1": "DRAINED"}
+
+    # operator terminates the drained process: silence escalates to GONE
+    time.sleep(0.4)
+    assert nm.lifecycle_states() == {"w1": "GONE"}
+    assert nm.gone_uris() == {"http://w1:1"}
+    assert gone == [("w1", "http://w1:1")]
+
+    # a restarted worker re-announces and rejoins without coordinator
+    # restart; the listener fired exactly once for the death
+    nm.announce("w1", "http://w1:1")
+    assert nm.lifecycle_states() == {"w1": "ACTIVE"}
+    assert nm.alive() == [("w1", "http://w1:1")]
+    assert len(gone) == 1
+
+
+def test_suspicion_window_tolerates_flaps():
+    nm = NodeManager(gone_grace=0.4)
+    gone = []
+    nm.add_gone_listener(lambda nid, uri: gone.append(nid))
+    nm.announce("w1", "http://w1:1")
+    # two failed pings trip the decayed failure ratio past 0.5
+    nm.record_ping("w1", False)
+    nm.record_ping("w1", False)
+    assert nm.lifecycle_states() == {"w1": "SUSPECT"}
+    assert nm.alive() == []  # suspect nodes are unschedulable...
+
+    # ...but a successful ping inside the window recovers to ACTIVE —
+    # a GC pause is not a death, no task reassignment fired
+    nm.record_ping("w1", True)
+    assert nm.lifecycle_states() == {"w1": "ACTIVE"}
+    assert gone == []
+
+    # sustained failure + silence past the gone grace IS a death
+    nm.record_ping("w1", False)
+    nm.record_ping("w1", False)
+    assert nm.lifecycle_states() == {"w1": "SUSPECT"}
+    time.sleep(0.5)
+    assert nm.lifecycle_states() == {"w1": "GONE"}
+    assert gone == ["w1"]
+
+
+def test_scheduler_refuses_when_all_nodes_excluded():
+    # real NodeManager: the one announced node is DRAINING, so the
+    # scheduler must fail with a structured error naming it — not
+    # silently fall back onto a node that is leaving the cluster
+    nm = NodeManager()
+    nm.announce("w1", "http://w1:1", state="DRAINING")
+    sched = DistributedScheduler(
+        catalogs=None, workers=[("w1", "http://w1:1")], node_manager=nm,
+    )
+    with pytest.raises(SchedulerError) as ei:
+        sched._schedulable_workers()
+    msg = str(ei.value)
+    assert "NO_NODES_AVAILABLE" in msg
+    assert "w1=DRAINING" in msg
+
+
+# --- worker drain endpoint ------------------------------------------------
+
+
+def test_drain_completes_running_work():
+    w = WorkerServer(_build_catalogs(TPCH)).start()
+    try:
+        # a running task pins the worker in DRAINING until it finishes
+        fake = types.SimpleNamespace(state="RUNNING")
+        w.task_manager.tasks["tq.0.0"] = fake
+
+        doc = _put_state(w.uri, "DRAINING")
+        assert doc["state"] == "DRAINING"
+
+        # new work is refused with 409 while draining
+        req = urllib.request.Request(
+            f"{w.uri}/v1/task/tq.0.1", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5.0)
+        assert ei.value.code == 409
+
+        # the running task holds the drain open
+        time.sleep(0.3)
+        assert _status(w.uri)["state"] == "DRAINING"
+
+        # task finishes (spool flushed before FINISHED): drain completes
+        fake.state = "FINISHED"
+        assert _wait_for(
+            lambda: _status(w.uri)["state"] == "DRAINED", timeout=5.0
+        )
+    finally:
+        w.stop()
+
+
+def test_drain_visible_to_coordinator_and_unschedulable():
+    with DistributedQueryRunner(workers=2, catalogs=TPCH) as runner:
+        victim = runner.workers[0]
+        nm = runner.coordinator.coordinator.node_manager
+        _put_state(victim.uri, "DRAINING")
+        # idle worker: DRAINING -> DRAINED immediately; the announcement
+        # walks the coordinator's state machine along
+        assert _wait_for(
+            lambda: nm.lifecycle_states().get(victim.node_id) == "DRAINED"
+        )
+        assert nm.alive() == [
+            (runner.workers[1].node_id, runner.workers[1].uri)
+        ]
+        # queries keep running on the survivor; the drained node gets
+        # zero placements
+        assert runner.rows("select count(*) from lineitem") == [(5995,)]
+        assert _status(victim.uri)["lifetimeTasks"] == 0
+        rows = runner.rows(
+            "select node_id, state from system.runtime.nodes"
+        )
+        assert (victim.node_id, "DRAINED") in rows
+
+
+def test_announce_drop_is_suspicion_not_death(monkeypatch):
+    # announcement loss WITHOUT process death (partition / GC-pause
+    # analog): pings keep succeeding, so the node parks in SUSPECT and
+    # must recover — never escalate to GONE, never reassign
+    monkeypatch.setattr(discovery, "ANNOUNCEMENT_TTL", 0.6)
+    with DistributedQueryRunner(
+        workers=1, catalogs=TPCH,
+        properties={"node_gone_grace_s": 1.5},
+    ) as runner:
+        w = runner.workers[0]
+        nm = runner.coordinator.coordinator.node_manager
+        w.task_manager.fault_injector = FaultInjector({"announce_drop": {}})
+        assert _wait_for(
+            lambda: nm.lifecycle_states().get(w.node_id) == "SUSPECT"
+        )
+        assert nm.alive() == []
+        # well past the gone grace: still SUSPECT, pings prove liveness
+        time.sleep(2.0)
+        assert nm.lifecycle_states().get(w.node_id) == "SUSPECT"
+        # announcements resume: the suspicion window closes harmlessly
+        w.task_manager.fault_injector = FaultInjector()
+        assert _wait_for(
+            lambda: nm.lifecycle_states().get(w.node_id) == "ACTIVE"
+        )
+        assert runner.rows("select count(*) from lineitem") == [(5995,)]
+
+
+def test_late_joiner_becomes_schedulable():
+    with DistributedQueryRunner(workers=2, catalogs=TPCH) as runner:
+        assert runner.rows("select count(*) from lineitem") == [(5995,)]
+        late = WorkerServer(
+            _build_catalogs(TPCH), runner.coordinator.uri
+        ).start()
+        runner.workers.append(late)
+        assert _wait_for(lambda: runner.alive_workers() == 3)
+        # source-partitioned stages now land on the new node too, with
+        # no coordinator restart
+        assert runner.rows(
+            "select count(*), sum(l_quantity) from lineitem"
+        )[0][0] == 5995
+        assert _status(late.uri)["lifetimeTasks"] >= 1
+
+
+# --- dead-host fast path --------------------------------------------------
+
+
+def test_exchange_connection_refused_fails_fast():
+    from trino_tpu.exec.exchange_client import (
+        RemoteHostGoneError,
+        _fetch_buffer,
+    )
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here: connections refuse instantly
+    t0 = time.time()
+    with pytest.raises(RemoteHostGoneError) as ei:
+        _fetch_buffer(f"http://127.0.0.1:{port}", "tq.0.0", 0, 30.0)
+    # one quick re-probe, not the full transient backoff budget
+    assert time.time() - t0 < 3.0
+    assert "REMOTE_HOST_GONE" in str(ei.value)
+
+
+# --- mid-query worker death (kill -9 chaos) -------------------------------
+
+
+def test_fte_survives_kill9_mid_q3(oracle_conn):
+    """kill -9 a real worker process while it holds Q3 tasks: FTE
+    reassigns its unfinished tasks to survivors, committed spools are
+    reused (finished tasks are NOT re-dispatched), the answer matches
+    the oracle, and the corpse shows up GONE in system.runtime.nodes."""
+    with DistributedQueryRunner(
+        workers=2, catalogs=TPCH,
+        properties={"node_gone_grace_s": 1.5},
+    ) as runner:
+        _, victim_id, victim_uri = runner.add_subprocess_worker(
+            fault_injection={"task_stall": {"stall_s": 3.0}},
+        )
+        nm = runner.coordinator.coordinator.node_manager
+        fired = []
+        killer = threading.Thread(
+            target=_kill_when_busy, args=(runner, victim_uri, fired),
+            daemon=True,
+        )
+        killer.start()
+        fte = FaultTolerantScheduler(
+            runner.session.catalogs, nm,
+            properties={"retry_policy": "task"},
+        )
+        plan = runner.session._plan_stmt(parse(Q3))
+        page = fte.run(plan, "q_chaos_kill9")
+        killer.join(timeout=60.0)
+        assert fired, "victim was never killed"
+
+        expected = oracle_conn.execute(oracle_dialect(Q3)).fetchall()
+        assert_rows_match(page.to_pylist(), expected, tol=2e-2, ordered=True)
+
+        # reassignment reused committed spools: tasks NOT on the dead
+        # node ran exactly one attempt; every re-dispatched task had an
+        # attempt on the victim
+        attempts = {}
+        for uri, task_id in fte._created_tasks:
+            q, frag, idx, att = task_id.rsplit(".", 3)
+            attempts.setdefault((frag, idx), []).append(uri)
+        retried = {k: v for k, v in attempts.items() if len(v) > 1}
+        assert retried, "no task was ever reassigned"
+        for k, uris in retried.items():
+            assert victim_uri in uris, (
+                f"task {k} retried without touching the victim: {uris}"
+            )
+        single = [k for k, v in attempts.items() if len(v) == 1]
+        assert single, "every task re-ran: committed spools not reused"
+
+        # the corpse is visible as GONE (silence past the gone grace)
+        assert _wait_for(
+            lambda: nm.lifecycle_states().get(victim_id) == "GONE"
+        )
+        rows = runner.rows(
+            "select node_id, state from system.runtime.nodes"
+        )
+        assert (victim_id, "GONE") in rows
+
+
+def test_pipelined_kill9_recovers_via_query_retry(oracle_conn):
+    """The pipelined path has no spool to recover from: killing a worker
+    mid-Q6 fails the attempt with a structured dead-host error, and
+    retry_policy=query re-dispatches the whole query against the
+    refreshed alive set — the final answer still matches the oracle."""
+    with DistributedQueryRunner(
+        workers=2, catalogs=TPCH,
+        properties={
+            "retry_policy": "query",
+            "query_retry_attempts": 4,
+            "node_gone_grace_s": 1.5,
+        },
+    ) as runner:
+        _, victim_id, victim_uri = runner.add_subprocess_worker(
+            fault_injection={"task_stall": {"stall_s": 3.0}},
+        )
+        fired = []
+        killer = threading.Thread(
+            target=_kill_when_busy, args=(runner, victim_uri, fired),
+            daemon=True,
+        )
+        killer.start()
+        _, rows = runner.execute(Q6)
+        killer.join(timeout=60.0)
+        assert fired, "victim was never killed"
+
+        expected = oracle_conn.execute(oracle_dialect(Q6)).fetchall()
+        assert_rows_match(
+            [tuple(r) for r in rows], expected, tol=2e-2, ordered=True
+        )
+        co = runner.coordinator.coordinator
+        retried = [
+            q for q in co.queries.values() if q.retry_count >= 1
+        ]
+        assert retried, "query finished without a whole-query retry"
+
+
+def test_seeded_worker_death_chaos(oracle_conn):
+    """Deterministic churn: the seeded worker_death site hard-exits the
+    subprocess worker (status 137, the OOM-killer signature) the moment
+    its first task starts — same recovery contract as kill -9, fully
+    reproducible from the spec."""
+    with DistributedQueryRunner(
+        workers=2, catalogs=TPCH,
+        properties={"node_gone_grace_s": 1.5},
+    ) as runner:
+        proc, victim_id, victim_uri = runner.add_subprocess_worker(
+            fault_injection={"worker_death": {"nth": 1}},
+        )
+        nm = runner.coordinator.coordinator.node_manager
+        fte = FaultTolerantScheduler(
+            runner.session.catalogs, nm,
+            properties={"retry_policy": "task"},
+        )
+        plan = runner.session._plan_stmt(parse(Q3))
+        page = fte.run(plan, "q_chaos_seeded")
+        expected = oracle_conn.execute(oracle_dialect(Q3)).fetchall()
+        assert_rows_match(page.to_pylist(), expected, tol=2e-2, ordered=True)
+        assert _wait_for(lambda: proc.poll() is not None, timeout=30.0)
+        assert proc.poll() == 137
+        dead_uris = {u for u, _t in fte._created_tasks if u == victim_uri}
+        assert dead_uris, "the doomed worker never received a task"

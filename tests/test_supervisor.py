@@ -29,7 +29,7 @@ from trino_tpu.runtime.supervisor import (
     QUARANTINED,
 )
 from trino_tpu.server.fte import FaultTolerantScheduler
-from trino_tpu.server.scheduler import DistributedScheduler
+from trino_tpu.server.scheduler import DistributedScheduler, SchedulerError
 from trino_tpu.session import Session
 from trino_tpu.sql.parser import parse
 from trino_tpu.testing import DistributedQueryRunner
@@ -390,12 +390,19 @@ def test_quarantined_workers_excluded_from_stage_placement():
     assert sched._schedulable_workers() == [
         ("w1", "http://w1"), ("w3", "http://w3")
     ]
-    # every node quarantined: degrade to the full set rather than refuse
+    # every node quarantined: refuse with a structured error naming each
+    # excluded node (no silent degrade onto known-bad hardware)
     sched_all = _scheduler(
         {w[0]: {"state": "QUARANTINED"} for w in workers}, workers
     )
-    assert sched_all._schedulable_workers() == workers
-    assert sched_all._pick_single_worker("qx") in workers
+    with pytest.raises(SchedulerError) as ei:
+        sched_all._schedulable_workers()
+    msg = str(ei.value)
+    assert "NO_NODES_AVAILABLE" in msg
+    for w, _uri in workers:
+        assert f"{w}=QUARANTINED" in msg
+    with pytest.raises(SchedulerError):
+        sched_all._pick_single_worker("qx")
 
 
 def test_degraded_beats_quarantined_for_single_placement():

@@ -145,6 +145,13 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 2,
         ),
         PropertyMetadata(
+            "node_gone_grace_s",
+            "continuous heartbeat silence before a SUSPECT/DRAINING node "
+            "is declared GONE and its tasks reassigned "
+            "(failure-detector GC-pause tolerance, seconds)",
+            float, 10.0,
+        ),
+        PropertyMetadata(
             "exchange_retry_attempts",
             "transient exchange-fetch tries per failure streak before "
             "the upstream worker is declared dead",

@@ -50,7 +50,10 @@ SCHEMAS: Dict[str, List] = {
     "nodes": [
         ("node_id", T.VARCHAR),
         ("http_uri", T.VARCHAR),
+        # distributed: lifecycle state machine (server/discovery.py)
+        # ACTIVE/SUSPECT/DRAINING/DRAINED/GONE; local session: "active"
         ("state", T.VARCHAR),
+        ("state_age_s", T.DOUBLE),
         # device-fault supervisor health (runtime/supervisor.py):
         # ACTIVE/DEGRADED/QUARANTINED + strikes toward the blacklist
         ("device_state", T.VARCHAR),
@@ -265,17 +268,15 @@ class _SystemSource:
             nodes = []
             nm = getattr(s, "node_manager", None)
             if nm is not None:
-                alive = {n for n, _ in nm.alive()}
-                with nm.lock:
-                    known = [
-                        (n.node_id, n.uri, n.device)
-                        for n in nm.nodes.values()
-                    ]
-                for node_id, uri, dev in known:
-                    dstate, strikes = device_cols(dev)
+                import time as _time
+
+                # discovery stamps state_since with time.time()
+                now = _time.time()
+                for snap in nm.nodes_snapshot():
+                    dstate, strikes = device_cols(snap.get("device"))
                     nodes.append(
-                        (node_id, uri,
-                         "active" if node_id in alive else "inactive",
+                        (snap["nodeId"], snap["uri"], snap["state"],
+                         max(now - float(snap["stateSince"] or now), 0.0),
                          dstate, strikes)
                     )
             else:
@@ -283,14 +284,15 @@ class _SystemSource:
                 dstate, strikes = device_cols(
                     sup.snapshot() if sup is not None else None
                 )
-                nodes.append(("local", "local://", "active",
+                nodes.append(("local", "local://", "active", 0.0,
                               dstate, strikes))
             return {
                 "node_id": [n[0] for n in nodes],
                 "http_uri": [n[1] for n in nodes],
                 "state": [n[2] for n in nodes],
-                "device_state": [n[3] for n in nodes],
-                "device_strikes": [n[4] for n in nodes],
+                "state_age_s": [n[3] for n in nodes],
+                "device_state": [n[4] for n in nodes],
+                "device_strikes": [n[5] for n in nodes],
             }
         if table == "session_properties":
             rows = s.properties.show()

@@ -33,14 +33,34 @@ class RemoteTaskError(RuntimeError):
     pass
 
 
-class ExchangeTimeout(RuntimeError):
-    pass
+class RemoteHostGoneError(RemoteTaskError):
+    """Connection REFUSED by an upstream worker: its process is gone
+    (kill -9, OOM-kill, a DRAINED node terminated) — a refused socket is
+    authoritative in a way a timeout or reset is not, because the task's
+    host was reachable when the location was handed out.  Raised after
+    one quick re-probe instead of the full transient backoff so FTE
+    reassignment (or retry_policy=query's whole-query retry) starts
+    immediately instead of spinning against a dead URI."""
+
+    def __init__(self, uri: str, task: str, detail):
+        super().__init__(
+            f"REMOTE_HOST_GONE: worker {uri} refused connection while "
+            f"fetching task {task}: {detail}"
+        )
+        self.uri = uri
+
+
+def _connection_refused(exc: BaseException) -> bool:
+    return isinstance(exc, ConnectionRefusedError) or isinstance(
+        getattr(exc, "reason", None), ConnectionRefusedError
+    )
 
 
 CREATE_WAIT = 30.0  # max time to wait for an upstream task to appear
 RETRY_ATTEMPTS = 3  # transient-error tries per contiguous failure streak
 RETRY_BUDGET_S = 5.0  # wall-clock budget for one failure streak
 RETRY_BASE_S = 0.1  # first backoff; doubles per consecutive failure
+REFUSED_FAST_TRIES = 2  # refused connections before the host is GONE
 
 
 def _fetch_buffer(
@@ -59,6 +79,7 @@ def _fetch_buffer(
     deadline = time.time() + timeout
     create_deadline = time.time() + CREATE_WAIT
     transient = 0  # consecutive transient failures in the current streak
+    refused = 0  # consecutive connection-refused (dead-host fast path)
     streak_deadline = 0.0
     fetch_total = REGISTRY.counter(
         "trino_tpu_exchange_fetch_total", "Exchange buffer-fetch HTTP requests"
@@ -82,6 +103,7 @@ def _fetch_buffer(
             with urllib.request.urlopen(url, timeout=10.0) as resp:
                 seen_task = True
                 transient = 0
+                refused = 0
                 state = resp.headers.get("X-Task-State", "RUNNING")
                 if resp.status == 200:
                     body = resp.read()
@@ -111,6 +133,22 @@ def _fetch_buffer(
                 )
             # 404 before first contact: task not created yet — keep polling
         except (urllib.error.URLError, ConnectionError, OSError) as e:
+            if _connection_refused(e):
+                # dead-host fast path: one immediate re-probe absorbs an
+                # accept-queue blip, then the host is declared gone —
+                # the exponential backoff is reserved for errors a live
+                # host can produce (timeout, reset, half-open close)
+                refused += 1
+                if refused >= REFUSED_FAST_TRIES:
+                    REGISTRY.counter(
+                        "trino_tpu_exchange_host_gone_total",
+                        "Exchange fetches failed fast on a refused "
+                        "(dead-host) connection",
+                    ).inc()
+                    raise RemoteHostGoneError(uri, task, e)
+                time.sleep(RETRY_BASE_S)
+                continue
+            refused = 0
             transient += 1
             if transient == 1:
                 streak_deadline = time.time() + retry_budget_s

@@ -62,6 +62,23 @@ class ClusterMemoryManager:
             "Cluster-wide reserved bytes aggregated from heartbeats",
         ).set(self.cluster_reserved_bytes())
 
+    def remove_node(self, node_id: str) -> bool:
+        """Evict a dead node's snapshot from the aggregation (node went
+        GONE): its reservations no longer exist anywhere, so leaving the
+        snapshot in place would overstate cluster pressure, hold phantom
+        per-query totals, and let the killer blame a corpse.  Returns
+        whether the node was known."""
+        with self._lock:
+            known = self._nodes.pop(node_id, None) is not None
+            self._node_seen.pop(node_id, None)
+            self._blocked_since.pop(node_id, None)
+        if known:
+            REGISTRY.gauge(
+                "trino_tpu_memory_cluster_reserved_bytes",
+                "Cluster-wide reserved bytes aggregated from heartbeats",
+            ).set(self.cluster_reserved_bytes())
+        return known
+
     def nodes_view(self) -> List[dict]:
         with self._lock:
             return [dict(s, nodeId=nid) for nid, s in self._nodes.items()]

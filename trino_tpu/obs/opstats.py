@@ -250,6 +250,26 @@ def timeline_from_tasks(tasks: List[dict], detector=None) -> dict:
     return {"stages": out_stages, "operators": merge_frames(all_frames)}
 
 
+def mark_node_tasks_terminal(
+    stage_map: Dict, node_id: str
+) -> List[str]:
+    """Node-death fan-out for the live timeline: every rollup entry the
+    dead node reported is marked terminal so stage merges and the live
+    straggler detector stop treating its tasks as in-flight siblings —
+    a ghost task would otherwise hold the stage's wall dispersion open
+    forever.  Returns the retired task ids (caller holds the ingest
+    lock)."""
+    retired: List[str] = []
+    for entries in (stage_map or {}).values():
+        for entry in entries or ():
+            if entry.get("nodeId") != node_id or entry.get("terminal"):
+                continue
+            entry["terminal"] = True
+            entry["terminalReason"] = "NODE_GONE"
+            retired.append(str(entry.get("taskId") or ""))
+    return retired
+
+
 def format_timeline(
     frames: List[dict], total_wall_s: Optional[float] = None
 ) -> str:
@@ -351,6 +371,29 @@ class StragglerDetector:
             "Dispersion-triggered FTE backup attempts launched",
         ).inc(stage=str(stage_id))
         return action
+
+    def observe_node_gone(
+        self, node_id: str, retired: List[str]
+    ) -> dict:
+        """Record a node-death event in the flag stream: retired tasks
+        must not be scored as stragglers (their walls stopped moving for
+        a reason dispersion can't see), and the flag gives EXPLAIN
+        ANALYZE / system.runtime a durable marker of why a stage's task
+        roster shrank mid-query."""
+        from ..utils import metrics as M
+
+        flag = {
+            "action": "node_gone",
+            "node": str(node_id),
+            "retiredTasks": [str(t) for t in retired or ()],
+        }
+        with self._lock:
+            self.flags.append(flag)
+        M.counter(
+            "trino_tpu_straggler_node_gone_total",
+            "Node-death events observed by the straggler detector",
+        ).inc(node=str(node_id))
+        return flag
 
     def observe_stage(self, stage_id, tasks: List[dict]) -> List[dict]:
         """Flag stragglers among a stage's completed tasks (the timeline

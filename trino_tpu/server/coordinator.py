@@ -95,7 +95,15 @@ class Coordinator:
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
         self.distributed = distributed
-        self.node_manager = NodeManager() if distributed else None
+        self.node_manager = (
+            NodeManager(
+                gone_grace=float(
+                    session.properties.get("node_gone_grace_s") or 10.0
+                )
+            )
+            if distributed
+            else None
+        )
         self.failure_detector = (
             HeartbeatFailureDetector(self.node_manager).start()
             if distributed
@@ -132,6 +140,10 @@ class Coordinator:
         self._opstats_seen: set = set()
         self._opstats_by_stage: Dict[tuple, list] = {}
         self._opstats_lock = threading.Lock()
+        if self.node_manager is not None:
+            # node-death fan-out: memory-pool eviction + opstats ghost
+            # retirement the moment a node is declared GONE
+            self.node_manager.add_gone_listener(self._on_node_gone)
         self._stop_enforcement = threading.Event()
         if distributed:
             threading.Thread(
@@ -165,7 +177,9 @@ class Coordinator:
         cm = self.cluster_memory
         if self.node_manager is not None:
             for n in self.node_manager.all_nodes():
-                if n.memory:
+                # a GONE node's last snapshot must not resurrect the
+                # eviction done by _on_node_gone
+                if n.memory and n.state != "GONE":
                     cm.update_node(n.node_id, n.memory)
         cm.update_node(
             self.node_id, self.session.memory_manager.snapshot()
@@ -310,6 +324,27 @@ class Coordinator:
                 pass  # observability must never fail the query
             if q.group is not None:
                 q.group.finish()
+
+    def _on_node_gone(self, node_id: str, uri: str) -> None:
+        """GONE fan-out: the dead node's pool snapshot leaves the cluster
+        memory view (its phantom reservations would otherwise skew
+        admission and the low-memory killer forever) and its in-flight
+        operator-stats tasks are marked terminal so the timeline and the
+        live straggler detector stop waiting on a ghost."""
+        from ..obs.opstats import mark_node_tasks_terminal
+
+        try:
+            self.cluster_memory.remove_node(node_id)
+        except Exception:
+            pass
+        try:
+            with self._opstats_lock:
+                retired = mark_node_tasks_terminal(
+                    self._opstats_by_stage, node_id
+                )
+            self.straggler_detector.observe_node_gone(node_id, retired)
+        except Exception:
+            pass
 
     def ingest_opstats(self, node_id: str, summaries) -> None:
         """Heartbeat piggyback: each worker announce carries its recent
@@ -792,7 +827,7 @@ class _Handler(BaseHTTPRequestHandler):
             if self.coordinator.node_manager is not None:
                 self.coordinator.node_manager.announce(
                     doc["nodeId"], doc["uri"], memory=doc.get("memory"),
-                    device=doc.get("device"),
+                    device=doc.get("device"), state=doc.get("state"),
                 )
                 if doc.get("memory"):
                     self.coordinator.cluster_memory.update_node(

@@ -787,6 +787,10 @@ class FaultTolerantScheduler:
             "properties": props,
             "spool_path": sink.path,
         }
+        # register before the POST: a worker that dies mid-dispatch may
+        # have created the task without ever flushing the response, and
+        # end-of-query cleanup must cover that half-created task too
+        self._created_tasks.append((uri, task_id))
         _post_json(f"{uri}/v1/task/{task_id}", doc)
         from ..utils.metrics import REGISTRY
 
@@ -799,7 +803,6 @@ class FaultTolerantScheduler:
                 "trino_tpu_scheduler_retry_total",
                 "Task attempts beyond the first (failover, backup, heal)",
             ).inc()
-        self._created_tasks.append((uri, task_id))
         return uri, task_id, sink
 
     def _abort_task(self, uri, task_id):
@@ -864,6 +867,11 @@ class FaultTolerantScheduler:
                         poll_failures = 0
                     else:
                         poll_failures += 1
+                        if self._uri_gone(uri):
+                            raise SchedulerError(
+                                f"worker {uri} GONE (node lifecycle): "
+                                "reassigning task to a survivor"
+                            )
                         if poll_failures >= POLL_FAILURE_TOLERANCE:
                             raise SchedulerError(
                                 f"worker {uri} lost (status polls failing)"
@@ -1036,6 +1044,19 @@ class FaultTolerantScheduler:
             })
             return True
 
+    def _uri_gone(self, uri: str) -> bool:
+        """True when the node manager has declared the attempt's host
+        GONE: the poll-failure tolerance (meant for GC pauses and slow
+        responses) is skipped and the attempt fails over immediately,
+        reusing every already-committed upstream spool."""
+        fn = getattr(self.node_manager, "gone_uris", None)
+        if fn is None:
+            return False
+        try:
+            return uri in fn()
+        except Exception:
+            return False
+
     def _poll_task(self, uri: str, task_id: str):
         """One status poll: (state, reachable) — state None while running
         or on a transient poll failure."""
@@ -1067,6 +1088,10 @@ class FaultTolerantScheduler:
                 # tolerate transient poll blips (a stalled worker thread is
                 # not a dead worker); ContinuousTaskStatusFetcher backoff
                 consecutive_failures += 1
+                if self._uri_gone(uri):
+                    raise SchedulerError(
+                        f"worker {uri} GONE (node lifecycle): {e}"
+                    )
                 if consecutive_failures >= POLL_FAILURE_TOLERANCE:
                     raise SchedulerError(f"worker {uri} lost: {e}")
                 time.sleep(0.2)

@@ -141,20 +141,60 @@ class DistributedScheduler:
         state = (device or {}).get("state", "ACTIVE")
         return {"QUARANTINED": 0, "DEGRADED": 1}.get(state, 2)
 
+    def _lifecycle_states(self) -> Dict[str, str]:
+        if self.node_manager is None:
+            return {}
+        fn = getattr(self.node_manager, "lifecycle_states", None)
+        if fn is None:
+            return {}
+        try:
+            return fn()
+        except Exception:
+            return {}
+
     def _schedulable_workers(self) -> List[Tuple[str, str]]:
-        """Workers eligible for source/hash placement: QUARANTINED nodes
-        (all devices out, no CPU fallback) are excluded unless every
-        node is quarantined — then degrade to the full set rather than
-        refuse outright."""
+        """Workers eligible for placement, re-resolved per stage from the
+        node manager when one is attached: DRAINING/DRAINED/SUSPECT/GONE
+        nodes drop out mid-query and LATE JOINERS become schedulable for
+        new stages without a restart.  QUARANTINED devices (all devices
+        out, no CPU fallback) are excluded too.  There is NO silent
+        fallback — when every node is excluded the query fails with a
+        structured error naming each exclusion, instead of quietly
+        re-admitting nodes known to be unhealthy."""
+        pool = list(self.workers)
+        alive_fn = getattr(self.node_manager, "alive", None)
+        if alive_fn is not None:
+            try:
+                pool = list(alive_fn())
+            except Exception:
+                pool = list(self.workers)
+        lifecycle = self._lifecycle_states()
         device = self._device_states()
-        ok = [
-            w for w in self.workers
-            if self._health_rank(device.get(w[0])) > 0
-        ]
-        return ok or list(self.workers)
+        ok: List[Tuple[str, str]] = []
+        excluded: List[str] = []
+        for w in pool:
+            state = lifecycle.get(w[0], "ACTIVE")
+            if state != "ACTIVE":
+                excluded.append(f"{w[0]}={state}")
+            elif self._health_rank(device.get(w[0])) <= 0:
+                excluded.append(f"{w[0]}=QUARANTINED")
+            else:
+                ok.append(w)
+        seen = {w[0] for w in pool}
+        for w in self.workers:
+            if w[0] not in seen:
+                excluded.append(
+                    f"{w[0]}={lifecycle.get(w[0], 'NOT_ALIVE')}"
+                )
+        if not ok:
+            raise SchedulerError(
+                "NO_NODES_AVAILABLE: every worker is unschedulable "
+                f"(excluded: {', '.join(sorted(excluded)) or 'none known'})"
+            )
+        return ok
 
     def _pick_single_worker(self, query_id: str) -> Tuple[str, str]:
-        fallback = self.workers[hash(query_id) % len(self.workers)]
+        pool = self._schedulable_workers()
         device = self._device_states()
         nodes: Dict[str, dict] = {}
         if self.memory_view is not None:
@@ -178,21 +218,15 @@ class DistributedScheduler:
             )
 
         # device health dominates memory headroom: a DEGRADED node (CPU
-        # fallback) ranks below ANY ACTIVE node regardless of free bytes,
-        # and QUARANTINED nodes are excluded entirely
-        pool = [
-            w for w in self.workers
-            if self._health_rank(device.get(w[0])) > 0
-        ]
-        if not pool:
-            return fallback
+        # fallback) ranks below ANY ACTIVE node regardless of free bytes;
+        # unschedulable nodes never reach this ranking at all
         best = max(
             (self._health_rank(device.get(w[0])), headroom(w))
             for w in pool
         )
         if best[1] < 0 and len(pool) == len(self.workers) and best[0] >= 2:
-            # no memory signal and no health signal: keep the hash pick
-            return fallback
+            # no memory signal and no health signal: hash-spread pick
+            return pool[hash(query_id) % len(pool)]
         candidates = [
             w for w in pool
             if (self._health_rank(device.get(w[0])), headroom(w)) == best

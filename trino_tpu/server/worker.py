@@ -27,6 +27,7 @@ partitioned into buffers (exec/partitioner.py) and served as binary frames.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import traceback
@@ -87,6 +88,10 @@ class TaskManager:
         # dispatches through it, so quarantine outlives any one task
         self.supervisor = supervisor
         self.tasks: Dict[str, TaskExecution] = {}
+        # cumulative placements: /v1/task DELETEs pop finished tasks out
+        # of ``tasks``, so "did this node ever get work" needs a counter
+        # that survives cleanup (drain + late-joiner assertions key on it)
+        self.tasks_created = 0
         self.lock = threading.Lock()
         # worker-level injector: serves the /v1/task/{id}/fail endpoint's
         # taskId-addressed modes and operator-configured sites (heartbeat)
@@ -108,6 +113,7 @@ class TaskManager:
                 return t  # idempotent re-POST (HttpRemoteTask retries)
             t = TaskExecution(task_id, doc)
             self.tasks[task_id] = t
+            self.tasks_created += 1
         threading.Thread(target=self._run, args=(t,), daemon=True).start()
         return t
 
@@ -184,6 +190,16 @@ class TaskManager:
                     f"(fault_injection site task_run, task {t.task_id})"
                 )
             inj.stall("task_stall", key=t.task_id)
+            # worker-LEVEL chaos (constructor fault_injection, not the
+            # session-shipped spec): node-churn sites that only make
+            # sense scoped to one victim process
+            winj = self.fault_injector
+            if winj.fires("worker_death", key=t.task_id):
+                # seeded kill -9 analog: vanish mid-task with no
+                # cleanup, no spool flush, no FAILED state — the
+                # coordinator must discover the death via heartbeats
+                os._exit(137)
+            winj.stall("task_stall", key=t.task_id)
             plan = plan_from_json(doc["fragment"])
             splits_by_scan: Dict[int, List[Split]] = {}
             for k, sps in (doc.get("splits") or {}).items():
@@ -387,7 +403,9 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 # drain the request body first or the connection wedges
                 self.rfile.read(int(self.headers.get("Content-Length", 0)))
                 self._json(
-                    409, {"error": "worker is shutting down"}
+                    409,
+                    {"error": "worker is not ACTIVE "
+                              f"(state {self.worker.state})"},
                 )
                 return
             n = int(self.headers.get("Content-Length", 0))
@@ -426,6 +444,13 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/info/state":
             n = int(self.headers.get("Content-Length", 0))
             want = json.loads(self.rfile.read(n) or b'""')
+            if want == "DRAINING":
+                # graceful decommission: refuse new tasks, finish running
+                # ones (spools flush before a task FINISHES), then
+                # announce DRAINED and stay up until terminated
+                self.worker.start_drain()
+                self._json(200, {"state": self.worker.state})
+                return
             if want != "SHUTTING_DOWN":
                 self._json(400, {"error": f"unsupported state {want}"})
                 return
@@ -474,12 +499,14 @@ class _WorkerHandler(BaseHTTPRequestHandler):
         if self.path == "/v1/status":
             self._json(200, {
                 "nodeId": w.node_id,
+                "state": w.state,
                 "activeTasks": sum(
                     1
                     for t in w.task_manager.tasks.values()
                     if t.state in ("PLANNED", "RUNNING", "FLUSHING")
                 ),
                 "totalTasks": len(w.task_manager.tasks),
+                "lifetimeTasks": w.task_manager.tasks_created,
             })
             return
         if len(parts) == 3 and parts[:2] == ["v1", "task"]:
@@ -595,8 +622,9 @@ class WorkerServer:
         self.announce_interval = announce_interval
         self._stop = threading.Event()
         self.announcer = threading.Thread(target=self._announce_loop, daemon=True)
-        # ACTIVE -> SHUTTING_DOWN (GracefulShutdownHandler analog): stop
-        # announcing, reject new tasks, drain running ones, then stop
+        # lifecycle (NodeState analog): ACTIVE -> DRAINING -> DRAINED
+        # (graceful decommission, keeps announcing) or ACTIVE ->
+        # SHUTTING_DOWN (GracefulShutdownHandler: drain then stop)
         self.state = "ACTIVE"
 
     @property
@@ -612,6 +640,33 @@ class WorkerServer:
     def stop(self):
         self._stop.set()
         self.httpd.shutdown()
+
+    def start_drain(self):
+        """PUT /v1/info/state DRAINING (NodeState.DRAINING analog):
+        refuse new tasks, keep ANNOUNCING (unlike SHUTTING_DOWN — the
+        coordinator must watch this node walk DRAINING -> DRAINED), wait
+        for running tasks to finish (each commits/flushes its spool
+        before reaching FINISHED), then advertise DRAINED.  The process
+        stays up serving spools/results until the operator terminates
+        it; the coordinator escalates the ensuing silence to GONE."""
+        if self.state != "ACTIVE":
+            return
+        self.state = "DRAINING"
+
+        def drain():
+            deadline = time.time() + 30.0
+            while time.time() < deadline:
+                active = sum(
+                    1
+                    for t in self.task_manager.tasks.values()
+                    if t.state in ("PLANNED", "RUNNING", "FLUSHING")
+                )
+                if active == 0:
+                    break
+                time.sleep(0.05)
+            self.state = "DRAINED"
+
+        threading.Thread(target=drain, daemon=True).start()
 
     def start_graceful_shutdown(self):
         """PUT /v1/info/state SHUTTING_DOWN: drain then stop (the
@@ -638,11 +693,14 @@ class WorkerServer:
     # ------------------------------------------------------------------
     def _announce_loop(self):
         while not self._stop.is_set():
-            if self.task_manager.fault_injector.fires(
-                "heartbeat", key=self.node_id
+            winj = self.task_manager.fault_injector
+            if winj.fires("heartbeat", key=self.node_id) or winj.fires(
+                "announce_drop", key=self.node_id
             ):
                 # injected missed announcement: the coordinator's
-                # failure detector sees this node go silent
+                # failure detector sees this node go silent (node-churn
+                # chaos uses announce_drop to model loss WITHOUT death —
+                # pings keep succeeding, so SUSPECT must recover)
                 self._stop.wait(self.announce_interval)
                 continue
             try:
@@ -656,6 +714,9 @@ class WorkerServer:
                 body = json.dumps({
                     "nodeId": self.node_id,
                     "uri": self.uri,
+                    # lifecycle announcements drive the coordinator's
+                    # node state machine (DRAINING/DRAINED visibility)
+                    "state": self.state,
                     "memory": self.memory_manager.snapshot(),
                     "device": self.supervisor.snapshot(),
                     # completed-task wall/row rollups for the

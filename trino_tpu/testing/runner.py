@@ -7,6 +7,11 @@ standard way the reference tests its multi-node story (SURVEY §4).
 """
 from __future__ import annotations
 
+import json
+import os
+import signal
+import subprocess
+import sys
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -53,12 +58,19 @@ class DistributedQueryRunner:
         startup_timeout: float = 10.0,
     ):
         self.session = Session(config=properties)
+        self._catalog_spec = [
+            (name, connector, dict(config))
+            for name, connector, config in catalogs
+        ]
         for name, connector, config in catalogs:
             self.session.create_catalog(name, connector, config)
         self.coordinator = CoordinatorServer(
             self.session, distributed=True
         ).start()
         self.workers: List[WorkerServer] = []
+        # real child processes (worker_main.py), killable with SIGKILL:
+        # list of (Popen, node_id, uri)
+        self.subprocess_workers: List[tuple] = []
         for _ in range(workers):
             w = WorkerServer(
                 _build_catalogs(catalogs), self.coordinator.uri
@@ -95,9 +107,78 @@ class DistributedQueryRunner:
         w.stop()
         return w
 
+    # -- real-process churn (chaos harness) ----------------------------
+    def add_subprocess_worker(
+        self,
+        fault_injection: Optional[dict] = None,
+        startup_timeout: float = 60.0,
+    ) -> tuple:
+        """Spawn a worker as a real child process (worker_main.py) and
+        wait until it announces.  Unlike the in-process workers this one
+        can be SIGKILLed for true kill -9 chaos: no drain, no goodbye,
+        its sockets refuse instantly.  Returns (Popen, node_id, uri)."""
+        cmd = [
+            sys.executable, "-m", "trino_tpu.server.worker_main",
+            "--coordinator", self.coordinator.uri,
+            "--catalogs", json.dumps(
+                [[n, c, cfg] for n, c, cfg in self._catalog_spec]
+            ),
+        ]
+        if fault_injection:
+            cmd += ["--fault-injection", json.dumps(fault_injection)]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+        )
+        line = proc.stdout.readline()  # blocks until the worker is up
+        if not line:
+            proc.kill()
+            raise RuntimeError(
+                "subprocess worker exited before announcing "
+                f"(rc={proc.poll()})"
+            )
+        doc = json.loads(line)
+        node_id, uri = doc["nodeId"], doc["uri"]
+        nm = self.coordinator.coordinator.node_manager
+        deadline = time.time() + startup_timeout
+        while time.time() < deadline:
+            if any(n == node_id for n, _ in nm.alive()):
+                break
+            time.sleep(0.05)
+        else:
+            proc.kill()
+            raise RuntimeError(
+                f"subprocess worker {node_id} never announced in "
+                f"{startup_timeout}s"
+            )
+        entry = (proc, node_id, uri)
+        self.subprocess_workers.append(entry)
+        return entry
+
+    def sigkill_subprocess_worker(self, index: int = -1) -> tuple:
+        """kill -9 a subprocess worker: the process dies mid-whatever,
+        with no chance to drain or announce.  Returns its entry."""
+        entry = self.subprocess_workers.pop(index)
+        proc = entry[0]
+        try:
+            os.kill(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return entry
+
     def stop(self):
         for w in self.workers:
             w.stop()
+        for proc, _, _ in self.subprocess_workers:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+            proc.wait()
+        self.subprocess_workers = []
         self.coordinator.stop()
 
     def __enter__(self):

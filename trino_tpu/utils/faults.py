@@ -35,6 +35,14 @@ SITES = (
     "task_run",             # task fails at start (FailureInjector TASK)
     "task_stall",           # straggler injection (TASK_MANAGEMENT_TIMEOUT)
     "heartbeat",            # worker skips an announcement round
+    "announce_drop",        # like heartbeat but named for node-churn
+                            # chaos: announcement loss without process
+                            # death (the GC-pause / partition analog)
+    "worker_death",         # hard process exit (kill -9 analog) at task
+                            # start; only honored by the WORKER-LEVEL
+                            # injector of a subprocess worker — an
+                            # in-process worker firing it would take the
+                            # whole test runner down
     "cache_read",           # corrupt a spilled result-cache frame on read
     "oom",                  # memory reservation behaves as if the pool
                             # were exhausted (LocalMemoryManager tier)

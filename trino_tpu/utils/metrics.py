@@ -41,6 +41,7 @@ METRIC_SUBSYSTEMS = (
     "stats",
     "device",
     "straggler",
+    "node",
 )
 
 METRIC_NAME_RE = re.compile(
