@@ -361,6 +361,17 @@ def _crash_forensics() -> dict:
                 out["flight_recorder_tail"] = tail
     except Exception:  # noqa: BLE001
         pass
+    try:
+        # the query doctor's ranked verdict over the incident journal:
+        # names the root-cause class (device fault, memory kill, node
+        # churn, ...) with the event ids it derived from
+        from trino_tpu.obs.doctor import diagnose_recent
+
+        diag = diagnose_recent()
+        if diag is not None:
+            out["doctor"] = diag
+    except Exception:  # noqa: BLE001
+        pass
     return out
 
 
@@ -444,6 +455,16 @@ def _time_config(session, sql, rows, iters):
             }
             for e in bw[:5]
         ]
+    # slow configs also carry the doctor's verdict: the sentinel rolls
+    # these up into the newest round's dominant root-cause class
+    if gbps < 10.0 or out["bandwidth_suspect"]:
+        diag = getattr(session, "last_diagnosis", None)
+        if diag:
+            out["diagnosis"] = {
+                k: diag.get(k)
+                for k in ("verdict", "rootCause", "summary", "eventIds",
+                          "errorCode")
+            }
     # fusion / donation / double-buffer engagement: wall time alone cannot
     # say whether the fused megakernel path, page donation, or the staged
     # H2D pipeline actually ran for this config, so the counters travel

@@ -135,6 +135,18 @@ def load_round(path: str) -> dict:
         gbps = cfg.get("effective_gbps")
         if isinstance(gbps, (int, float)) and gbps > 0:
             bandwidth[name] = float(gbps)
+    # query-doctor verdicts attached per config (bench.py puts a
+    # "doctor" document on crashed configs and a "diagnosis" on slow
+    # ones): the sentinel rolls them up into the round's dominant
+    # root-cause class
+    root_causes: List[str] = []
+    for cfg in configs.values():
+        if not isinstance(cfg, dict):
+            continue
+        for key in ("doctor", "diagnosis"):
+            d = cfg.get(key)
+            if isinstance(d, dict) and d.get("rootCause"):
+                root_causes.append(str(d["rootCause"]))
     blob = tail + (json.dumps(parsed) if parsed else "")
     crashes = sum(blob.count(sig) for sig in CRASH_SIGNATURES)
     errors = sum(
@@ -164,6 +176,7 @@ def load_round(path: str) -> dict:
         "crashes": crashes,
         "errors": errors,
         "op_walls": op_walls,
+        "root_causes": root_causes,
     }
 
 
@@ -346,6 +359,20 @@ def main(argv=None) -> int:
     rounds = [load_round(p) for p in paths]
     rounds.sort(key=lambda r: r["round"])
     verdicts = judge(rounds)
+    # the newest round's verdict line names the dominant root-cause
+    # class the query doctor attached to its crashed/slow configs
+    causes = rounds[-1].get("root_causes") or []
+    if causes:
+        from collections import Counter
+
+        cause, n = Counter(causes).most_common(1)[0]
+        verdicts[-1]["dominant_root_cause"] = cause
+        verdicts[-1]["reason"] = (
+            (verdicts[-1]["reason"] + "; " if verdicts[-1]["reason"]
+             else "")
+            + "dominant root cause: %s (%d/%d diagnosed config(s))"
+            % (cause, n, len(causes))
+        )
     print(to_markdown(verdicts))
     if args.json == "-":
         print(json.dumps(verdicts, indent=2))

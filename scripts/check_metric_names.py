@@ -41,6 +41,12 @@ MEMORY_LITERAL_RE = re.compile(r'["\'](trino_tpu_memory_[a-z0-9_]*)["\']')
 # trino_tpu_node_* series drive churn dashboards and the chaos harness
 # asserts on them by full name
 NODE_LITERAL_RE = re.compile(r'["\'](trino_tpu_node_[a-z0-9_]*)["\']')
+# incident-journal and query-doctor literals likewise: the doctor's
+# acceptance tests assert on these series by full name
+JOURNAL_LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_journal_[a-z0-9_]*)["\']'
+)
+DOCTOR_LITERAL_RE = re.compile(r'["\'](trino_tpu_doctor_[a-z0-9_]*)["\']')
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -78,7 +84,8 @@ def check_tree(root: str):
             text = f.read()
         seen_spans = set()
         for regex in (
-            REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE, NODE_LITERAL_RE
+            REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE,
+            NODE_LITERAL_RE, JOURNAL_LITERAL_RE, DOCTOR_LITERAL_RE,
         ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
@@ -114,6 +121,10 @@ def check_tree(root: str):
          "trino_tpu.obs.history", "HISTORY_FIELDS"),
         ("trino_tpu/server/discovery.py",
          "trino_tpu.server.discovery", "NODE_FIELDS"),
+        ("trino_tpu/obs/journal.py",
+         "trino_tpu.obs.journal", "EVENT_FIELDS"),
+        ("trino_tpu/obs/doctor.py",
+         "trino_tpu.obs.doctor", "DIAGNOSIS_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# One-command CI gate: source linters, the tier-1 test suite, and the
+# bench regression sentinel, in that order.  Exit non-zero when any
+# stage fails.  The sentinel is advisory-skipped (not failed) when the
+# checkout carries no BENCH_r*.json trajectory to judge.
+#
+# Usage: scripts/ci.sh [pytest args...]
+set -o pipefail
+
+root="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$root"
+rc=0
+
+echo "== lint =="
+python scripts/lint.py || rc=1
+
+echo "== tier-1 tests =="
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=1
+
+echo "== bench sentinel =="
+if ls BENCH_r*.json >/dev/null 2>&1; then
+    python scripts/bench_sentinel.py || rc=1
+else
+    echo "no BENCH_r*.json trajectory; sentinel skipped"
+fi
+
+echo "== ci: $([ "$rc" -eq 0 ] && echo ok || echo FAIL) =="
+exit "$rc"

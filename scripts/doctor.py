@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""doctor: post-mortem root-cause verdicts from persisted journal segments.
+
+After a hard crash (kill -9, host OOM, TPU runtime abort) the process is
+gone but the incident journal's mmap'd segments survive on disk.  This
+tool replays the same ordered rule table the in-process query doctor
+runs at finalize, against nothing but those segments (plus, optionally,
+the persisted query history for the structured error code):
+
+    doctor.py --journal DIR <query_id>    diagnose one specific query
+    doctor.py --journal DIR --last-crash  find the newest query that
+                                          never reached FINISHED and
+                                          diagnose it
+    doctor.py --journal DIR --events      dump recovered events (JSONL)
+
+Exit status: 0 with a verdict, 1 when no events / no crashed query could
+be recovered from the directory.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "query_id", nargs="?", default=None,
+        help="query to diagnose (omit with --last-crash)",
+    )
+    ap.add_argument(
+        "--journal", required=True,
+        help="event-journal directory (the event_journal_dir the crashed "
+        "process ran with)",
+    )
+    ap.add_argument(
+        "--history", default=None,
+        help="persisted query-history directory (query_history_dir); "
+        "supplies the structured error code when available",
+    )
+    ap.add_argument(
+        "--last-crash", action="store_true",
+        help="diagnose the newest query that never reached FINISHED",
+    )
+    ap.add_argument(
+        "--events", action="store_true",
+        help="dump every recovered journal event as JSONL and exit",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the raw diagnosis document instead of the rendered "
+        "verdict",
+    )
+    args = ap.parse_args(argv)
+
+    from trino_tpu.obs import doctor
+    from trino_tpu.obs.journal import read_journal_dir
+
+    if args.events:
+        events = read_journal_dir(args.journal)
+        if not events:
+            print("no journal events in %s" % args.journal,
+                  file=sys.stderr)
+            return 1
+        for e in events:
+            print(json.dumps(e, sort_keys=True))
+        return 0
+
+    if args.query_id is None and not args.last_crash:
+        ap.error("a query_id or --last-crash is required")
+
+    diag = doctor.diagnose_from_dir(
+        args.journal,
+        query_id=args.query_id,
+        history_dir=args.history,
+    )
+    if diag is None:
+        print(
+            "no diagnosable query recovered from %s" % args.journal,
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(diag, indent=2, sort_keys=True))
+    else:
+        print("query: %s" % diag.get("queryId"))
+        print(doctor.format_diagnosis(diag))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

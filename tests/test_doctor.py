@@ -1,0 +1,476 @@
+"""Query-doctor acceptance: seeded faults must be named by the verdict.
+
+For every fault class the chaos harness can seed — oom, device_loss,
+spool corruption, worker death, stats-estimate skew — the doctor's top
+verdict must name that injection site and cite the concrete journal
+event ids it derived the verdict from; a healthy control query must get
+an explicit HEALTHY (absence of diagnosis is itself a signal).  The
+kill -9 scenario goes further: a coordinator hard-killed mid-query must
+be diagnosable by a *fresh process* from the persisted journal/history
+segments alone (scripts/doctor.py --last-crash).
+
+Reference parity: Trino's EventListener#queryCompleted carries an
+ErrorCode + failure info for exactly this post-hoc triage role; the
+ranked multi-signal verdict is the part Trino leaves to a human.
+"""
+import json
+import os
+import sqlite3
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from tpch_sql import QUERIES, oracle_dialect
+from trino_tpu.obs import doctor, journal
+from trino_tpu.server.fte import FaultTolerantScheduler
+from trino_tpu.server.worker import WorkerServer
+from trino_tpu.session import tpch_session
+from trino_tpu.sql.parser import parse
+from trino_tpu.testing import DistributedQueryRunner
+from trino_tpu.testing.runner import _build_catalogs
+
+SF = 0.001
+TPCH = (("tpch", "tpch", {"tpch.scale-factor": SF}),)
+Q3 = QUERIES[3][0]
+Q6 = QUERIES[6][0]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal():
+    """Each scenario gets a clean process-global journal: ambient-event
+    attribution is wall-clock windowed, so a prior test's fault firings
+    must never bleed into this one's verdict."""
+    journal._reset_journal()
+    doctor._reset_diagnoses()
+    yield
+    journal._reset_journal()
+    doctor._reset_diagnoses()
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["customer", "orders", "lineitem"])
+    return conn
+
+
+def _put_state(uri: str, state: str) -> dict:
+    req = urllib.request.Request(
+        f"{uri}/v1/info/state", data=json.dumps(state).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT",
+    )
+    with urllib.request.urlopen(req, timeout=5.0) as resp:
+        return json.loads(resp.read())
+
+
+def _status(uri: str) -> dict:
+    with urllib.request.urlopen(f"{uri}/v1/status", timeout=5.0) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_for(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _ev(i, etype, qid="q1", node="", task="", **detail):
+    return {
+        "eventId": i, "eventType": etype, "queryId": qid,
+        "taskId": task, "nodeId": node, "severity": "warn",
+        "detail": detail, "ts": float(i),
+    }
+
+
+# --- units: error classification, rule precedence, torn-tail reads -------
+
+
+def test_classify_error_structured_codes():
+    assert doctor.classify_error(None) == ""
+    assert doctor.classify_error("") == ""
+    cases = (
+        ("ExceededMemoryLimitError: query memory limit",
+         "EXCEEDED_MEMORY_LIMIT"),
+        ("QueryKilledError: Query killed to free memory", "QUERY_KILLED"),
+        ("DeviceFaultError: forced device_loss in kernel", "DEVICE_LOSS"),
+        ("DeviceFaultError: forced device_wedge", "DEVICE_WEDGE"),
+        ("SchedulerError: NO_NODES_AVAILABLE", "NO_NODES_AVAILABLE"),
+        ("RuntimeError: REMOTE_HOST_GONE fetching page",
+         "REMOTE_HOST_GONE"),
+        ("PageIntegrityError: crc mismatch", "PAGE_CORRUPTION"),
+        ("ValueError: something else entirely", "INTERNAL_ERROR"),
+    )
+    for text, code in cases:
+        assert doctor.classify_error(text) == code, text
+
+
+def test_rule_precedence_device_fault_outranks_memory_pressure():
+    """Same evidence, same ranking: the ordered rule table puts the
+    device fault first even though memory-pressure evidence is present,
+    and both survive as findings with their own cited event ids."""
+    events = [
+        _ev(1, journal.MEMORY_REVOKE, reason="pool pressure"),
+        _ev(2, journal.DEVICE_FAULT, node="n1", kind="device_loss",
+            kernel="q6_fused"),
+        _ev(3, journal.CPU_FALLBACK, node="n1"),
+    ]
+    d = doctor.diagnose("q1", events)
+    assert d["verdict"] == doctor.ROOT_CAUSE
+    assert d["rootCause"] == "device_fault"
+    assert set(d["eventIds"]) >= {2, 3}
+    codes = [f["code"] for f in d["findings"]]
+    assert codes.index("device_fault") < codes.index("memory_pressure")
+    # deterministic: re-running the table over the same evidence
+    # reproduces the verdict exactly (minus the fresh timestamp)
+    d2 = doctor.diagnose("q1", events)
+    assert {k: v for k, v in d.items() if k != "ts"} \
+        == {k: v for k, v in d2.items() if k != "ts"}
+
+
+def test_no_evidence_is_an_explicit_healthy_verdict():
+    d = doctor.diagnose("q_clean", [])
+    assert d["verdict"] == doctor.HEALTHY
+    assert d["rootCause"] == "" and d["eventIds"] == []
+    assert "HEALTHY" in doctor.format_diagnosis(d)
+
+
+def test_read_journal_dir_skips_torn_tail(tmp_path):
+    """A line half-written at the instant of death parses to nothing,
+    never to an error — the events before it are still recovered."""
+    j = journal.EventJournal(str(tmp_path), name="w1")
+    eid = j.emit(journal.MEMORY_KILL, query_id="q1", node_id="n1",
+                 reason="largest query over limit")
+    j.sync()
+    j.close()
+    # a second writer died mid-line: torn JSON then EOF
+    with open(tmp_path / (journal._FILE_PREFIX + "crashed-0.jsonl"),
+              "wb") as f:
+        f.write(b'{"eventId": 99, "eventType": "device_f')
+    events = journal.read_journal_dir(str(tmp_path))
+    assert [e["eventId"] for e in events] == [eid]
+    assert events[0]["eventType"] == journal.MEMORY_KILL
+    assert events[0]["queryId"] == "q1"
+
+
+def test_ambient_events_need_a_window():
+    """Ambient events (no queryId — injector firings, node churn) join a
+    query only through its wall-clock window; without one, a query with
+    no tagged events has no evidence at all."""
+    journal.emit(journal.FAULT_INJECTED, site="oom", key="")
+    ts = journal.get_journal().tail()[-1]["ts"]
+    assert doctor.events_for_query("q_x") == []
+    scoped = doctor.events_for_query("q_x", window=(ts - 0.1, ts + 0.1))
+    assert [e["eventType"] for e in scoped] == [journal.FAULT_INJECTED]
+
+
+# --- seeded-fault scenarios (local session) ------------------------------
+
+
+def test_healthy_query_diagnosed_healthy():
+    s = tpch_session(SF)
+    page = s.execute("select count(*) from lineitem")
+    assert page.to_pylist()[0][0] > 0
+    d = s.last_diagnosis
+    assert d is not None and d["queryId"].startswith("q_")
+    assert d["verdict"] == doctor.HEALTHY
+    assert d["errorCode"] == ""
+
+
+def test_seeded_oom_diagnosed_memory_pressure():
+    """Scenario `oom`: the verdict names memory pressure, carries the
+    structured error code, cites the injector's event ids — and the
+    failed query is persisted to history with the same code."""
+    spec = json.dumps({"seed": 7, "oom": {"p": 1.0, "times": 1}})
+    s = tpch_session(0.01, fault_injection=spec)
+    with pytest.raises(Exception, match="memory limit"):
+        s.execute("select sum(l_extendedprice) from lineitem")
+    d = s.last_diagnosis
+    assert d is not None and d["verdict"] == doctor.ROOT_CAUSE
+    assert d["rootCause"] == "memory_pressure"
+    assert d["errorCode"] == "EXCEEDED_MEMORY_LIMIT"
+    assert d["eventIds"], "verdict cites no journal events"
+    cited = {e["eventId"] for e in journal.get_journal().tail()}
+    assert set(d["eventIds"]) <= cited
+    failed = [r for r in s.query_history if r["state"] == "FAILED"]
+    assert failed and failed[-1]["error_code"] == "EXCEEDED_MEMORY_LIMIT"
+
+
+def test_seeded_device_loss_diagnosed_device_fault(oracle_conn):
+    """Scenario `device_loss`: the query completes degraded (CPU re-run)
+    yet the finalize-time verdict still names the device fault."""
+    s = tpch_session(SF, result_cache=False,
+                     fault_injection=json.dumps({"device_loss": {"nth": 1}}),
+                     device_probe_backoff_s=30.0)
+    page = s.execute(Q6)
+    expected = oracle_conn.execute(oracle_dialect(Q6)).fetchall()
+    assert_rows_match(page.to_pylist(), expected, tol=2e-2, ordered=True)
+    d = s.last_diagnosis
+    assert d is not None and d["verdict"] == doctor.ROOT_CAUSE
+    assert d["rootCause"] == "device_fault"
+    assert "device_loss" in d["summary"]
+    assert d["eventIds"], "verdict cites no journal events"
+    assert d["errorCode"] == "", "degraded completion is not an error"
+
+
+# --- seeded-fault scenarios (distributed / FTE) --------------------------
+
+
+def test_seeded_spool_corruption_diagnosed(oracle_conn):
+    """Scenario `spool_corruption`: the heal event is query-tagged, so
+    the doctor needs no window to pin the corruption on this query."""
+    spec = json.dumps({"seed": 5, "spool_write_corrupt": {"nth": 1}})
+    with DistributedQueryRunner(
+        workers=2, catalogs=TPCH, properties={"retry_policy": "task"}
+    ) as runner:
+        nm = runner.coordinator.coordinator.node_manager
+        fte = FaultTolerantScheduler(
+            runner.session.catalogs, nm,
+            properties={"group_capacity": 4096, "fault_injection": spec},
+        )
+        sql = ("select l_returnflag, count(*) c from lineitem "
+               "group by l_returnflag order by l_returnflag")
+        plan = runner.session._plan_stmt(parse(sql))
+        t0 = time.time()
+        page = fte.run(plan, "q_doc_spool")
+        t1 = time.time()
+        expected = oracle_conn.execute(oracle_dialect(sql)).fetchall()
+        assert_rows_match(page.to_pylist(), expected, tol=2e-2,
+                          ordered=True)
+        assert fte.heal_actions, "corruption never injected/healed"
+        d = doctor.diagnose_query("q_doc_spool", window=(t0, t1))
+        assert d["verdict"] == doctor.ROOT_CAUSE
+        assert d["rootCause"] == "spool_corruption"
+        assert "healed" in d["summary"]
+        assert d["eventIds"], "verdict cites no journal events"
+
+
+def test_seeded_worker_death_diagnosed_node_churn(oracle_conn):
+    """Scenario `worker_death`: the victim subprocess hard-exits mid-task
+    (status 137); FTE reassignment events are query-tagged, node-GONE
+    churn joins through the window, and the verdict names the churn."""
+    with DistributedQueryRunner(
+        workers=2, catalogs=TPCH,
+        properties={"node_gone_grace_s": 1.5},
+    ) as runner:
+        proc, _victim_id, victim_uri = runner.add_subprocess_worker(
+            fault_injection={"worker_death": {"nth": 1}},
+        )
+        nm = runner.coordinator.coordinator.node_manager
+        fte = FaultTolerantScheduler(
+            runner.session.catalogs, nm,
+            properties={"retry_policy": "task"},
+        )
+        plan = runner.session._plan_stmt(parse(Q3))
+        t0 = time.time()
+        page = fte.run(plan, "q_doc_churn")
+        expected = oracle_conn.execute(oracle_dialect(Q3)).fetchall()
+        assert_rows_match(page.to_pylist(), expected, tol=2e-2,
+                          ordered=True)
+        assert _wait_for(lambda: proc.poll() is not None, timeout=30.0)
+        assert proc.poll() == 137
+        dead = {u for u, _t in fte._created_tasks if u == victim_uri}
+        assert dead, "the doomed worker never received a task"
+        # the failure detector writes the ambient churn event only after
+        # node_gone_grace_s of silence; hold the window open until then
+        assert _wait_for(lambda: any(
+            e["eventType"] in (journal.NODE_GONE, journal.NODE_SUSPECT)
+            for e in journal.get_journal().tail()
+        ), timeout=30.0), "no churn event after worker death"
+        t1 = time.time()
+        d = doctor.diagnose_query("q_doc_churn", window=(t0, t1))
+        assert d["verdict"] == doctor.ROOT_CAUSE
+        assert d["rootCause"] == "node_churn"
+        assert "reassigned" in d["summary"]
+        assert d["eventIds"], "verdict cites no journal events"
+
+
+def test_seeded_stats_estimate_diagnosed_estimate_drift():
+    """Scenario `stats_estimate`: the skew leaves only ambient injector
+    events (the scheduler has no per-fragment query tag at estimate
+    time), so this is the window-attribution path end-to-end."""
+    with DistributedQueryRunner(
+        workers=2, catalogs=TPCH, properties={"retry_policy": "task"}
+    ) as runner:
+        nm = runner.coordinator.coordinator.node_manager
+        fte = FaultTolerantScheduler(
+            runner.session.catalogs, nm,
+            properties={
+                "group_capacity": 4096,
+                "fault_injection": {"seed": 1,
+                                    "stats_estimate": {"factor": 10}},
+            },
+            metadata=runner.session.metadata,
+        )
+        plan = runner.session._plan_stmt(
+            parse("select count(*) from orders where o_orderkey > 0")
+        )
+        t0 = time.time()
+        page = fte.run(plan, "q_doc_stats")
+        t1 = time.time()
+        assert page.to_pylist()[0][0] > 0
+        d = doctor.diagnose_query("q_doc_stats", window=(t0, t1))
+        assert d["verdict"] == doctor.ROOT_CAUSE
+        assert d["rootCause"] == "estimate_drift"
+        assert "stats_estimate" in d["summary"]
+
+
+# --- SQL + HTTP surfaces --------------------------------------------------
+
+
+def test_events_and_diagnoses_queryable_over_sql():
+    """system.runtime.events / .diagnoses answer from SQL on a live
+    distributed cluster, and the coordinator's finalize pass records a
+    verdict for ordinary queries without being asked."""
+    with DistributedQueryRunner(workers=2, catalogs=TPCH) as runner:
+        assert runner.rows("select count(*) from lineitem") == [(5995,)]
+        journal.emit(journal.STRAGGLER_FLAG, query_id="q_sql_vis",
+                     task_id="q_sql_vis.1.0.0", wallS=2.0, medianS=0.5)
+        rows = runner.rows(
+            "select event_type, query_id, severity "
+            "from system.runtime.events where query_id = 'q_sql_vis'"
+        )
+        assert rows == [("straggler_flag", "q_sql_vis", "info")]
+        diags = runner.rows(
+            "select query_id, verdict from system.runtime.diagnoses"
+        )
+        assert diags, "coordinator finalize recorded no diagnosis"
+        assert all(v in (doctor.HEALTHY, doctor.ROOT_CAUSE)
+                   for _q, v in diags)
+
+
+def test_query_events_endpoint_serves_correlated_events():
+    with DistributedQueryRunner(workers=2, catalogs=TPCH) as runner:
+        runner.rows("select count(*) from orders")
+        co = runner.coordinator.coordinator
+        qid = sorted(co.queries)[-1]
+        journal.emit(journal.HEDGE, query_id=qid,
+                     task_id=f"{qid}.1.0.0", reason="test straggler")
+        with urllib.request.urlopen(
+            f"{runner.coordinator.uri}/v1/query/{qid}/events", timeout=5.0
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["queryId"] == qid
+        assert any(e["eventType"] == journal.HEDGE for e in doc["events"])
+        with urllib.request.urlopen(
+            f"{runner.coordinator.uri}/v1/query/{qid}/diagnosis",
+            timeout=5.0,
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["diagnosis"]["verdict"] in (doctor.HEALTHY,
+                                               doctor.ROOT_CAUSE)
+
+
+def test_explain_analyze_carries_diagnosis_section():
+    s = tpch_session(SF)
+    text = "\n".join(
+        r[0] for r in s.execute(
+            "explain analyze select count(*) from lineitem"
+        ).to_pylist()
+    )
+    assert "Diagnosis:" in text
+
+
+# --- drain flushes telemetry ---------------------------------------------
+
+
+def test_drain_flushes_journal_and_spans(tmp_path):
+    """Satellite: DRAINING -> DRAINED is a telemetry barrier — journal
+    segments and buffered spans land on disk before the worker reports
+    DRAINED, so a drain-then-terminate never loses the tail."""
+    from trino_tpu.utils.tracing import TRACER, OtlpFileExporter
+
+    journal.configure(str(tmp_path / "journal"))
+    otlp = tmp_path / "spans.jsonl"
+    exporter = OtlpFileExporter(str(otlp))
+    TRACER.attach_exporter(exporter)
+    w = WorkerServer(_build_catalogs(TPCH)).start()
+    try:
+        journal.emit(journal.CACHE_HEAL, query_id="q_drain_doc",
+                     node_id=w.node_id, frames=1)
+        with TRACER.span("drain_doc_probe"):
+            pass
+        _put_state(w.uri, "DRAINING")
+        assert _wait_for(
+            lambda: _status(w.uri)["state"] == "DRAINED", timeout=10.0
+        )
+        events = journal.read_journal_dir(str(tmp_path / "journal"))
+        assert any(
+            e["eventType"] == journal.CACHE_HEAL
+            and e["queryId"] == "q_drain_doc"
+            for e in events
+        ), "journal event not on disk after DRAINED"
+        assert otlp.exists() and otlp.stat().st_size > 0, \
+            "buffered spans not exported by the drain walk"
+    finally:
+        w.stop()
+        TRACER.attach_exporter(None)
+
+
+# --- kill -9 post-mortem (reconstruction from disk alone) ----------------
+
+
+_CRASH_CHILD = """
+import json, os, sys
+sys.path.insert(0, sys.argv[3])
+from trino_tpu import force_cpu
+force_cpu(2)
+from trino_tpu.session import tpch_session
+s = tpch_session(
+    0.01,
+    event_journal_dir=sys.argv[1],
+    query_history_dir=sys.argv[2],
+    query_doctor=False,  # the in-process doctor never ran: offline only
+    fault_injection=json.dumps({"seed": 7, "oom": {"p": 1.0, "times": 1}}),
+)
+try:
+    s.execute("select sum(l_extendedprice) from lineitem")
+except Exception:
+    pass
+os._exit(137)  # kill -9 semantics: no atexit, no flush, no goodbye
+"""
+
+
+def test_kill9_postmortem_reconstructs_verdict_from_disk(tmp_path):
+    """Acceptance: a coordinator killed with -9 mid-incident leaves only
+    its mmap'd segments; a FRESH process (scripts/doctor.py) must find
+    the crashed query and reproduce the ranked verdict from those alone."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    jd, hd = str(tmp_path / "journal"), str(tmp_path / "history")
+    script = tmp_path / "crash_child.py"
+    script.write_text(_CRASH_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    child = subprocess.run(
+        [sys.executable, str(script), jd, hd, repo],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert child.returncode == 137, child.stderr[-2000:]
+
+    res = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "doctor.py"),
+         "--last-crash", "--journal", jd, "--history", hd, "--json"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    diag = json.loads(res.stdout)
+    assert diag["queryId"].startswith("q_")
+    assert diag["verdict"] == doctor.ROOT_CAUSE
+    assert diag["rootCause"] == "memory_pressure"
+    assert diag["errorCode"] == "EXCEEDED_MEMORY_LIMIT"
+    assert diag["eventIds"], "offline verdict cites no events"
+
+    # the rendered form names the query and the cause too
+    res2 = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "doctor.py"),
+         "--last-crash", "--journal", jd, "--history", hd],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert res2.returncode == 0
+    assert diag["queryId"] in res2.stdout
+    assert "memory_pressure" in res2.stdout

@@ -196,6 +196,7 @@ class FragmentResultCache:
             self._drop_spilled(digest)
             self.heals += 1
             self._event("heal")
+            self._journal_heal(digest, "unreadable spilled frame")
             return None
         if injector is not None:
             frame = injector.corrupt("cache_read", frame, key=digest)
@@ -207,7 +208,17 @@ class FragmentResultCache:
             self._drop_spilled(digest)
             self.heals += 1
             self._event("heal")
+            self._journal_heal(digest, "checksum mismatch")
             return None
+
+    @staticmethod
+    def _journal_heal(digest: str, why: str):
+        from ..obs import journal
+
+        journal.emit(
+            journal.CACHE_HEAL, severity=journal.WARN,
+            digest=str(digest)[:16], reason=why,
+        )
 
     def put(self, key, page: Page, tables=()) -> bool:
         nbytes = page_nbytes(page)

@@ -207,6 +207,26 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 512,
         ),
         PropertyMetadata(
+            "event_journal_dir",
+            "directory for the crash-safe engine-wide incident journal "
+            "(mmap'd JSONL segments, scripts/doctor.py reads them); "
+            "empty keeps the journal in-memory only",
+            str, "",
+        ),
+        PropertyMetadata(
+            "event_journal_max_bytes",
+            "byte budget of the on-disk incident journal (the two "
+            "segments rotate, oldest events drop first)",
+            int, 1 << 20,
+        ),
+        PropertyMetadata(
+            "query_doctor",
+            "run the automated query doctor at query finalize and "
+            "attach its ranked root-cause diagnosis to EXPLAIN ANALYZE, "
+            "system.runtime.diagnoses, and the query history",
+            _bool, True,
+        ),
+        PropertyMetadata(
             "bandwidth_ledger",
             "bracket every supervised dispatch with block_until_ready "
             "and account bytes-touched / device wall into per-kernel "

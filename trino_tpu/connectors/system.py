@@ -172,7 +172,34 @@ SCHEMAS: Dict[str, List] = {
         ("rows", T.BIGINT),
         ("wall_s", T.DOUBLE),
         ("error", T.VARCHAR),
+        ("error_code", T.VARCHAR),
         ("operators", T.BIGINT),
+    ],
+    # the in-memory tail of the engine-wide incident journal
+    # (obs/journal.py): every subsystem's typed, query/task/node-
+    # correlated anomaly events, oldest first
+    "events": [
+        ("event_id", T.BIGINT),
+        ("event_type", T.VARCHAR),
+        ("query_id", T.VARCHAR),
+        ("task_id", T.VARCHAR),
+        ("node_id", T.VARCHAR),
+        ("severity", T.VARCHAR),
+        ("detail", T.VARCHAR),
+        ("ts", T.DOUBLE),
+    ],
+    # one row per query-doctor verdict (obs/doctor.py finalize pass):
+    # the ranked causal root-cause report, newest last
+    "diagnoses": [
+        ("query_id", T.VARCHAR),
+        ("verdict", T.VARCHAR),
+        ("root_cause", T.VARCHAR),
+        ("summary", T.VARCHAR),
+        ("error_code", T.VARCHAR),
+        ("event_ids", T.VARCHAR),
+        ("findings", T.BIGINT),
+        ("wall_s", T.DOUBLE),
+        ("ts", T.DOUBLE),
     ],
     # one row per metric series from the process-global MetricsRegistry —
     # the plugin/trino-jmx "metrics as SQL" surface; histograms expose
@@ -452,9 +479,49 @@ class _SystemSource:
                 "rows": [int(r.get("rows") or 0) for r in recs],
                 "wall_s": [float(r.get("wallS") or 0.0) for r in recs],
                 "error": [r.get("error") for r in recs],
+                "error_code": [r.get("errorCode") or "" for r in recs],
                 "operators": [
                     len(r.get("operators") or ()) for r in recs
                 ],
+            }
+        if table == "events":
+            import json as _json
+
+            from ..obs import journal as _journal
+
+            tail = _journal.get_journal().tail()
+            return {
+                "event_id": [int(e.get("eventId") or 0) for e in tail],
+                "event_type": [e.get("eventType", "") for e in tail],
+                "query_id": [e.get("queryId", "") for e in tail],
+                "task_id": [e.get("taskId", "") for e in tail],
+                "node_id": [e.get("nodeId", "") for e in tail],
+                "severity": [e.get("severity", "") for e in tail],
+                "detail": [
+                    _json.dumps(e.get("detail") or {}, sort_keys=True)
+                    for e in tail
+                ],
+                "ts": [float(e.get("ts") or 0.0) for e in tail],
+            }
+        if table == "diagnoses":
+            from ..obs import doctor as _doctor
+
+            recs = _doctor.recent_diagnoses()
+            return {
+                "query_id": [d.get("queryId", "") for d in recs],
+                "verdict": [d.get("verdict", "") for d in recs],
+                "root_cause": [d.get("rootCause", "") for d in recs],
+                "summary": [d.get("summary", "") for d in recs],
+                "error_code": [d.get("errorCode", "") for d in recs],
+                "event_ids": [
+                    ",".join(str(i) for i in d.get("eventIds") or ())
+                    for d in recs
+                ],
+                "findings": [
+                    len(d.get("findings") or ()) for d in recs
+                ],
+                "wall_s": [float(d.get("wallS") or 0.0) for d in recs],
+                "ts": [float(d.get("ts") or 0.0) for d in recs],
             }
         if table == "metrics":
             from ..utils.metrics import REGISTRY

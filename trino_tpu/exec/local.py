@@ -401,7 +401,7 @@ class LocalExecutor:
         disabled for the retry; the supervisor keeps advertising the
         sick device so schedulers route around this node meanwhile."""
         sup = self.supervisor
-        sup.note_fallback_attempt()
+        sup.note_fallback_attempt(query_id=self.query_id)
         orig_config = self.config
         cfg = dict(orig_config)
         cfg["jit_fragments"] = False
@@ -907,11 +907,20 @@ class LocalExecutor:
             )
             if frags is None:
                 return None
-            return streaming.execute_streaming(
+            out = streaming.execute_streaming(
                 self, plan, frags, int(limit)
             )
         except Exception:
             return None
+        if out is not None:
+            from ..obs import journal
+
+            journal.emit(
+                journal.FORCED_STREAMING, query_id=self.query_id,
+                severity=journal.WARN,
+                fragments=len(frags) if hasattr(frags, "__len__") else 0,
+            )
+        return out
 
     # ------------------------------------------------------------------
     def _execute_write(self, w: P.TableWriter) -> Page:

@@ -69,6 +69,14 @@ class MemoryAdmissionController:
                             "trino_tpu_memory_admission_queued_total",
                             "Queries queued by memory admission control",
                         ).inc()
+                        from ..obs import journal
+
+                        journal.emit(
+                            journal.ADMISSION_BLOCK, query_id=query_id,
+                            severity=journal.WARN,
+                            estimatedBytes=bytes_,
+                            capacityBytes=int(self.capacity_fn()),
+                        )
                         if on_queue is not None:
                             on_queue()
                     remaining = deadline - time.monotonic()

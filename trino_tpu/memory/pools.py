@@ -174,6 +174,14 @@ class LocalMemoryManager:
                 "trino_tpu_memory_revoke_total",
                 "Revocation (spill-before-kill) requests that freed bytes",
             ).inc(fired)
+            from ..obs import journal
+
+            journal.emit(
+                journal.MEMORY_REVOKE, query_id=exclude or "",
+                node_id=self.node_id, severity=journal.WARN,
+                listeners=fired, revokedBytes=revoked,
+                neededBytes=int(needed),
+            )
         return revoked
 
     # -- reservation ---------------------------------------------------
@@ -299,6 +307,13 @@ class LocalMemoryManager:
             "trino_tpu_memory_killed_total",
             "Queries killed by the low-memory killer",
         ).inc()
+        from ..obs import journal
+
+        journal.emit(
+            journal.MEMORY_KILL, query_id=query_id,
+            node_id=self.node_id, severity=journal.ERROR,
+            reason=str(reason)[:200],
+        )
 
     def is_killed(self, query_id: str) -> Optional[str]:
         with self._cond:

@@ -21,9 +21,34 @@ Two always-on production-profiling surfaces in the spirit of Kanev et al.
   hedging (``trino_tpu_straggler_*`` metrics);
 - :mod:`.history` — crash-safe byte-bounded persisted query history
   (``query_history_dir``), same torn-tail-tolerant mmap'd JSONL shape as
-  the flight recorder, backing ``system.runtime.completed_queries``.
+  the flight recorder, backing ``system.runtime.completed_queries``;
+- :mod:`.journal` — the engine-wide incident journal: every subsystem
+  that bumps an anomaly metric also appends a typed query/task/node-
+  correlated event (``event_journal_dir`` upgrades it to the crash-safe
+  on-disk segments), backing ``system.runtime.events``;
+- :mod:`.doctor` — the query doctor: deterministic ordered-rule
+  correlation of the journal with the flight recorder, bandwidth
+  ledger, timeline, and history into a ranked causal verdict (EXPLAIN
+  ANALYZE "Diagnosis", ``system.runtime.diagnoses``,
+  ``scripts/doctor.py``).
 """
 from .bandwidth import BandwidthLedger, roofline_bytes_per_s
+from .doctor import (
+    DIAGNOSIS_FIELDS,
+    classify_error,
+    diagnose,
+    diagnose_from_dir,
+    diagnose_query,
+    format_diagnosis,
+    recent_diagnoses,
+    record_diagnosis,
+)
+from .journal import (
+    EVENT_FIELDS,
+    EventJournal,
+    get_journal,
+    read_journal_dir,
+)
 from .flight_recorder import (
     RECORD_FIELDS,
     FlightRecorder,
@@ -50,6 +75,18 @@ from .opstats import (
 __all__ = [
     "BandwidthLedger",
     "roofline_bytes_per_s",
+    "DIAGNOSIS_FIELDS",
+    "classify_error",
+    "diagnose",
+    "diagnose_from_dir",
+    "diagnose_query",
+    "format_diagnosis",
+    "recent_diagnoses",
+    "record_diagnosis",
+    "EVENT_FIELDS",
+    "EventJournal",
+    "get_journal",
+    "read_journal_dir",
     "RECORD_FIELDS",
     "FlightRecorder",
     "last_recorder",

@@ -1,0 +1,552 @@
+"""The query doctor: ranked cross-subsystem root-cause verdicts.
+
+At query finalize (and on demand for crashed queries via the persisted
+journal segments) the doctor correlates the incident journal
+(:mod:`.journal`) with the flight recorder, the HBM bandwidth ledger,
+the per-operator timeline, and the query history into one deterministic
+causal verdict:
+
+    ROOT_CAUSE: device_fault — device_loss on node-2/devgen:lineitem
+    -> quarantine -> CPU degraded re-run [events 3,4,7]
+
+Rule evaluation is an ordered table with explicit precedence — fault >
+kill > node-churn > memory pressure > corruption heals >
+straggler/hedge > fusion misses > estimate-drift (the last per Leis et al.,
+*How Good Are Query Optimizers, Really?*: estimated-vs-observed rows
+from the operator timeline) — so the same evidence always produces the
+same ranking.  Every verdict cites the concrete event ids it derived
+from, and a query with no anomalies gets an explicit ``HEALTHY``
+verdict so absence of diagnosis is itself a signal.
+
+Surfaces: a "Diagnosis" section in EXPLAIN ANALYZE, the
+``system.runtime.diagnoses`` table, ``GET /v1/query/{id}/diagnosis``,
+and ``scripts/doctor.py <query_id|--last-crash>`` for post-mortem use
+after kill -9 (reconstruction from on-disk segments alone).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import journal as J
+
+# wire schema for one diagnosis document (system.runtime.diagnoses /
+# /v1/query/{id}/diagnosis), linted by scripts/check_metric_names.py
+DIAGNOSIS_FIELDS = (
+    "queryId",
+    "verdict",
+    "rootCause",
+    "summary",
+    "findings",
+    "eventIds",
+    "wallS",
+    "error",
+    "errorCode",
+    "ts",
+)
+
+HEALTHY = "HEALTHY"
+ROOT_CAUSE = "ROOT_CAUSE"
+
+# estimated-vs-observed row ratio above which an operator counts as
+# estimate-drifted (Leis et al. report q-errors of 10^2..10^4 for real
+# optimizers; 4x keeps ordinary stats noise out of the verdict)
+ESTIMATE_DRIFT_RATIO = 4.0
+
+
+# -- structured error codes (satellite: history post-mortem surface) -----
+
+
+def classify_error(error) -> str:
+    """Map an error (exception or rendered text) to a structured code.
+
+    The coordinator renders errors as ``TypeName: message``, so type
+    names are part of the searchable text."""
+    if error is None:
+        return ""
+    text = str(error)
+    if not text:
+        return ""
+    checks = (
+        ("NO_NODES_AVAILABLE", "NO_NODES_AVAILABLE"),
+        ("QUERY_QUEUE_FULL", "QUERY_QUEUE_FULL"),
+        ("QueryKilledError", "QUERY_KILLED"),
+        ("Query killed", "QUERY_KILLED"),
+        ("device_wedge", "DEVICE_WEDGE"),
+        ("device_loss", "DEVICE_LOSS"),
+        ("DeviceFaultError", "DEVICE_FAULT"),
+        ("REMOTE_HOST_GONE", "REMOTE_HOST_GONE"),
+        ("admission queue", "ADMISSION_TIMEOUT"),
+        ("ExceededMemoryLimit", "EXCEEDED_MEMORY_LIMIT"),
+        ("memory limit", "EXCEEDED_MEMORY_LIMIT"),
+        ("PageIntegrityError", "PAGE_CORRUPTION"),
+        ("SchedulerError", "SCHEDULER_ERROR"),
+    )
+    for needle, code in checks:
+        if needle in text:
+            return code
+    return "INTERNAL_ERROR"
+
+
+# -- evidence helpers ----------------------------------------------------
+
+
+def _events_of(ctx: Dict, *types, sites: Optional[tuple] = None) -> List[Dict]:
+    out = []
+    for e in ctx.get("events") or []:
+        if e.get("eventType") not in types:
+            continue
+        if sites is not None and e.get("eventType") == J.FAULT_INJECTED:
+            if (e.get("detail") or {}).get("site") not in sites:
+                continue
+        out.append(e)
+    return out
+
+
+def _ids(events: List[Dict]) -> List[int]:
+    return [int(e.get("eventId", 0)) for e in events]
+
+
+def _finding(code: str, severity: str, summary: str,
+             events: List[Dict]) -> Dict:
+    return {
+        "code": code,
+        "severity": severity,
+        "summary": summary,
+        "eventIds": _ids(events),
+    }
+
+
+# -- the ordered rule table ----------------------------------------------
+
+
+def _rule_device_fault(ctx) -> Optional[Dict]:
+    faults = _events_of(ctx, J.DEVICE_FAULT)
+    injected = _events_of(ctx, J.FAULT_INJECTED,
+                          sites=("device_loss", "device_wedge"))
+    if not faults and not injected:
+        return None
+    transitions = _events_of(ctx, J.DEVICE_QUARANTINE, J.DEVICE_BLACKLIST)
+    fallbacks = _events_of(ctx, J.CPU_FALLBACK)
+    first = faults[0] if faults else injected[0]
+    detail = first.get("detail") or {}
+    kind = detail.get("kind") or detail.get("site") or "device_fault"
+    where = first.get("nodeId") or "local"
+    kernel = detail.get("kernel") or ""
+    summary = f"{kind} on {where}" + (f"/{kernel}" if kernel else "")
+    if any(e.get("eventType") == J.DEVICE_BLACKLIST for e in transitions):
+        summary += " -> blacklist"
+    elif transitions:
+        summary += " -> quarantine"
+    if fallbacks:
+        summary += " -> CPU degraded re-run"
+    return _finding("device_fault", J.ERROR, summary,
+                    faults + injected + transitions + fallbacks)
+
+
+def _rule_memory_kill(ctx) -> Optional[Dict]:
+    kills = _events_of(ctx, J.MEMORY_KILL)
+    if not kills and ctx.get("errorCode") != "QUERY_KILLED":
+        return None
+    reason = ""
+    if kills:
+        reason = (kills[0].get("detail") or {}).get("reason", "")
+    summary = "query killed by the memory killer"
+    if reason:
+        summary += f" ({reason[:120]})"
+    revokes = _events_of(ctx, J.MEMORY_REVOKE)
+    if revokes:
+        summary += " after revoke cascade"
+    return _finding("memory_kill", J.ERROR, summary, kills + revokes)
+
+
+def _rule_node_churn(ctx) -> Optional[Dict]:
+    # FTE_REASSIGN alone is a recovery *mechanism*, not churn evidence —
+    # spool heals reassign too.  The rule needs an actual node signal.
+    gone = _events_of(ctx, J.NODE_GONE, J.NODE_SUSPECT)
+    deaths = _events_of(ctx, J.FAULT_INJECTED, sites=("worker_death",))
+    if not gone and not deaths \
+            and ctx.get("errorCode") not in ("NO_NODES_AVAILABLE",
+                                             "REMOTE_HOST_GONE"):
+        return None
+    reassigned = _events_of(ctx, J.FTE_REASSIGN)
+    churn = gone + reassigned
+    nodes = sorted({e.get("nodeId") for e in gone + deaths
+                    if e.get("nodeId")})
+    summary = "worker death" if deaths else "node churn"
+    if nodes:
+        summary += f" on {','.join(nodes)}"
+    elif gone:
+        summary += " (node GONE mid-query)"
+    if reassigned:
+        summary += f" -> {len(reassigned)} task attempt(s) reassigned"
+    if ctx.get("errorCode") == "NO_NODES_AVAILABLE":
+        summary += " -> no schedulable nodes left"
+    return _finding("node_churn", J.ERROR if ctx.get("error") else J.WARN,
+                    summary, deaths + churn)
+
+
+def _rule_memory_pressure(ctx) -> Optional[Dict]:
+    oom = _events_of(ctx, J.FAULT_INJECTED, sites=("oom",))
+    revokes = _events_of(ctx, J.MEMORY_REVOKE)
+    blocks = _events_of(ctx, J.ADMISSION_BLOCK)
+    streamed = _events_of(ctx, J.FORCED_STREAMING)
+    if not (oom or revokes or blocks or streamed) \
+            and ctx.get("errorCode") not in ("EXCEEDED_MEMORY_LIMIT",
+                                             "ADMISSION_TIMEOUT"):
+        return None
+    parts = []
+    if oom:
+        parts.append("oom at reservation")
+    if revokes:
+        parts.append(f"{len(revokes)} revoke(s)")
+    if blocks:
+        parts.append("blocked in admission queue")
+    if streamed:
+        parts.append("fell back to tiled streaming")
+    if not parts:
+        parts.append("memory limit exceeded")
+    summary = "memory pressure: " + ", ".join(parts)
+    sev = J.ERROR if ctx.get("error") else J.WARN
+    return _finding("memory_pressure", sev, summary,
+                    oom + revokes + blocks + streamed)
+
+
+def _rule_straggler(ctx) -> Optional[Dict]:
+    flags = _events_of(ctx, J.STRAGGLER_FLAG)
+    hedges = _events_of(ctx, J.HEDGE)
+    if not flags and not hedges:
+        return None
+    parts = []
+    if flags:
+        worst = max(flags, key=lambda e: float(
+            (e.get("detail") or {}).get("wallS", 0.0) or 0.0))
+        d = worst.get("detail") or {}
+        parts.append(
+            "task %s straggled (%.2fs vs %.2fs median)"
+            % (worst.get("taskId") or d.get("task", "?"),
+               float(d.get("wallS", 0.0) or 0.0),
+               float(d.get("medianS", 0.0) or 0.0))
+        )
+    if hedges:
+        parts.append(f"{len(hedges)} hedge(s) dispatched")
+    return _finding("straggler", J.WARN, "; ".join(parts), flags + hedges)
+
+
+def _rule_spool_corruption(ctx) -> Optional[Dict]:
+    heals = _events_of(ctx, J.SPOOL_HEAL)
+    injected = _events_of(ctx, J.FAULT_INJECTED,
+                          sites=("spool_write_corrupt", "spool_read"))
+    if not heals and not injected:
+        return None
+    summary = "spool corruption"
+    if heals:
+        d = heals[0].get("detail") or {}
+        frag = d.get("fragment")
+        summary += (
+            f" on fragment {frag}" if frag is not None else ""
+        ) + " -> producer re-run, attempt healed"
+    return _finding("spool_corruption", J.WARN, summary, injected + heals)
+
+
+def _rule_cache_heal(ctx) -> Optional[Dict]:
+    heals = _events_of(ctx, J.CACHE_HEAL)
+    injected = _events_of(ctx, J.FAULT_INJECTED, sites=("cache_read",))
+    if not heals and not injected:
+        return None
+    return _finding(
+        "cache_corruption", J.WARN,
+        f"{len(heals) or len(injected)} corrupt spilled cache "
+        "frame(s) detected and healed (recomputed)",
+        injected + heals,
+    )
+
+
+def _rule_fusion_reject(ctx) -> Optional[Dict]:
+    rejects = _events_of(ctx, J.FUSION_REJECT)
+    prof = ctx.get("profile") or {}
+    if not rejects and not prof.get("fusionRejects"):
+        return None
+    reason = ""
+    if rejects:
+        reason = (rejects[0].get("detail") or {}).get("reason", "")
+    elif prof.get("lastFusionReject"):
+        reason = str(prof["lastFusionReject"])
+    summary = "megakernel fusion rejected -> unfused path"
+    if reason:
+        summary += f" ({reason[:120]})"
+    return _finding("fusion_reject", J.INFO, summary, rejects)
+
+
+def _rule_estimate_drift(ctx) -> Optional[Dict]:
+    injected = _events_of(ctx, J.FAULT_INJECTED, sites=("stats_estimate",))
+    drifted = []
+    for frame in (ctx.get("timeline") or {}).get("operators") or []:
+        est = float(frame.get("estimatedRows", 0.0) or 0.0)
+        obs = float(frame.get("outputRows", 0.0) or 0.0)
+        if est <= 0 or obs <= 0:
+            continue
+        ratio = max(est / obs, obs / est)
+        if ratio >= ESTIMATE_DRIFT_RATIO:
+            drifted.append((ratio, frame))
+    if not injected and not drifted:
+        return None
+    if drifted:
+        ratio, frame = max(drifted, key=lambda p: p[0])
+        summary = (
+            "estimate drift: %s estimated %.0f rows, observed %.0f "
+            "(%.1fx off)" % (
+                frame.get("operator", "?"),
+                float(frame.get("estimatedRows", 0.0) or 0.0),
+                float(frame.get("outputRows", 0.0) or 0.0),
+                ratio,
+            )
+        )
+    else:
+        d = injected[0].get("detail") or {}
+        summary = (
+            "estimate drift: seeded stats_estimate skewed %s"
+            % (d.get("key") or "a fragment estimate")
+        )
+    return _finding("estimate_drift", J.INFO, summary, injected)
+
+
+# precedence is the tentpole's mandated order: first hit is the root
+# cause; later hits still appear as secondary findings
+_RULES = (
+    _rule_device_fault,
+    _rule_memory_kill,
+    _rule_node_churn,
+    _rule_memory_pressure,
+    # corruption heals before straggler/hedge: a healed producer re-run
+    # is slow, so corruption routinely *causes* a straggler flag — the
+    # flag is the symptom, the corrupt frame is the cause
+    _rule_spool_corruption,
+    _rule_cache_heal,
+    _rule_straggler,
+    _rule_fusion_reject,
+    _rule_estimate_drift,
+)
+
+
+# -- diagnosis -----------------------------------------------------------
+
+
+def diagnose(
+    query_id: str,
+    events: List[Dict],
+    timeline: Optional[Dict] = None,
+    profile: Optional[Dict] = None,
+    flight_records: Optional[List[Dict]] = None,
+    error: Optional[str] = None,
+    error_code: Optional[str] = None,
+    wall_s: float = 0.0,
+) -> Dict:
+    """Run the ordered rule table over the evidence for one query.
+
+    ``events`` should already be scoped to the query (see
+    :func:`events_for_query` for the scoping policy)."""
+    ctx = {
+        "queryId": query_id,
+        "events": events or [],
+        "timeline": timeline,
+        "profile": profile,
+        "flight_records": flight_records,
+        "error": str(error) if error else "",
+        "errorCode": error_code if error_code is not None
+        else classify_error(error),
+    }
+    findings = []
+    for rule in _RULES:
+        try:
+            f = rule(ctx)
+        except Exception:  # noqa: BLE001 — a broken rule must not mask
+            continue       # the others (diagnosis is best-effort)
+        if f is not None:
+            findings.append(f)
+    if findings:
+        top = findings[0]
+        verdict, root, summary = ROOT_CAUSE, top["code"], top["summary"]
+        event_ids = top["eventIds"]
+    else:
+        verdict, root = HEALTHY, ""
+        summary = "no anomalous events correlated with this query"
+        event_ids = []
+    diagnosis = {
+        "queryId": query_id,
+        "verdict": verdict,
+        "rootCause": root,
+        "summary": summary,
+        "findings": findings,
+        "eventIds": event_ids,
+        "wallS": float(wall_s or 0.0),
+        "error": ctx["error"],
+        "errorCode": ctx["errorCode"],
+        "ts": time.time(),
+    }
+    from ..utils.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "trino_tpu_doctor_diagnoses_total",
+        "Query-doctor verdicts, by verdict class",
+    ).inc(verdict=verdict, cause=root or "none")
+    return diagnosis
+
+
+def events_for_query(
+    query_id: str,
+    events: Optional[List[Dict]] = None,
+    window: Optional[tuple] = None,
+    slack_s: float = 1.0,
+) -> List[Dict]:
+    """Scope journal events to one query: events tagged with its id,
+    plus ambient events (no queryId — fault-injector firings, node
+    churn) inside the query's wall-clock window.  Ambient attribution
+    is a heuristic; concurrent queries can share ambient events."""
+    if events is None:
+        events = J.get_journal().tail()
+    scoped, ambient = [], []
+    for e in events:
+        if e.get("queryId") == query_id:
+            scoped.append(e)
+        elif not e.get("queryId"):
+            ambient.append(e)
+    if window is None and scoped:
+        ts = [e.get("ts", 0.0) for e in scoped]
+        window = (min(ts), max(ts))
+    if window is not None:
+        t0, t1 = window
+        scoped += [
+            e for e in ambient
+            if t0 - slack_s <= e.get("ts", 0.0) <= t1 + slack_s
+        ]
+    scoped.sort(key=lambda e: (e.get("ts", 0.0), e.get("eventId", 0)))
+    return scoped
+
+
+def diagnose_query(
+    query_id: str,
+    window: Optional[tuple] = None,
+    timeline: Optional[Dict] = None,
+    profile: Optional[Dict] = None,
+    error: Optional[str] = None,
+    error_code: Optional[str] = None,
+    wall_s: float = 0.0,
+) -> Dict:
+    """Diagnose against the live process-global journal (query finalize)."""
+    events = events_for_query(query_id, window=window)
+    return diagnose(
+        query_id, events, timeline=timeline, profile=profile,
+        error=error, error_code=error_code, wall_s=wall_s,
+    )
+
+
+def diagnose_recent() -> Optional[Dict]:
+    """Diagnose the most recent query seen in the global journal — the
+    bench's crashed-config attach point, where no query object survives."""
+    events = J.get_journal().tail()
+    tagged = [e for e in events if e.get("queryId")]
+    if not tagged:
+        return None
+    qid = tagged[-1]["queryId"]
+    return diagnose(qid, events_for_query(qid, events=events))
+
+
+# -- offline reconstruction (kill -9 post-mortem) ------------------------
+
+
+def find_crashed_query(
+    events: List[Dict], history: Optional[List[Dict]] = None
+) -> Optional[str]:
+    """The newest journal queryId whose history record is absent or not
+    FINISHED — with no history at all, simply the newest queryId (the
+    crash took the history writer down with it)."""
+    finished = {
+        h.get("queryId") for h in (history or [])
+        if h.get("state") == "FINISHED"
+    }
+    for e in reversed(events):
+        qid = e.get("queryId")
+        if qid and qid not in finished:
+            return qid
+    return None
+
+
+def diagnose_from_dir(
+    journal_dir: str,
+    query_id: Optional[str] = None,
+    history_dir: Optional[str] = None,
+) -> Optional[Dict]:
+    """Reconstruct a verdict from persisted segments alone (the process
+    that wrote them is gone)."""
+    events = J.read_journal_dir(journal_dir)
+    if not events:
+        return None
+    history = None
+    error = error_code = None
+    if history_dir:
+        from .history import read_history_dir
+
+        history = read_history_dir(history_dir)
+    if query_id is None:
+        query_id = find_crashed_query(events, history)
+    if query_id is None:
+        return None
+    for h in history or []:
+        if h.get("queryId") == query_id:
+            error = h.get("error") or None
+            error_code = h.get("errorCode") or None
+    return diagnose(
+        query_id, events_for_query(query_id, events=events),
+        error=error, error_code=error_code,
+    )
+
+
+# -- rendering -----------------------------------------------------------
+
+
+def format_diagnosis(diagnosis: Optional[Dict]) -> str:
+    """The "Diagnosis" section of EXPLAIN ANALYZE / scripts/doctor.py."""
+    if not diagnosis:
+        return "Diagnosis:\n  (doctor disabled or no evidence)"
+    lines = ["Diagnosis:"]
+    if diagnosis.get("verdict") == HEALTHY:
+        lines.append(f"  HEALTHY — {diagnosis.get('summary', '')}")
+        return "\n".join(lines)
+    for i, f in enumerate(diagnosis.get("findings") or []):
+        label = "ROOT_CAUSE" if i == 0 else "      also"
+        cited = ",".join(str(x) for x in f.get("eventIds") or [])
+        cite = f" [events {cited}]" if cited else ""
+        lines.append(
+            f"  {label}: {f.get('code')} — {f.get('summary')}{cite}"
+        )
+    if diagnosis.get("errorCode"):
+        lines.append(
+            f"  error: {diagnosis['errorCode']}"
+            + (f" — {diagnosis['error'][:160]}"
+               if diagnosis.get("error") else "")
+        )
+    return "\n".join(lines)
+
+
+# -- process-global diagnosis registry (system.runtime.diagnoses) --------
+
+_DIAG_LOCK = threading.Lock()
+_DIAGNOSES: deque = deque(maxlen=256)
+
+
+def record_diagnosis(diagnosis: Dict):
+    with _DIAG_LOCK:
+        _DIAGNOSES.append(diagnosis)
+
+
+def recent_diagnoses() -> List[Dict]:
+    with _DIAG_LOCK:
+        return list(_DIAGNOSES)
+
+
+def _reset_diagnoses():
+    with _DIAG_LOCK:
+        _DIAGNOSES.clear()

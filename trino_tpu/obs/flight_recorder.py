@@ -259,6 +259,19 @@ class FlightRecorder:
             recs = list(self.tail_ring)
         return recs[-n:] if n else recs
 
+    def sync(self):
+        """Flush dirty segment pages to disk (drain/shutdown barrier).
+
+        The MAP_SHARED pages already survive process death without this;
+        sync() exists for the drain path, which promises that telemetry
+        is durable before the worker announces DRAINED."""
+        with self._lock:
+            for seg in self._segments:
+                try:
+                    seg.mm.flush()
+                except Exception:  # noqa: BLE001 — closed/readonly fs
+                    pass
+
     def close(self):
         with self._lock:
             for seg in self._segments:

@@ -39,6 +39,7 @@ HISTORY_FIELDS = (
     "rows",
     "wallS",
     "error",
+    "errorCode",
     "operators",
     "ts",
 )
@@ -193,6 +194,9 @@ class QueryHistoryStore:
             "rows": int(entry.get("rows") or 0),
             "wallS": float(entry.get("wall_s") or entry.get("wallS") or 0.0),
             "error": entry.get("error"),
+            "errorCode": (
+                entry.get("error_code") or entry.get("errorCode") or ""
+            ),
             "operators": entry.get("operators"),
             "ts": time.time(),
         }

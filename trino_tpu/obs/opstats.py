@@ -370,6 +370,15 @@ class StragglerDetector:
             "trino_tpu_straggler_hedge_total",
             "Dispersion-triggered FTE backup attempts launched",
         ).inc(stage=str(stage_id))
+        from . import journal
+
+        journal.emit(
+            journal.HEDGE,
+            query_id=str(task_id).split(".", 1)[0],
+            task_id=str(task_id), severity=journal.WARN,
+            stage=str(stage_id), uri=str(uri),
+            elapsedS=float(elapsed), medianS=action["medianS"],
+        )
         return action
 
     def observe_node_gone(
@@ -441,4 +450,15 @@ class StragglerDetector:
         if flagged:
             with self._lock:
                 self.flags.extend(flagged)
+            from . import journal
+
+            for flag in flagged:
+                journal.emit(
+                    journal.STRAGGLER_FLAG,
+                    query_id=flag["task"].split(".", 1)[0],
+                    task_id=flag["task"], node_id=flag["node"],
+                    severity=journal.WARN,
+                    stage=flag["stage"], wallS=flag["wallS"],
+                    medianS=flag["medianS"], score=flag["score"],
+                )
         return flagged

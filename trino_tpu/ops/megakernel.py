@@ -434,6 +434,13 @@ def try_fused(ctx, node: P.Aggregate):
         prof = ex.kernel_profile
         prof["fusionRejects"] = prof.get("fusionRejects", 0) + 1
         prof["lastFusionReject"] = str(r)
+        from ..obs import journal
+
+        journal.emit(
+            journal.FUSION_REJECT,
+            query_id=getattr(ex, "query_id", "") or "",
+            reason=str(r)[:200],
+        )
         return None
 
 

@@ -344,6 +344,7 @@ class DeviceSupervisor:
                cause: Optional[BaseException],
                device_id: int = 0) -> DeviceFaultError:
         now = time.time()
+        transition = None
         with self._lock:
             d = self._device(device_id)
             d.faults_total += 1
@@ -356,15 +357,36 @@ class DeviceSupervisor:
                     # N strikes inside the window: out for the process
                     # lifetime — no probe ever reinstates it
                     d.state = BLACKLISTED
+                    transition = BLACKLISTED
                 else:
                     d.state = QUARANTINED
+                    transition = QUARANTINED
                     d.probe_failures += 1
                     d.next_probe = now + self._backoff(d.probe_failures)
+            strikes = len(d.strikes)
         _counter(
             "trino_tpu_device_faults_total",
             "Device faults (loss/wedge) caught at the supervised "
             "dispatch boundary",
         ).inc(kind=kind, node=self.node_id)
+        from ..obs import journal
+
+        journal.emit(
+            journal.DEVICE_FAULT,
+            query_id=bc.query_id, task_id=bc.task_id,
+            node_id=self.node_id, severity=journal.ERROR,
+            kind=kind, kernel=bc.kernel, device=device_id,
+            cause=(f"{type(cause).__name__}: {cause}"[:200]
+                   if cause else ""),
+        )
+        if transition is not None:
+            journal.emit(
+                journal.DEVICE_QUARANTINE if transition == QUARANTINED
+                else journal.DEVICE_BLACKLIST,
+                query_id=bc.query_id, node_id=self.node_id,
+                severity=journal.ERROR,
+                device=device_id, strikes=strikes,
+            )
         self._publish_state()
         return DeviceFaultError(kind, bc, cause)
 
@@ -413,6 +435,13 @@ class DeviceSupervisor:
             else:
                 d.probe_failures += 1
                 d.next_probe = now + self._backoff(d.probe_failures)
+        if ok:
+            from ..obs import journal
+
+            journal.emit(
+                journal.DEVICE_RECOVERED, node_id=self.node_id,
+                device=device_id,
+            )
         self._publish_state()
         return ok
 
@@ -544,7 +573,7 @@ class DeviceSupervisor:
         return box.get("result")
 
     # -- degraded-mode bookkeeping --------------------------------------
-    def note_fallback_attempt(self):
+    def note_fallback_attempt(self, query_id: str = ""):
         with self._lock:
             self.fallback_attempted += 1
         _note_fallback("attempted")
@@ -552,6 +581,12 @@ class DeviceSupervisor:
             "trino_tpu_device_fallback_total",
             "Degraded-mode CPU re-executions after a device fault",
         ).inc(node=self.node_id)
+        from ..obs import journal
+
+        journal.emit(
+            journal.CPU_FALLBACK, query_id=query_id,
+            node_id=self.node_id, severity=journal.WARN,
+        )
 
     def note_fallback_completed(self):
         with self._lock:

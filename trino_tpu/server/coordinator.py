@@ -63,6 +63,7 @@ class QueryExecution:
         self.adaptive_actions: list = []  # FTE mid-query replan records
         self.task_stats: list = []  # per-task stats docs (TaskInfo rollup)
         self.timeline: Optional[dict] = None  # merged operator timeline
+        self.diagnosis: Optional[dict] = None  # doctor's finalize verdict
         self.straggler_flags: list = []  # dispersion-detector verdicts
         self.session_executed = False  # ran via session.execute (history
         #                                already recorded there)
@@ -305,6 +306,16 @@ class Coordinator:
             REGISTRY.counter(
                 "trino_tpu_query_failed_total", "Queries that reached FAILED"
             ).inc()
+            try:
+                from ..obs import doctor, journal
+
+                journal.emit(
+                    journal.QUERY_FAILED, query_id=q.query_id,
+                    severity=journal.ERROR, error=str(q.error)[:400],
+                    errorCode=doctor.classify_error(q.error),
+                )
+            except Exception:  # noqa: BLE001 — journaling is best-effort
+                pass
         finally:
             if admitted:
                 self.admission.release(q.query_id)
@@ -386,27 +397,43 @@ class Coordinator:
             )
             q.timeline = _opstats.timeline_from_tasks(tasks, detector=det)
             q.straggler_flags = list(q.straggler_flags or []) + det.flags
-        if q.session_executed:
-            return  # session.execute already recorded this query
-        store = get_store(
-            self.session.properties.get("query_history_dir") or None,
-            max_bytes=int(
-                self.session.properties.get("query_history_max_bytes")
-                or (1 << 20)
-            ),
-        )
-        store.put({
-            "query_id": q.query_id,
-            "state": q.state,
-            "sql": q.sql,
-            "user": q.user,
-            "created": q.created,
-            "finished": q.finished,
-            "rows": int(q.page.count) if q.page is not None else 0,
-            "wall_s": (q.finished or time.time()) - q.created,
-            "error": q.error,
-            "operators": (q.timeline or {}).get("operators") or None,
-        })
+        from ..obs import doctor
+
+        if not q.session_executed:
+            store = get_store(
+                self.session.properties.get("query_history_dir") or None,
+                max_bytes=int(
+                    self.session.properties.get("query_history_max_bytes")
+                    or (1 << 20)
+                ),
+            )
+            store.put({
+                "query_id": q.query_id,
+                "state": q.state,
+                "sql": q.sql,
+                "user": q.user,
+                "created": q.created,
+                "finished": q.finished,
+                "rows": int(q.page.count) if q.page is not None else 0,
+                "wall_s": (q.finished or time.time()) - q.created,
+                "error": q.error,
+                "error_code": doctor.classify_error(q.error),
+                "operators": (q.timeline or {}).get("operators") or None,
+            })
+        # the doctor's finalize pass: failed AND finished queries get a
+        # verdict (HEALTHY is itself a signal), served by
+        # GET /v1/query/{id}/diagnosis and system.runtime.diagnoses
+        if self.session.properties.get("query_doctor"):
+            finished = q.finished or time.time()
+            diag = doctor.diagnose_query(
+                q.query_id,
+                window=(q.created, finished),
+                timeline=q.timeline,
+                error=q.error,
+                wall_s=finished - q.created,
+            )
+            doctor.record_diagnosis(diag)
+            q.diagnosis = diag
 
     def _plan_is_coordinator_only(self, plan) -> bool:
         """True when the plan scans a connector marked coordinator_only
@@ -943,6 +970,39 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, {"error": "query not found"})
                 return
             self._json(200, co.query_profile(q))
+            return
+        if (
+            len(parts) == 4
+            and parts[:2] == ["v1", "query"]
+            and parts[3] == "events"
+        ):
+            # journal events correlated with this query (tagged + ambient
+            # within its wall-clock window)
+            q = co.queries.get(parts[2])
+            if q is None:
+                self._json(404, {"error": "query not found"})
+                return
+            from ..obs import doctor
+
+            events = doctor.events_for_query(
+                q.query_id,
+                window=(q.created, q.finished or time.time()),
+            )
+            self._json(200, {"queryId": q.query_id, "events": events})
+            return
+        if (
+            len(parts) == 4
+            and parts[:2] == ["v1", "query"]
+            and parts[3] == "diagnosis"
+        ):
+            q = co.queries.get(parts[2])
+            if q is None:
+                self._json(404, {"error": "query not found"})
+                return
+            self._json(200, {
+                "queryId": q.query_id,
+                "diagnosis": q.diagnosis,
+            })
             return
         if len(parts) == 3 and parts[:2] == ["v1", "query"]:
             q = co.queries.get(parts[2])

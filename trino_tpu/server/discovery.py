@@ -118,6 +118,24 @@ class NodeManager:
                 "trino_tpu_node_gone_total",
                 "Nodes declared GONE after the suspicion window",
             ).inc()
+        from ..obs import journal
+
+        if state == ACTIVE:
+            if prev == GONE:
+                journal.emit(journal.NODE_REJOIN, node_id=n.node_id)
+        else:
+            journal.emit(
+                {
+                    SUSPECT: journal.NODE_SUSPECT,
+                    DRAINING: journal.NODE_DRAINING,
+                    DRAINED: journal.NODE_DRAINED,
+                    GONE: journal.NODE_GONE,
+                }[state],
+                node_id=n.node_id,
+                severity=journal.ERROR if state == GONE
+                else journal.WARN if state == SUSPECT else journal.INFO,
+                prev=prev,
+            )
         return (n.node_id, n.uri, prev, state)
 
     def _fire(self, events):

@@ -803,6 +803,15 @@ class FaultTolerantScheduler:
                 "trino_tpu_scheduler_retry_total",
                 "Task attempts beyond the first (failover, backup, heal)",
             ).inc()
+            from ..obs import journal
+
+            journal.emit(
+                journal.FTE_REASSIGN, query_id=query_id,
+                task_id=task_id, node_id=node_id,
+                severity=journal.WARN,
+                attempt=attempt, uri=uri,
+                excludedUri=str(exclude_uri or ""),
+            )
         return uri, task_id, sink
 
     def _abort_task(self, uri, task_id):
@@ -1042,6 +1051,15 @@ class FaultTolerantScheduler:
                 "corrupt_path": attempt_dir,
                 "healed_path": new_path,
             })
+            from ..obs import journal
+
+            journal.emit(
+                journal.SPOOL_HEAL, query_id=epoch_qid,
+                task_id=f"{epoch_qid}.{f.id}.{task_index}",
+                severity=journal.WARN,
+                fragment=f.id, corruptPath=attempt_dir,
+                healedPath=new_path,
+            )
             return True
 
     def _uri_gone(self, uri: str) -> bool:

@@ -664,9 +664,34 @@ class WorkerServer:
                 if active == 0:
                     break
                 time.sleep(0.05)
+            # telemetry durability barrier: DRAINED is a promise that
+            # this node's spans, dispatch ring, and incident journal are
+            # on disk — the operator terminates the process right after
+            self._flush_telemetry()
             self.state = "DRAINED"
 
         threading.Thread(target=drain, daemon=True).start()
+
+    def _flush_telemetry(self):
+        """Flush every telemetry sink before advertising DRAINED (or
+        shutting the listener down): exporter-buffered spans, the
+        flight-recorder mmap ring, and the incident-journal segments."""
+        try:
+            TRACER.flush()
+        except Exception:  # noqa: BLE001 — flush must not block a drain
+            pass
+        try:
+            rec = getattr(self.supervisor, "flight_recorder", None)
+            if rec is not None:
+                rec.sync()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            from ..obs import journal
+
+            journal.sync()
+        except Exception:  # noqa: BLE001
+            pass
 
     def start_graceful_shutdown(self):
         """PUT /v1/info/state SHUTTING_DOWN: drain then stop (the
@@ -686,6 +711,7 @@ class WorkerServer:
                 if active == 0:
                     break
                 time.sleep(0.05)
+            self._flush_telemetry()
             self.httpd.shutdown()
 
         threading.Thread(target=drain, daemon=True).start()

@@ -100,6 +100,22 @@ class Session:
                 or (1 << 20)
             ),
         )
+        # engine-wide incident journal (obs/journal.py): process-global,
+        # memory-only until a directory upgrades it to the crash-safe
+        # mmap'd segment store that scripts/doctor.py reads post-mortem
+        from .obs import journal as _journal
+
+        if self.properties.get("event_journal_dir"):
+            _journal.configure(
+                self.properties.get("event_journal_dir"),
+                max_bytes=int(
+                    self.properties.get("event_journal_max_bytes")
+                    or (1 << 20)
+                ),
+            )
+        # ranked root-cause verdict of the most recent doctored query
+        # (bench.py attaches it to slow configs)
+        self.last_diagnosis: Optional[dict] = None
         # operator timeline of the last instrumented execution (EXPLAIN
         # ANALYZE / operator_stats=true), backing
         # system.runtime.operator_stats
@@ -171,6 +187,7 @@ class Session:
                 "finished": r.get("finished"),
                 "rows": r.get("rows"),
                 "error": r.get("error"),
+                "error_code": r.get("errorCode"),
             })
         return out
 
@@ -299,22 +316,64 @@ class Session:
             if tl and tl.get("queryId") == query_id:
                 entry["operators"] = tl.get("operators")
             self.history.put(entry)
+            self._finalize_doctor(query_id, created)
             return page
         except Exception as e:
+            from .obs.doctor import classify_error
+
             self.events.query_completed(
                 query_id, sql, "FAILED", created, error=str(e)
             )
             entry.update(
                 state="FAILED", finished=time.time(),
-                error=str(e), wall_s=time.time() - created,
+                error=str(e), error_code=classify_error(e),
+                wall_s=time.time() - created,
             )
             self.history.put(entry)
+            try:
+                from .obs import journal
+
+                journal.emit(
+                    journal.QUERY_FAILED, query_id=query_id,
+                    severity=journal.ERROR, error=str(e)[:400],
+                    errorCode=classify_error(e),
+                )
+            except Exception:  # noqa: BLE001 — journaling is best-effort
+                pass
+            self._finalize_doctor(query_id, created, error=e)
             raise
         finally:
             # batch-export completed spans on EVERY completion path —
             # success, failure, and non-Query statements alike (no-op
             # without an attached OTLP exporter)
             self.tracer.flush()
+
+    def _finalize_doctor(self, query_id: str, created: float,
+                         error=None):
+        """Query-finalize doctor pass (query_doctor session property):
+        correlate the incident journal, operator timeline, and kernel
+        profile into a ranked verdict.  Observability must never fail
+        (or re-fail) the query, so everything is best-effort."""
+        try:
+            if not self.properties.get("query_doctor"):
+                return
+            from .obs import doctor
+
+            now = time.time()
+            tl = self.last_timeline
+            diag = doctor.diagnose_query(
+                query_id,
+                window=(created, now),
+                timeline=tl if (tl or {}).get("queryId") == query_id
+                else None,
+                profile=getattr(self, "last_kernel_profile", None),
+                error=error,
+                wall_s=now - created,
+            )
+            doctor.record_diagnosis(diag)
+            self.last_diagnosis = diag
+        except Exception:  # noqa: BLE001
+            pass
 
     def _execute_statement(self, stmt, sql: str, query_id: str,
                            identity=None) -> Page:
@@ -728,6 +787,10 @@ class Session:
             if page is not None:
                 return page
         executor = self._executor()
+        # journal/flight-recorder correlation: breadcrumbs and incident
+        # events this execution emits carry the real query id, not the
+        # executor's generic "query" placeholder
+        executor.query_id = query_id
         with self.tracer.span("execute", query_id=query_id):
             _t0 = time.time()
             page = executor.execute(plan)
@@ -858,6 +921,7 @@ class Session:
             },
         )
         t0 = time.perf_counter()
+        t_created = time.time()  # wall-clock window for the doctor
         page = executor.execute(plan)
         wall = time.perf_counter() - t0
         self.last_kernel_profile = getattr(executor, "kernel_profile", None)
@@ -928,6 +992,24 @@ class Session:
                     f"inter {e['intermediateBytes']}B over "
                     f"{e['deviceWallS'] * 1000:.2f}ms device wall"
                 )
+        # the doctor's causal verdict over the same evidence (EXPLAIN
+        # ANALYZE is the interactive "why was this slow" surface)
+        if self.properties.get("query_doctor"):
+            try:
+                from .obs import doctor
+
+                diag = doctor.diagnose_query(
+                    query_id,
+                    window=(t_created, time.time()),
+                    timeline=self.last_timeline,
+                    profile=prof,
+                    wall_s=wall,
+                )
+                doctor.record_diagnosis(diag)
+                self.last_diagnosis = diag
+                text += "\n\n" + doctor.format_diagnosis(diag)
+            except Exception:  # noqa: BLE001 — diagnosis is best-effort
+                pass
         col = column_from_pylist(T.VARCHAR, text.split("\n"))
         return Page([col], len(text.split("\n")), ["Query Plan"])
 

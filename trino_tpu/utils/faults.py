@@ -125,6 +125,15 @@ class FaultInjector:
                     "trino_tpu_fault_injected_total",
                     "Chaos-harness fault firings by injection site",
                 ).inc(site=site)
+                # journaled BEFORE the fault takes effect: even a
+                # worker_death hard-exit leaves the firing on record
+                # (the mmap'd page survives os._exit)
+                from ..obs import journal
+
+                journal.emit(
+                    journal.FAULT_INJECTED, severity=journal.WARN,
+                    site=site, key=str(key or "")[:200],
+                )
             return fired
 
     def fired_count(self, site: str) -> int:

@@ -42,6 +42,8 @@ METRIC_SUBSYSTEMS = (
     "device",
     "straggler",
     "node",
+    "journal",
+    "doctor",
 )
 
 METRIC_NAME_RE = re.compile(
