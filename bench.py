@@ -100,8 +100,40 @@ def _chaos_churn() -> bool:
     return "--chaos-churn" in sys.argv[1:]
 
 
+def _mesh_sizes() -> tuple:
+    """--mesh[=1,2,4,8] (also BENCH_MESH=1,2,4,8).
+
+    Opt-in mesh-scaling axis: run the fused Q6 plan distributed over n
+    mesh devices for each listed n (plus one unfused run at the widest
+    mesh for the fusion delta), recording per-shard effective GB/s.  On
+    the CPU backend this forces virtual host devices for the whole
+    process, so it is off by default.
+    """
+    spec = os.environ.get("BENCH_MESH", "")
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--mesh":
+            spec = (
+                argv[i + 1]
+                if i + 1 < len(argv) and argv[i + 1][:1].isdigit()
+                else "1,2,4,8"
+            )
+        elif a.startswith("--mesh="):
+            spec = a.split("=", 1)[1]
+    if not spec:
+        return ()
+    try:
+        sizes = sorted({int(x) for x in spec.split(",") if x.strip()})
+    except ValueError:
+        raise SystemExit(
+            f"--mesh takes a CSV of device counts, got {spec!r}"
+        )
+    return tuple(n for n in sizes if n >= 1)
+
+
 CACHE_MODE = _cache_mode()
 CHAOS_CHURN = _chaos_churn()
+MESH_SIZES = _mesh_sizes()
 CACHE_PROPS = {
     "off": {"result_cache": False, "compile_cache": False,
             "scan_cache_enabled": False},
@@ -830,6 +862,14 @@ def main():
     if os.environ.get("BENCH_CPU_PROBE") == "1":
         _run_probe()
         return
+    if MESH_SIZES:
+        # children (BENCH_ONLY subprocesses) must see the same axis, and
+        # the CPU backend needs the virtual devices BEFORE backend init
+        os.environ["BENCH_MESH"] = ",".join(str(n) for n in MESH_SIZES)
+        if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+            import trino_tpu
+
+            trino_tpu.force_cpu(max(8, max(MESH_SIZES)))
     import jax
 
     # persistent compilation cache: repeated runs (and the driver's run
@@ -1000,6 +1040,30 @@ def main():
             _drop_session(hs)
         return r
 
+    def _cfg_mesh(n, megak):
+        # mesh-scaling axis: the same fused Q6 plan shard-mapped over n
+        # devices; per-shard GB/s says whether widening the mesh keeps
+        # each chip fed or just slices one chip's bandwidth n ways
+        def run():
+            if n > len(jax.devices()):
+                return {
+                    "skipped": f"{len(jax.devices())} devices < mesh {n}"
+                }
+            s = tpch_session(
+                1.0, distributed=True, num_devices=n,
+                megakernels=megak, **CACHE_PROPS
+            )
+            r = _time_config(s, Q6, _table_rows(s, "lineitem"), iters)
+            r["mesh_devices"] = n
+            r["megakernels"] = megak
+            if r.get("effective_gbps"):
+                r["per_shard_gbps"] = round(r["effective_gbps"] / n, 2)
+            prof = getattr(s, "last_kernel_profile", None) or {}
+            r["mesh_shrinks"] = int(prof.get("meshShrinks", 0) or 0)
+            _drop_session(s)
+            return r
+        return run
+
     def _cfg_chaos_churn():
         # node-churn chaos (--chaos-churn): two in-process workers plus a
         # killable subprocess worker per round; kill -9 the subprocess
@@ -1085,6 +1149,15 @@ def main():
         # appended after the CPU filter: the churn config runs on any
         # backend when explicitly requested
         plan.append(("chaos_churn_sf0.01", _cfg_chaos_churn, 90, []))
+    if MESH_SIZES:
+        # appended after the CPU filter too: the scaling axis is explicit
+        # opt-in on every backend (--mesh / BENCH_MESH)
+        for n in MESH_SIZES:
+            plan.append((f"mesh_q6_{n}dev", _cfg_mesh(n, "on"), 90, []))
+        widest = max(MESH_SIZES)
+        plan.append((
+            f"mesh_q6_{widest}dev_unfused", _cfg_mesh(widest, "off"), 90, []
+        ))
 
     only = os.environ.get("BENCH_ONLY")
     if only:
@@ -1182,6 +1255,59 @@ def main():
         state["vs_arrow_q6_sf1"] = round(
             anchors["q6_steady_s"] / q6_cfg["steady_s"], 2
         )
+
+    # mesh-scaling rollup (--mesh): narrow-vs-wide speedup, an upper
+    # bound on what the collectives cost, and the fusion delta at the
+    # widest mesh (scripts/bench_sentinel.py flags a wide mesh that
+    # stopped beating the single-device run)
+    if MESH_SIZES:
+        mesh = {}
+        for n in MESH_SIZES:
+            cfg = state["configs"].get(f"mesh_q6_{n}dev", {})
+            if isinstance(cfg, dict) and cfg.get("rows_per_sec"):
+                mesh[f"{n}dev"] = {
+                    "rows_per_sec": cfg["rows_per_sec"],
+                    "steady_s": cfg.get("steady_s"),
+                    "per_shard_gbps": cfg.get("per_shard_gbps"),
+                }
+        lo, hi = min(MESH_SIZES), max(MESH_SIZES)
+        a = state["configs"].get(f"mesh_q6_{lo}dev", {})
+        b = state["configs"].get(f"mesh_q6_{hi}dev", {})
+        if (
+            isinstance(a, dict) and isinstance(b, dict)
+            and a.get("rows_per_sec") and b.get("rows_per_sec")
+        ):
+            mesh["scaling"] = {
+                "from_devices": lo,
+                "to_devices": hi,
+                "speedup": round(
+                    b["rows_per_sec"] / a["rows_per_sec"], 3
+                ),
+            }
+            if a.get("steady_s") and b.get("steady_s"):
+                # wall the widest mesh loses against perfect linear
+                # scaling of the narrowest — an upper bound on the
+                # all-gather/all-to-all exchange cost (the two programs
+                # are identical except shard width and collectives)
+                mesh["scaling"]["collective_overhead_s"] = round(
+                    max(
+                        0.0,
+                        b["steady_s"] - a["steady_s"] * lo / hi,
+                    ),
+                    5,
+                )
+        u = state["configs"].get(f"mesh_q6_{hi}dev_unfused", {})
+        if (
+            isinstance(b, dict) and isinstance(u, dict)
+            and b.get("steady_s") and u.get("steady_s")
+        ):
+            mesh["fused_vs_unfused"] = {
+                "fused_s": b["steady_s"],
+                "unfused_s": u["steady_s"],
+                "speedup": round(u["steady_s"] / b["steady_s"], 3),
+            }
+        if mesh:
+            state["mesh_scaling"] = mesh
 
     # per-operator timeline of the slowest completed TPC-H config (BENCH
     # "operator_timeline"): one eager operator_stats pass at SF1 so a

@@ -22,6 +22,14 @@ round:
                       back, donation stopped, pages re-uploading); only
                       issued when both rounds carry per-config
                       effective_gbps data
+    mesh-scaling-regression
+                      within ONE round's --mesh axis, the widest mesh
+                      stopped beating the narrowest (geomean of
+                      widest/narrowest rows/s over every
+                      mesh_<q>_<n>dev family <= 1.0): collectives or
+                      skew now eat the added shards; advisory — it
+                      never fails the CI gate (CPU-proxy scaling is
+                      noisy)
     unknown           ran clean but shares no metric names with any
                       earlier round (nothing to diff)
 
@@ -49,6 +57,7 @@ from typing import Dict, List, Optional
 REGRESSION_RATIO = 0.70   # geomean throughput below this => regression
 IMPROVED_RATIO = 1.25     # ...above this => improved
 BW_REGRESSION_RATIO = 0.70  # effective GB/s below this while wall holds
+MESH_SCALING_RATIO = 1.00   # widest mesh must beat the narrowest outright
 
 # hard-crash signatures: runtime death, not ordinary query errors (a
 # compile HTTP 500 is a failure, but nobody's process died)
@@ -207,6 +216,26 @@ def _geomean_ratio(cur: Dict[str, float], prev: Dict[str, float]):
     return math.exp(sum(logs) / len(logs)), sorted(common)
 
 
+def _mesh_scaling_ratio(metrics: Dict[str, float]):
+    """Within-round mesh scaling: geomean over every ``mesh_<q>_<n>dev``
+    config family of (widest rows/s / narrowest rows/s).  None when the
+    round carries no mesh axis or only one width (the ``_unfused``
+    fusion-delta config deliberately does not match the pattern)."""
+    fams: Dict[str, Dict[int, float]] = {}
+    for name, val in metrics.items():
+        m = re.match(r"^mesh_(.+?)_(\d+)dev$", name)
+        if m and val > 0:
+            fams.setdefault(m.group(1), {})[int(m.group(2))] = val
+    ratios = []
+    for widths in fams.values():
+        if len(widths) < 2:
+            continue
+        ratios.append(widths[max(widths)] / widths[min(widths)])
+    if not ratios:
+        return None
+    return math.exp(sum(math.log(x) for x in ratios) / len(ratios))
+
+
 def judge(rounds: List[dict]) -> List[dict]:
     """One verdict per round, in trajectory order."""
     verdicts = []
@@ -299,6 +328,22 @@ def judge(rounds: List[dict]) -> List[dict]:
                                 "config(s) despite wall holding"
                                 % (bw_ratio, len(bw_common))
                             )
+        # within-round mesh-scaling check (--mesh axis): the widest mesh
+        # must beat the narrowest, or the added shards are pure overhead.
+        # Advisory: it annotates otherwise-healthy rounds but never
+        # joins the exit-1 set (CPU-proxy scaling is noisy)
+        mr = _mesh_scaling_ratio(r["metrics"]) if r["metrics"] else None
+        if mr is not None:
+            v["mesh_ratio"] = round(mr, 4)
+            if mr <= MESH_SCALING_RATIO and v["verdict"] in (
+                "steady", "improved", "baseline"
+            ):
+                v["verdict"] = "mesh-scaling-regression"
+                sep = "; " if v["reason"] else ""
+                v["reason"] += sep + (
+                    "widest mesh only x%.2f the narrowest — scaling "
+                    "collapsed" % mr
+                )
         verdicts.append(v)
     return verdicts
 
@@ -321,6 +366,7 @@ def to_markdown(verdicts: List[dict]) -> str:
         v for v in verdicts
         if v["verdict"] in (
             "regression", "crash-introduced", "bandwidth-regression",
+            "mesh-scaling-regression",
         )
     ]
     lines.append("")

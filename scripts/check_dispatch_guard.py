@@ -32,9 +32,13 @@ DISPATCH_RE = re.compile(
 OK_MARKER = "# dispatch-guard: ok"
 
 # only the layers that execute queries on devices; connectors build their
-# own jitted generators (pure data synthesis) and runtime/ IS the guard
+# own jitted generators (pure data synthesis) and runtime/ IS the guard.
+# parallel/ executes whole SPMD fragments on the mesh — a naked dispatch
+# there loses the breadcrumb exactly when forensics matter most (which
+# of eight devices died?), so it is guarded like exec/.
 SCAN_DIRS = (
     os.path.join("trino_tpu", "exec"),
+    os.path.join("trino_tpu", "parallel"),
     os.path.join("trino_tpu", "server"),
 )
 

@@ -2213,6 +2213,12 @@ class _TraceCtx:
             out[hs.output] = self._host_agg_lanes(hs, *host_src, cap)
         return self._finish_aggregate(node, keys_out, out, present, cap)
 
+    def _merge_fused_sums(self, sums):
+        """Fused-megakernel partial-sum merge seam: one device has
+        nothing to merge; the mesh trace context overrides this with a
+        cross-shard collective before the shared finalize tail."""
+        return sums
+
     def _finish_aggregate(self, node, keys_out, out, present, cap):
         """Shared aggregate tail (unfused and megakernel paths): merge
         key and output lanes, pad to the static 128-aligned capacity."""

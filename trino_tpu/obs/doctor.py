@@ -188,6 +188,34 @@ def _rule_node_churn(ctx) -> Optional[Dict]:
                     summary, deaths + churn)
 
 
+def _rule_mesh_shrink(ctx) -> Optional[Dict]:
+    """A quarantined device dropped out of the SPMD mesh and the query
+    finished on the healthy subset — slower (fewer shards), but a
+    query-level non-event.  Below node churn (a dead WORKER loses
+    spools and tasks; a shrunk mesh loses only parallelism), above
+    memory pressure (the shrink halves per-shard headroom, so it often
+    *causes* the memory symptoms)."""
+    shrinks = _events_of(ctx, J.MESH_SHRINK)
+    if not shrinks:
+        return None
+    devs = sorted({
+        str((e.get("detail") or {}).get("deviceId"))
+        for e in shrinks
+        if (e.get("detail") or {}).get("deviceId") is not None
+    })
+    last = shrinks[-1].get("detail") or {}
+    summary = "mesh shrank to the healthy subset"
+    if devs:
+        summary = (
+            "device(s) %s dropped out of the mesh" % ",".join(devs)
+        )
+    if last.get("fromSize") and last.get("toSize"):
+        summary += " (%s -> %s shards)" % (
+            last["fromSize"], last["toSize"]
+        )
+    return _finding("mesh_shrink", J.WARN, summary, shrinks)
+
+
 def _rule_memory_pressure(ctx) -> Optional[Dict]:
     oom = _events_of(ctx, J.FAULT_INJECTED, sites=("oom",))
     revokes = _events_of(ctx, J.MEMORY_REVOKE)
@@ -319,6 +347,7 @@ _RULES = (
     _rule_device_fault,
     _rule_memory_kill,
     _rule_node_churn,
+    _rule_mesh_shrink,
     _rule_memory_pressure,
     # corruption heals before straggler/hedge: a healed producer re-run
     # is slow, so corruption routinely *causes* a straggler flag — the

@@ -69,6 +69,7 @@ NODE_GONE = "node_gone"
 NODE_REJOIN = "node_rejoin"
 NODE_DRAINING = "node_draining"
 NODE_DRAINED = "node_drained"
+MESH_SHRINK = "mesh_shrink"
 FTE_REASSIGN = "fte_reassign"
 FUSION_REJECT = "fusion_reject"
 FORCED_STREAMING = "forced_streaming"

@@ -519,6 +519,12 @@ def _run(ctx, node: P.Aggregate):
         cols32, live, emit, n_terms, cap,
         interpret=not pk.enabled(),
     )
+    # mesh shard bodies: each device fused ITS split shard; the trace
+    # context merges the int64 (term, group) partials across the mesh
+    # before the shared finalize tail (identity on a single device).
+    # The SUM_GATE proof above bounds the TABLE-wide total, so the
+    # cross-shard sum of per-shard partials cannot wrap int64.
+    sums = ctx._merge_fused_sums(sums)
     cnt = sums[0]
 
     specs = [a.to_spec() for a in node.aggs]

@@ -117,6 +117,21 @@ def _unpack(lanes, n: int) -> jnp.ndarray:
     return jnp.stack(cols, axis=1)  # order: lane-major = register index
 
 
+def hll_pmax_merge(lanes, cap: int, axis) -> Dict[int, jnp.ndarray]:
+    """Cross-device HLL union as a register-wise max collective.
+
+    The packed int64 lanes are NOT pmax-mergeable as words — a max of
+    two packed words compares the 8-register concatenation
+    lexicographically, not each register (the HLL union is the
+    ELEMENTWISE register max, Flajolet et al.).  Unpack to [cap, 512]
+    int32 registers, pmax over the mesh axis, repack.  Must run inside
+    a shard_map program over `axis`."""
+    _guard_cap(cap, HLL_M)
+    regs = _unpack(lanes, cap)
+    regs = jax.lax.pmax(regs, axis)
+    return _pack(regs)
+
+
 def hll_merge(
     lanes, sel: jnp.ndarray, gid: jnp.ndarray, cap: int
 ) -> Dict[int, jnp.ndarray]:

@@ -24,6 +24,7 @@ places with AddExchanges (optimizations/AddExchanges.java:138).  Batch
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -44,12 +45,37 @@ from ..expr import ir
 from ..expr.lower import compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
+from ..ops import sketches
 from ..ops import sort as sort_ops
 from . import shuffle
 from ..page import Column, Page
 from ..plan import nodes as P
+from ..runtime import Breadcrumb, DeviceFaultError
 
 AXIS = "workers"
+
+
+def _is_hll_lane(spec, name: str) -> bool:
+    """True for the packed-register HLL accumulator lanes of
+    approx_distinct — the one sketched state a mesh collective CAN merge
+    (register-wise max); other sketched lanes (k-min-hash samples) still
+    need the gathered merge path."""
+    return spec.kind == "approx_distinct" and "$hll" in name
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map moved out of jax.experimental and renamed its
+    replication-check kwarg (check_rep -> check_vma) across jax
+    releases; resolve whichever this install provides."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
 
 
 def default_mesh(n: Optional[int] = None) -> Mesh:
@@ -104,10 +130,142 @@ class MeshExecutor(LocalExecutor):
                  config: Optional[dict] = None):
         super().__init__(catalogs, config)
         self.mesh = mesh or default_mesh()
+        # supervisor identity of each mesh position: default_mesh takes
+        # the first n jax devices, so position i IS supervisor device i
+        self._mesh_device_ids = list(range(self.mesh.devices.size))
+        self.mesh_tasks: List[dict] = []
 
     # ------------------------------------------------------------------
     def execute(self, plan: P.PlanNode) -> Page:
         assert isinstance(plan, P.Output)
+        sup = self.supervisor
+        if not self._device_fallback:
+            for d in list(self._mesh_device_ids):
+                sup.maybe_probe(device_id=d)
+            self._shrink_to_healthy()
+            if not any(
+                sup.healthy(device_id=d) for d in self._mesh_device_ids
+            ):
+                # every mesh device is out: same degrade/refuse gate as
+                # the single-device executor
+                bc = Breadcrumb(
+                    "mesh:%d/pre-dispatch" % self.mesh.devices.size,
+                    query_id=self.query_id,
+                    task_id=str(self.config.get("task_id") or ""),
+                    mode="gate",
+                )
+                fault = DeviceFaultError(
+                    "device_"
+                    + sup.device_state(
+                        device_id=self._mesh_device_ids[0]
+                    ).lower(),
+                    bc,
+                )
+                if not self._cpu_fallback_enabled():
+                    raise fault
+                return self._run_cpu_fallback(plan, fault)
+        try:
+            return self._execute_mesh(plan)
+        except DeviceFaultError as fault:
+            if self._device_fallback:
+                raise
+            # a device faulted mid-query and the supervisor quarantined
+            # it: shrink the mesh to the healthy subset and re-run there
+            # (fewer, larger shards) before degrading all the way to CPU
+            if self._shrink_to_healthy():
+                try:
+                    return self._execute_mesh(plan)
+                except DeviceFaultError:
+                    pass
+            if not self._cpu_fallback_enabled():
+                raise
+            return self._run_cpu_fallback(plan, fault)
+
+    # ------------------------------------------------------------------
+    def _shrink_to_healthy(self) -> bool:
+        """Drop quarantined/blacklisted devices from the mesh so the
+        query keeps executing over the healthy subset instead of
+        failing — a lost shard costs parallelism, not the query.
+        Returns True when the mesh changed (the caller then re-shards
+        scans over the smaller mesh)."""
+        sup = self.supervisor
+        ids = list(self._mesh_device_ids)
+        healthy = [d for d in ids if sup.healthy(device_id=d)]
+        if not healthy or len(healthy) == len(ids):
+            return False
+        from ..obs import journal
+
+        by_id = dict(zip(ids, list(self.mesh.devices.flat)))
+        for d in ids:
+            if d in healthy:
+                continue
+            journal.emit(
+                journal.MESH_SHRINK,
+                query_id=self.query_id,
+                severity=journal.WARN,
+                deviceId=d,
+                deviceState=sup.device_state(device_id=d),
+                fromSize=len(ids),
+                toSize=len(healthy),
+            )
+        self.kernel_profile["meshShrinks"] = (
+            self.kernel_profile.get("meshShrinks", 0)
+            + (len(ids) - len(healthy))
+        )
+        self.mesh = Mesh(np.array([by_id[d] for d in healthy]), (AXIS,))
+        self._mesh_device_ids = healthy
+        return True
+
+    # ------------------------------------------------------------------
+    def _run_cpu_fallback(self, plan: P.PlanNode, fault) -> Page:
+        # the SPMD program pins explicit mesh devices, so re-running it
+        # under jax.default_device would still target the faulted chips;
+        # degrade to the single-device executor's eager CPU path instead
+        local = LocalExecutor(self.catalogs, dict(self.config))
+        local.query_id = self.query_id
+        page = local._run_cpu_fallback(plan, fault)
+        self.kernel_profile.update(local.kernel_profile)
+        self.node_stats.update(getattr(local, "node_stats", {}) or {})
+        self.scan_bytes = getattr(local, "scan_bytes", self.scan_bytes)
+        return page
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, thunk, bc):
+        if self._device_fallback:
+            return thunk()
+        return self.supervisor.dispatch(
+            thunk, bc, device_id=self._mesh_device_ids[0]
+        )
+
+    def _device_get(self, objs, bc):
+        if self._device_fallback:
+            return jax.device_get(objs)  # dispatch-guard: ok
+        return self.supervisor.device_get(
+            objs, bc, device_id=self._mesh_device_ids[0]
+        )
+
+    def _record_kernel(self, digest, compile_s, cached, mode="jit"):
+        # every mesh-path kernel record carries the axis-size tag, so
+        # flight records, the bandwidth ledger, and bench profiles can
+        # tell 8-way from single-chip executions of the same plan
+        tag = "mesh:%d" % self.mesh.devices.size
+        if not str(digest).startswith("mesh:"):
+            digest = "%s/%s" % (tag, digest)
+        return super()._record_kernel(digest, compile_s, cached, mode=mode)
+
+    def _ledger_input_bytes(self, scans) -> int:
+        # mesh scan args are flat {sym: [ndev, cap]} ndarray dicts (the
+        # $ok validity plane is its own entry), not (value, ok) tuples
+        total = 0
+        for arrays in scans.values():
+            for v in arrays.values():
+                total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+
+    # ------------------------------------------------------------------
+    def _execute_mesh(self, plan: P.PlanNode) -> Page:
+        t_exec0 = time.perf_counter()
+        self.mesh_tasks = []
         ndev = self.mesh.devices.size
         scan_args, counts_args, dicts = self._load_sharded_scans(plan, ndev)
         self.dicts = dicts
@@ -151,15 +309,27 @@ class MeshExecutor(LocalExecutor):
                     ),
                 )
 
-            shard_fn = jax.shard_map(
-                fragment,
-                mesh=self.mesh,
-                in_specs=(P_(AXIS), P_(AXIS)),
-                out_specs=P_(),
-                check_vma=False,
+            shard_fn = _shard_map(
+                fragment, self.mesh, (P_(AXIS), P_(AXIS)), P_()
             )
-            (out_lanes, sel, checks, dups, colls, wides,
-             sflags) = jax.jit(shard_fn)(scan_args, counts_args)
+            digest = "mesh:%d/fragment-a%d" % (ndev, attempt)
+            compile_start = time.time()
+            bc = self._dispatch_crumb(digest, "mesh", scan_args)
+            self._last_crumb = bc
+            fn = jax.jit(shard_fn)  # dispatch-guard: ok (lazy wrapper)
+            led_t0 = time.perf_counter()
+            out = self._dispatch(lambda: fn(scan_args, counts_args), bc)
+            self._ledger_bracket(out, digest, "mesh", plan, scan_args,
+                                 led_t0)
+            self._record_kernel(
+                digest, compile_s=time.time() - compile_start,
+                cached=False, mode="mesh",
+            )
+            # one supervised transfer covers every retry-ladder check
+            (checks, dups, colls, wides, sflags) = self._device_get(
+                out[2:], self._dispatch_crumb(digest, "device_get")
+            )
+            out_lanes, sel = out[0], out[1]
             fell_back = False
             for (join_node, _), d in zip(ctx.dup_checks, dups):
                 if int(d) > 0:
@@ -207,7 +377,117 @@ class MeshExecutor(LocalExecutor):
         else:
             raise ExecutionError("group capacity overflow after retries")
 
-        return self._materialize(plan, out_lanes, sel, ctx.ordered_out)
+        # settle-time accounting: the local executor fills these during
+        # scan loading / profile finalize, neither of which runs on the
+        # mesh path — without them the bench reports 0 scan bytes and
+        # the per-shard GB/s satellite has nothing to divide
+        self.scan_bytes = self._ledger_input_bytes(scan_args)
+        led = self.bandwidth_ledger
+        if led is not None:
+            s = led.summary()
+            self.kernel_profile["bandwidth"] = led.entries()
+            self.kernel_profile.setdefault("summary", {}).update(
+                effectiveGbps=s["effectiveGbps"],
+                rooflinePct=s["rooflinePct"],
+                ledgerBytes=s["totalBytes"],
+                deviceWallS=s["deviceWallS"],
+                meshDevices=ndev,
+                perShardGbps=round(s["effectiveGbps"] / ndev, 6),
+            )
+
+        page = self._materialize(plan, out_lanes, sel, ctx.ordered_out)
+        if self.config.get("collect_node_stats"):
+            self._mesh_node_stats(
+                plan, scan_args, counts_args,
+                time.perf_counter() - t_exec0, ndev, page,
+            )
+        return page
+
+    # ------------------------------------------------------------------
+    def _mesh_node_stats(self, plan, scans, counts, wall_s, ndev, page):
+        """Post-execute operator/task stats for the SPMD program.
+
+        The eager per-node row probes cannot run inside shard_map (the
+        counts are traced there), so the mesh synthesizes its timeline
+        after the program settles: whole-plan node stats feeding
+        frames_from_plan, plus one task rollup PER SHARD so EXPLAIN
+        ANALYZE stage timelines and the straggler detector see shards.
+        Per-shard wall is not separately observable inside one lockstep
+        SPMD program; each shard's wall is scaled by its scan-row share
+        relative to the heaviest shard — the slowest shard sets the
+        program wall and lighter shards idle, which is exactly the data
+        skew the straggler detector should surface."""
+        from ..obs import opstats
+
+        shard_rows = np.zeros(ndev, dtype=np.int64)
+        total_rows = 0
+        total_bytes = 0
+
+        def walk(n):
+            nonlocal total_rows, total_bytes
+            if isinstance(n, P.TableScan):
+                cnts = counts.get(str(id(n)))
+                arrays = scans.get(str(id(n))) or {}
+                nbytes = sum(
+                    int(getattr(v, "nbytes", 0) or 0)
+                    for v in arrays.values()
+                )
+                rows = int(cnts.sum()) if cnts is not None else 0
+                total_rows += rows
+                total_bytes += nbytes
+                if cnts is not None:
+                    for d in range(min(ndev, len(cnts))):
+                        shard_rows[d] += int(cnts[d])
+                self.node_stats[id(n)] = {
+                    "rows": rows,
+                    "bytes": nbytes,
+                    "wall_s": 0.0,
+                    "device_wall_s": 0.0,
+                    "calls": ndev,
+                }
+            for s in n.sources:
+                walk(s)
+
+        walk(plan)
+        out_bytes = sum(
+            int(getattr(c.values, "nbytes", 0) or 0) for c in page.columns
+        )
+        # the fragment root carries the whole program wall (walls are
+        # inclusive; frames_from_plan subtracts child walls for own-wall)
+        self.node_stats[id(plan.source)] = {
+            "rows": int(page.count),
+            "bytes": out_bytes,
+            "wall_s": float(wall_s),
+            "device_wall_s": float(wall_s),
+            "calls": 1,
+        }
+        frames = opstats.frames_from_plan(plan, self.node_stats)
+        qid = self.query_id or "query"
+        heaviest = int(shard_rows.max()) if ndev else 0
+        total = int(shard_rows.sum())
+        tasks = []
+        for d in range(ndev):
+            frac = (int(shard_rows[d]) / heaviest) if heaviest else 1.0
+            share = (int(shard_rows[d]) / total) if total else 1.0 / ndev
+            fl = []
+            for f in frames:
+                g = dict(f)
+                for k in ("inputRows", "inputBytes", "outputRows",
+                          "outputBytes"):
+                    if k in g:
+                        g[k] = int((f.get(k) or 0) * share)
+                for k in ("wallS", "deviceWallS", "hostWallS"):
+                    if k in g:
+                        g[k] = float(f.get(k) or 0.0) * frac
+                fl.append(g)
+            tasks.append({
+                "taskId": "%s.0.%d" % (qid, d),
+                "nodeId": "device-%d" % self._mesh_device_ids[d],
+                "operatorStats": opstats.task_rollup(
+                    fl, wall_s=float(wall_s) * frac
+                ),
+            })
+        self.mesh_tasks = tasks
 
     # ------------------------------------------------------------------
     def _skew_shuffle_hints(self, plan, scans, counts, ndev):
@@ -458,6 +738,28 @@ class _MeshTraceCtx(_TraceCtx):
     def _note_collision(self, coll):
         self.collision_checks.append(jax.lax.pmax(coll, AXIS))
 
+    def visit(self, node: P.PlanNode) -> Batch:
+        # the eager per-node instrumentation concretizes row counts
+        # (int(jnp.sum(sel))), which is impossible while tracing inside
+        # shard_map — the executor synthesizes node stats and per-shard
+        # task rollups after the program settles (_mesh_node_stats)
+        m = getattr(self, f"_visit_{type(node).__name__.lower()}", None)
+        if m is None:
+            raise ExecutionError(f"no executor for {type(node).__name__}")
+        return m(node)
+
+    def _merge_fused_sums(self, sums):
+        """Megakernel shard bodies: merge the per-shard fused
+        (term, group) int64 partials across the mesh before the shared
+        finalize tail.  all_gather + local reduce rather than psum keeps
+        the exchange in the canonical all-gather/dynamic-slice HLO form;
+        exactness rides the megakernel's own SUM_GATE proof — the
+        TABLE-wide total clears the 2^62 gate, so the cross-shard sum of
+        per-shard partials cannot wrap int64."""
+        return jax.tree_util.tree_map(
+            lambda s: jnp.sum(jax.lax.all_gather(s, AXIS), axis=0), sums
+        )
+
     # -- leaves ---------------------------------------------------------
     def _visit_tablescan(self, node: P.TableScan) -> Batch:
         arrays = self.scans[str(id(node))]
@@ -482,21 +784,53 @@ class _MeshTraceCtx(_TraceCtx):
 
     # -- aggregation -----------------------------------------------------
     def _visit_aggregate(self, node: P.Aggregate) -> Batch:
+        if node.step in ("single", "partial"):
+            from ..ops import megakernel
+
+            fused = megakernel.try_fused(self, node)
+            if fused is not None:
+                # each shard ran the fused kernel over its own split; the
+                # _merge_fused_sums collective already made the finished
+                # accumulators identical on every device
+                return Batch(
+                    fused.lanes, fused.sel, fused.ordered, replicated=True
+                )
         b = self.visit(node.source)
-        psum_able = all(
-            s.psum_kind(n) is not None
-            for a in node.aggs
-            for s in (a.to_spec(),)
+        all_specs = [a.to_spec() for a in node.aggs]
+        collective_able = all(
+            s.psum_kind(n) is not None or _is_hll_lane(s, n)
+            for s in all_specs
             for n in s.accumulator_names
         )
+        hll = any(
+            _is_hll_lane(s, n)
+            for s in all_specs
+            for n in s.accumulator_names
+        )
+        # strictly psum-able: the global fast path (1-row accumulators)
+        psum_able = collective_able and not hll
+        raw_needed = any(
+            a.distinct or not a.partializable for a in node.aggs
+        )
+        if not b.replicated and raw_needed and node.keys:
+            # grouped DISTINCT / non-decomposable aggregates: FIXED_HASH
+            # exchange on the GROUP BY keys co-locates each group's raw
+            # rows, then every device aggregates its own hash range
+            # exactly — the count(DISTINCT)-beyond-memory path.  The old
+            # gathering exchange replicated the ENTIRE input into every
+            # device; here no device ever holds more than its hash range
+            # (plus skew slack, backstopped by the capacity ladder).
+            b = self._hash_repartition(b, tuple(node.keys))
+            out = _TraceCtx._visit_aggregate(self, node, b)
+            return Batch(out.lanes, out.sel, out.ordered, replicated=False)
         if not b.replicated and (
-            any(a.distinct or not a.partializable for a in node.aggs)
-            or (not psum_able and not node.keys)
+            raw_needed or (not psum_able and not node.keys)
         ):
-            # DISTINCT and non-decomposable aggregates (approx_percentile,
-            # approx_distinct) need the raw rows in one place — and global
-            # aggregates whose accumulators no collective can merge
-            # (min_by/bitwise/arbitrary) need a gather instead of psum.
+            # global DISTINCT / non-decomposable aggregates need the raw
+            # rows in one place (a gathered approx_distinct even stays
+            # EXACT: the single-step path counts, it never sketches) —
+            # and global aggregates whose accumulators no collective can
+            # merge (min_by/bitwise/arbitrary) gather instead of psum.
             b = _gather_batch(b)
         if b.replicated:
             out = _TraceCtx._visit_aggregate(self, node, b)
@@ -526,10 +860,15 @@ class _MeshTraceCtx(_TraceCtx):
 
         key_lanes = [b.lanes[k] for k in node.keys]
         domains = self._direct_domains(node.keys, types)
-        if domains is not None and psum_able:
+        if domains is not None and collective_able:
             gid, cap = agg_ops.direct_group_ids(key_lanes, domains)
             accs = agg_ops.accumulate(
                 specs, b.lanes, gid, b.sel, cap,
+                # sketched approx_distinct must emit its mergeable HLL
+                # register lanes here (the single-step shortcut is an
+                # exact per-shard count, which cannot merge across
+                # shards); plain accumulators are step-invariant
+                step="partial" if hll else "single",
                 overflow_flags=self.sum_overflow,
                 wide_flags=self.lowering.overflow_flags,
                 force_wide=self.lowering.force_wide_mul,
@@ -616,13 +955,28 @@ class _MeshTraceCtx(_TraceCtx):
 
     def _psum_accs(self, specs, accs):
         """Cross-device accumulator merge by collective; callers must have
-        checked psum_kind != None for every accumulator first.  int64 sum
-        accumulators get an f64 shadow psum so a cross-device wrap (each
-        shard under the threshold, total beyond int64) fails loudly."""
+        checked psum_kind != None (or the HLL-lane exception) for every
+        accumulator first.  int64 sum accumulators get an f64 shadow psum
+        so a cross-device wrap (each shard under the threshold, total
+        beyond int64) fails loudly."""
         out = {}
         ops = {"sum": jax.lax.psum, "min": jax.lax.pmin, "max": jax.lax.pmax}
         for s in specs:
+            hll_names = [
+                n for n in s.accumulator_names if _is_hll_lane(s, n)
+            ]
+            if hll_names:
+                # HLL sketches union by ELEMENTWISE register max — a max
+                # of the packed int64 words would compare the 8-register
+                # concatenation lexicographically, which is wrong
+                cap = accs[hll_names[0]].shape[0]
+                lanes = {i: accs[n] for i, n in enumerate(hll_names)}
+                merged = sketches.hll_pmax_merge(lanes, cap, AXIS)
+                for i, n in enumerate(hll_names):
+                    out[n] = merged[i]
             for name in s.accumulator_names:
+                if _is_hll_lane(s, name):
+                    continue
                 kind = s.psum_kind(name)
                 out[name] = ops[kind](accs[name], AXIS)
                 if (

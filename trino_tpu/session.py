@@ -818,6 +818,22 @@ class Session:
                     ),
                 ),
             }
+            if getattr(executor, "mesh_tasks", None):
+                # mesh execution: per-shard task rollups become stage
+                # timelines, and the straggler detector sees shards the
+                # way it sees worker tasks (row-skew apportioned wall)
+                det = _opstats.StragglerDetector(
+                    factor=float(
+                        self.properties.get("straggler_dispersion_factor")
+                        or 2.0
+                    )
+                )
+                mesh_tl = _opstats.timeline_from_tasks(
+                    executor.mesh_tasks, detector=det
+                )
+                self.last_timeline["stages"] = mesh_tl["stages"]
+                if det.flags:
+                    self.last_timeline["stragglers"] = det.flags
         if rkey is not None:
             self.store_result(rkey, page, plan)
         if not isinstance(stmt, ast.Query):
