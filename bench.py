@@ -100,6 +100,25 @@ def _chaos_churn() -> bool:
     return "--chaos-churn" in sys.argv[1:]
 
 
+def _serve_mode() -> str:
+    """--serve / --serve-smoke (also BENCH_SERVE=1|smoke).
+
+    Opt-in closed-loop serving bench: a distributed cluster fronted by
+    weighted-fair resource groups takes sustained mixed TPC-H + point-
+    lookup traffic from several tenants; records per-tenant latency
+    percentiles, shed counts, fairness under a 10x tenant flood, and
+    autoscaler scale events.  ``smoke`` is the ~30s CI variant: two
+    tenants, tiny QPS, zero tolerated failures.  Off by default — it
+    measures serving behavior, not scan speed.
+    """
+    env = os.environ.get("BENCH_SERVE", "")
+    if "--serve-smoke" in sys.argv[1:] or env == "smoke":
+        return "smoke"
+    if "--serve" in sys.argv[1:] or env == "1":
+        return "full"
+    return ""
+
+
 def _mesh_sizes() -> tuple:
     """--mesh[=1,2,4,8] (also BENCH_MESH=1,2,4,8).
 
@@ -133,6 +152,7 @@ def _mesh_sizes() -> tuple:
 
 CACHE_MODE = _cache_mode()
 CHAOS_CHURN = _chaos_churn()
+SERVE_MODE = _serve_mode()
 MESH_SIZES = _mesh_sizes()
 CACHE_PROPS = {
     "off": {"result_cache": False, "compile_cache": False,
@@ -1112,6 +1132,265 @@ def main():
             "wall_s": round(time.perf_counter() - t0, 1),
         }
 
+    def _cfg_serve():
+        # closed-loop multi-tenant serving bench (--serve / --serve-smoke):
+        # a weighted-fair resource-group tree fronts a distributed cluster
+        # taking sustained mixed point-lookup + TPC-H traffic from several
+        # tenants.  Full mode adds a fairness chaos phase (the lowest-
+        # weight tenant floods 10x its steady session count — the well-
+        # behaved tenants' p99 must stay bounded and shed-free) and the
+        # autoscaler (scale events land in this config's record).
+        import threading
+
+        from trino_tpu.client.client import StatementClient
+        from trino_tpu.testing.runner import DistributedQueryRunner
+
+        smoke = SERVE_MODE == "smoke"
+        scale = int(os.environ.get(
+            "BENCH_SERVE_SESSIONS", "1" if (smoke or not on_tpu) else "8"
+        ))
+        steady_s = float(os.environ.get(
+            "BENCH_SERVE_S", "8" if smoke else "12"
+        ))
+        flood_s = 0.0 if smoke else steady_s
+
+        point_sqls = [
+            "select l_extendedprice, l_discount from lineitem "
+            f"where l_orderkey = {k}" for k in (1, 3, 32, 69, 227)
+        ]
+        agg_sqls = [
+            "select count(*), sum(l_extendedprice * l_discount) "
+            f"from lineitem where l_discount between 0.0{d} and 0.0{d + 2} "
+            "and l_quantity < 24" for d in (2, 4, 6)
+        ]
+        batch_sqls = [
+            "select l_returnflag, l_linestatus, count(*), sum(l_quantity),"
+            " avg(l_extendedprice) from lineitem "
+            f"where l_shipdate is not null and l_quantity > {q} "
+            "group by l_returnflag, l_linestatus"
+            for q in (0, 10, 20)
+        ]
+
+        # (tenant, weight, sessions, think_s, workload)
+        tenants = [
+            ("interactive", 4, 6 * scale, 0.05 if smoke else 0.0,
+             point_sqls),
+            ("batch", 2, 3 * scale, 0.05 if smoke else 0.0, batch_sqls),
+        ]
+        if not smoke:
+            tenants.append(("adhoc", 1, 3 * scale, 0.0,
+                            agg_sqls + point_sqls))
+        sub_groups = []
+        selectors = []
+        for name, weight, _n, _think, _w in tenants:
+            spec = {
+                "name": name,
+                "schedulingWeight": weight,
+                "hardConcurrencyLimit": 2 + 2 * weight,
+                "maxQueued": 50 * weight if not smoke else 500,
+                "memoryShare": round(weight / 8.0, 3),
+            }
+            if name == "adhoc":
+                # the floodable tenant sheds instead of queueing forever
+                spec["maxQueued"] = 24
+                spec["queueDeadlineS"] = 1.5
+            sub_groups.append(spec)
+            selectors.append({"user": name, "group": f"serve.{name}"})
+        resource_groups = {
+            "groups": [{
+                "name": "serve",
+                "hardConcurrencyLimit": 10,
+                "maxQueued": 1000,
+                "schedulingPolicy": "weighted_fair",
+                "queueDeadlineS": 0.0 if smoke else 10.0,
+                "subGroups": sub_groups,
+            }],
+            "selectors": selectors,
+        }
+
+        samples = []  # (tenant, phase, latency_ms, outcome) — append-only
+        error_samples = []  # first few distinct unexpected failures
+        stop_evt = threading.Event()
+        phase_ref = {"phase": "steady"}
+
+        def classify(msg: str) -> str:
+            if (
+                "ADMISSION_TIMEOUT" in msg
+                or "shed after" in msg
+                or "memory admission queue" in msg
+            ):
+                return "shed"
+            if "QUERY_QUEUE_FULL" in msg or "Too many queued" in msg:
+                return "rejected"
+            if len(error_samples) < 5 and msg[:120] not in error_samples:
+                error_samples.append(msg[:120])
+            return "failed"
+
+        def loop(uri, tenant, sqls, think):
+            client = StatementClient(uri, user=tenant, source="bench-serve")
+            i = 0
+            while not stop_evt.is_set():
+                sql = sqls[i % len(sqls)]
+                i += 1
+                ph = phase_ref["phase"]
+                t0 = time.perf_counter()
+                try:
+                    client.execute(sql)
+                    outcome = "ok"
+                except Exception as e:  # noqa: BLE001 — outcome recorded
+                    outcome = classify(str(e))
+                samples.append(
+                    (tenant, ph, (time.perf_counter() - t0) * 1e3, outcome)
+                )
+                if think:
+                    time.sleep(think)
+
+        t_run = time.perf_counter()
+        with DistributedQueryRunner(
+            workers=1 if not smoke else 2,
+            catalogs=(("tpch", "tpch", {"tpch.scale-factor": 0.01}),),
+            properties=dict(CACHE_PROPS),
+            resource_groups=resource_groups,
+        ) as runner:
+            scaler = None
+            if not smoke:
+                scaler = runner.enable_autoscaler(
+                    min_workers=1, max_workers=3, backlog_high=6,
+                )
+            uri = runner.coordinator.uri
+            threads = []
+            for name, _w, n, think, sqls in tenants:
+                for _ in range(n):
+                    t = threading.Thread(
+                        target=loop, args=(uri, name, sqls, think),
+                        daemon=True,
+                    )
+                    t.start()
+                    threads.append(t)
+            time.sleep(steady_s)
+            if flood_s:
+                # fairness chaos: adhoc floods 10x its steady sessions
+                phase_ref["phase"] = "flood"
+                _, _, n_adhoc, _, adhoc_sqls = tenants[-1]
+                for _ in range(9 * n_adhoc):
+                    t = threading.Thread(
+                        target=loop, args=(uri, "adhoc", adhoc_sqls, 0.0),
+                        daemon=True,
+                    )
+                    t.start()
+                    threads.append(t)
+                time.sleep(flood_s)
+            stop_evt.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            group_stats = (
+                runner.coordinator.coordinator.resource_groups.info()
+            )
+            scale_events = scaler.stats()["events"] if scaler else []
+            workers_final = runner.alive_workers()
+        wall = time.perf_counter() - t_run
+
+        def pctl(lats, q):
+            if not lats:
+                return None
+            xs = sorted(lats)
+            return round(xs[min(len(xs) - 1, int(q * len(xs)))], 1)
+
+        duration = steady_s + flood_s
+        per_tenant = {}
+        for name, weight, n, _think, _w in tenants:
+            mine = [s for s in samples if s[0] == name]
+            oks = [s[2] for s in mine if s[3] == "ok"]
+            per_tenant[name] = {
+                "weight": weight,
+                "sessions": n,
+                "requests": len(mine),
+                "ok": len(oks),
+                "shed": sum(1 for s in mine if s[3] == "shed"),
+                "rejected": sum(1 for s in mine if s[3] == "rejected"),
+                "failed": sum(1 for s in mine if s[3] == "failed"),
+                "qps": round(len(oks) / duration, 1),
+                "p50_ms": pctl(oks, 0.50),
+                "p95_ms": pctl(oks, 0.95),
+                "p99_ms": pctl(oks, 0.99),
+            }
+        result = {
+            "mode": SERVE_MODE,
+            "duration_s": round(duration, 1),
+            "wall_s": round(wall, 1),
+            "sessions_total": (
+                sum(n for _, _, n, _, _ in tenants)
+                + (9 * tenants[-1][2] if flood_s else 0)
+            ),
+            "qps": round(
+                sum(t["ok"] for t in per_tenant.values()) / duration, 1
+            ),
+            "tenants": per_tenant,
+            "shed_total": sum(t["shed"] for t in per_tenant.values()),
+            "rejected_total": sum(
+                t["rejected"] for t in per_tenant.values()
+            ),
+            "failed_queries": sum(
+                t["failed"] for t in per_tenant.values()
+            ),
+            "error_samples": error_samples,
+            "scale_events": scale_events,
+            "workers_final": workers_final,
+            "groups": group_stats,
+        }
+        if flood_s:
+            vic = [s for s in samples if s[0] == "interactive"]
+            vic_steady = [s[2] for s in vic
+                          if s[1] == "steady" and s[3] == "ok"]
+            vic_flood = [s[2] for s in vic
+                         if s[1] == "flood" and s[3] == "ok"]
+            p99_s, p99_f = pctl(vic_steady, 0.99), pctl(vic_flood, 0.99)
+            result["fairness"] = {
+                "flooder": "adhoc",
+                "victim": "interactive",
+                "victim_p99_steady_ms": p99_s,
+                "victim_p99_flood_ms": p99_f,
+                "victim_p99_ratio": (
+                    round(p99_f / p99_s, 2) if p99_s and p99_f else None
+                ),
+                "victim_sheds_during_flood": sum(
+                    1 for s in vic if s[1] == "flood" and s[3] == "shed"
+                ),
+                "flooder_sheds": per_tenant["adhoc"]["shed"],
+            }
+            # the doctor should name the overload on a saturated run:
+            # diagnose the most recent shed query against the journal
+            try:
+                from trino_tpu.obs import journal as J
+                from trino_tpu.obs import doctor
+
+                shed_evts = [
+                    e for e in J.get_journal().tail()
+                    if e.get("eventType") == J.QUERY_SHED
+                    and e.get("queryId")
+                ]
+                if shed_evts:
+                    diag = doctor.diagnose_query(
+                        shed_evts[-1]["queryId"],
+                        error="ADMISSION_TIMEOUT: shed",
+                    )
+                    result["diagnosis"] = {
+                        k: diag.get(k)
+                        for k in ("verdict", "rootCause", "summary",
+                                  "eventIds")
+                    }
+            except Exception:  # noqa: BLE001 — diagnosis is best-effort
+                pass
+        else:
+            # smoke fairness signal: weighted share of completed starts
+            result["fairness"] = {
+                "starts_per_weight": {
+                    name: round(per_tenant[name]["ok"] / weight, 1)
+                    for name, weight, _n, _t, _w in tenants
+                }
+            }
+        return result
+
     # (name, fn, default_estimate_s, shared sessions to drop afterwards)
     # NORTH-STAR FIRST (r04 weak #2: SF100 was never reached): the spec-
     # scale configs spend the budget before the SF1 smoke tail
@@ -1149,6 +1428,11 @@ def main():
         # appended after the CPU filter: the churn config runs on any
         # backend when explicitly requested
         plan.append(("chaos_churn_sf0.01", _cfg_chaos_churn, 90, []))
+    if SERVE_MODE:
+        # appended after the CPU filter too: serving behavior is worth
+        # measuring on every backend when explicitly requested
+        plan.append((f"serve_{SERVE_MODE}", _cfg_serve,
+                     45 if SERVE_MODE == "smoke" else 90, []))
     if MESH_SIZES:
         # appended after the CPU filter too: the scaling axis is explicit
         # opt-in on every backend (--mesh / BENCH_MESH)

@@ -30,6 +30,14 @@ round:
                       skew now eat the added shards; advisory — it
                       never fails the CI gate (CPU-proxy scaling is
                       noisy)
+    serve-slo-regression
+                      a serve_* closed-loop config in the round failed
+                      queries outright, or its fairness chaos let the
+                      well-behaved tenant's p99 blow up past the bound
+                      (victim_p99_ratio > 4): shedding/isolation is no
+                      longer protecting tenants; advisory — it never
+                      joins the exit-1 set (serving SLOs on a CPU proxy
+                      under CI load are noisy)
     unknown           ran clean but shares no metric names with any
                       earlier round (nothing to diff)
 
@@ -58,6 +66,7 @@ REGRESSION_RATIO = 0.70   # geomean throughput below this => regression
 IMPROVED_RATIO = 1.25     # ...above this => improved
 BW_REGRESSION_RATIO = 0.70  # effective GB/s below this while wall holds
 MESH_SCALING_RATIO = 1.00   # widest mesh must beat the narrowest outright
+SERVE_VICTIM_P99_RATIO = 4.0  # victim p99 flood/steady past this => SLO broken
 
 # hard-crash signatures: runtime death, not ordinary query errors (a
 # compile HTTP 500 is a failure, but nobody's process died)
@@ -156,6 +165,18 @@ def load_round(path: str) -> dict:
             d = cfg.get(key)
             if isinstance(d, dict) and d.get("rootCause"):
                 root_causes.append(str(d["rootCause"]))
+    # closed-loop serving configs (bench.py --serve / --serve-smoke)
+    # carry SLO facts instead of rows/s: unstructured failure counts and
+    # the fairness-chaos victim p99 ratio
+    serve: Dict[str, dict] = {}
+    for name, cfg in configs.items():
+        if not (isinstance(cfg, dict) and name.startswith("serve_")):
+            continue
+        fairness = cfg.get("fairness") or {}
+        serve[name] = {
+            "failed_queries": int(cfg.get("failed_queries") or 0),
+            "victim_p99_ratio": fairness.get("victim_p99_ratio"),
+        }
     blob = tail + (json.dumps(parsed) if parsed else "")
     crashes = sum(blob.count(sig) for sig in CRASH_SIGNATURES)
     errors = sum(
@@ -186,6 +207,7 @@ def load_round(path: str) -> dict:
         "errors": errors,
         "op_walls": op_walls,
         "root_causes": root_causes,
+        "serve": serve,
     }
 
 
@@ -344,6 +366,28 @@ def judge(rounds: List[dict]) -> List[dict]:
                     "widest mesh only x%.2f the narrowest — scaling "
                     "collapsed" % mr
                 )
+        # serve-SLO check (--serve axis): the closed-loop bench must
+        # finish with zero unstructured failures, and the fairness chaos
+        # must keep the well-behaved tenant's p99 bounded.  Advisory —
+        # like mesh scaling it annotates but never joins the exit-1 set
+        broken = []
+        for name, s in sorted((r.get("serve") or {}).items()):
+            if s["failed_queries"]:
+                broken.append(
+                    "%s failed %d querie(s)" % (name, s["failed_queries"])
+                )
+            ratio = s.get("victim_p99_ratio")
+            if ratio is not None and ratio > SERVE_VICTIM_P99_RATIO:
+                broken.append(
+                    "%s victim p99 x%.1f under flood (bound x%.1f)"
+                    % (name, ratio, SERVE_VICTIM_P99_RATIO)
+                )
+        if broken and v["verdict"] in (
+            "steady", "improved", "baseline", "unknown"
+        ):
+            v["verdict"] = "serve-slo-regression"
+            sep = "; " if v["reason"] else ""
+            v["reason"] += sep + "; ".join(broken)
         verdicts.append(v)
     return verdicts
 
@@ -366,7 +410,7 @@ def to_markdown(verdicts: List[dict]) -> str:
         v for v in verdicts
         if v["verdict"] in (
             "regression", "crash-introduced", "bandwidth-regression",
-            "mesh-scaling-regression",
+            "mesh-scaling-regression", "serve-slo-regression",
         )
     ]
     lines.append("")

@@ -47,6 +47,14 @@ JOURNAL_LITERAL_RE = re.compile(
     r'["\'](trino_tpu_journal_[a-z0-9_]*)["\']'
 )
 DOCTOR_LITERAL_RE = re.compile(r'["\'](trino_tpu_doctor_[a-z0-9_]*)["\']')
+# resource-group and autoscaler literals likewise: the serving bench and
+# the fairness acceptance tests assert on these series by full name
+RESOURCE_GROUP_LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_resource_group_[a-z0-9_]*)["\']'
+)
+AUTOSCALER_LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_autoscaler_[a-z0-9_]*)["\']'
+)
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -86,6 +94,7 @@ def check_tree(root: str):
         for regex in (
             REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE,
             NODE_LITERAL_RE, JOURNAL_LITERAL_RE, DOCTOR_LITERAL_RE,
+            RESOURCE_GROUP_LITERAL_RE, AUTOSCALER_LITERAL_RE,
         ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""CI gate for the serving smoke: zero failed queries + a fairness signal.
+
+Reads bench.py's child-mode output (``BENCH_ONLY=serve_smoke``) from
+stdin — the last JSON line is ``{"bench_only": ..., "result": {...}}`` —
+and fails when:
+
+  * any query failed for a reason other than a structured shed/reject
+    (the smoke runs at tiny QPS with generous limits, so even one
+    unstructured failure is a regression in the serving path), or
+  * no tenant completed work, or
+  * the fairness signal (per-tenant percentiles + starts-per-weight) is
+    missing from the artifact — the bench stopped measuring what the
+    multi-tenant scheduler is for.
+
+Exit 0 with a one-line summary on success, 1 with the reason otherwise.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    doc = None
+    for line in sys.stdin:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+    if not doc:
+        print("serve smoke: no JSON output from bench.py", file=sys.stderr)
+        return 1
+    result = doc.get("result") or {}
+    if "error" in result:
+        print(f"serve smoke: crashed: {result['error']}", file=sys.stderr)
+        return 1
+    failed = int(result.get("failed_queries") or 0)
+    if failed:
+        print(
+            f"serve smoke: {failed} unstructured query failure(s): "
+            f"{result.get('error_samples')}", file=sys.stderr,
+        )
+        return 1
+    tenants = result.get("tenants") or {}
+    done = sum(int(t.get("ok") or 0) for t in tenants.values())
+    if not tenants or done == 0:
+        print("serve smoke: no tenant completed any query", file=sys.stderr)
+        return 1
+    fairness = result.get("fairness") or {}
+    have_pcts = all(
+        t.get("p99_ms") is not None for t in tenants.values()
+    )
+    if not fairness or not have_pcts:
+        print(
+            "serve smoke: fairness signal missing "
+            f"(fairness={bool(fairness)}, p99s={have_pcts})",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"serve smoke ok: {done} queries across {len(tenants)} tenants, "
+        f"qps={result.get('qps')}, shed={result.get('shed_total')}, "
+        f"0 failed"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

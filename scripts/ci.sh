@@ -19,6 +19,15 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=1
 
+echo "== serve smoke =="
+# ~30s closed-loop serving smoke: two tenants behind weighted-fair
+# resource groups at tiny QPS — zero failed queries, and the fairness
+# signal must be present in the artifact (scripts/check_serve_smoke.py
+# asserts both from bench.py's child-mode JSON line)
+timeout -k 10 180 env JAX_PLATFORMS=cpu BENCH_SERVE=smoke \
+    BENCH_ONLY=serve_smoke python bench.py \
+    | python scripts/check_serve_smoke.py || rc=1
+
 echo "== bench sentinel =="
 if ls BENCH_r*.json >/dev/null 2>&1; then
     python scripts/bench_sentinel.py || rc=1

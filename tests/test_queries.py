@@ -14,6 +14,26 @@ from trino_tpu.session import tpch_session
 SF = 0.001
 
 
+def _sqlite_supports_right_full_join() -> bool:
+    """Capability probe: RIGHT/FULL OUTER JOIN landed in sqlite 3.39 —
+    on older hosts the oracle cannot run the comparison query at all, so
+    those tests skip instead of failing against the oracle's limitation."""
+    try:
+        conn = sqlite3.connect(":memory:")
+        conn.execute("create table a(x)")
+        conn.execute("create table b(y)")
+        conn.execute("select * from a right join b on x = y").fetchall()
+        return True
+    except sqlite3.OperationalError:
+        return False
+
+
+requires_oracle_right_full_join = pytest.mark.skipif(
+    not _sqlite_supports_right_full_join(),
+    reason="host sqlite predates RIGHT/FULL OUTER JOIN (needs 3.39+)",
+)
+
+
 @pytest.fixture(scope="module")
 def session():
     return tpch_session(SF)
@@ -359,6 +379,7 @@ def test_substring_predicate_q22_shape(session, oracle_conn):
     check(session, oracle_conn, sql, oracle_sql)
 
 
+@requires_oracle_right_full_join
 def test_right_outer_join(session, oracle_conn):
     check(
         session, oracle_conn,
@@ -384,6 +405,7 @@ def test_full_outer_join(session, oracle_conn):
     assert_rows_match(actual, expected)
 
 
+@requires_oracle_right_full_join
 def test_full_outer_join_counts(session, oracle_conn):
     # customers with no orders exist at tiny SF; orders always match
     check(

@@ -1,0 +1,398 @@
+"""Elastic multi-tenant serving: weighted-fair groups, shedding, scaling.
+
+Covers the serving stack end to end: scheduling-policy arbitration
+(weighted_fair starts track configured weights under saturation,
+query_priority starts the highest priority first), queue-deadline
+shedding with structured retryable errors, per-tenant memory shares in
+the admission controller (including the FIFO bypass of a tenant-capped
+head), the doctor's overload rule, the system.runtime.resource_groups
+table, and the autoscaler's headline guarantee — scale-in mid-traffic
+with zero failed queries.
+"""
+import threading
+import time
+
+import pytest
+
+from trino_tpu.memory.admission import MemoryAdmissionController
+from trino_tpu.obs import journal
+from trino_tpu.obs.doctor import classify_error, diagnose
+from trino_tpu.server.resource_groups import (
+    QUERY_PRIORITY,
+    InternalResourceGroup,
+    QueryQueueFullError,
+    QueryShedError,
+    ResourceGroupManager,
+)
+from trino_tpu.utils.memory import ExceededMemoryLimitError
+
+
+# -- scheduling policies -------------------------------------------------
+
+
+def test_weighted_fair_starts_track_weights():
+    """2:1 weights -> ~2:1 starts under saturation (the dequeue-order
+    property): a single root slot arbitrated weighted-fair between two
+    loaded children starts them proportionally to their weights."""
+    mgr = ResourceGroupManager({
+        "groups": [{
+            "name": "root",
+            "hardConcurrencyLimit": 1,
+            "maxQueued": 1000,
+            "schedulingPolicy": "weighted_fair",
+            "subGroups": [
+                {"name": "a", "schedulingWeight": 2,
+                 "hardConcurrencyLimit": 1, "maxQueued": 100},
+                {"name": "b", "schedulingWeight": 1,
+                 "hardConcurrencyLimit": 1, "maxQueued": 100},
+            ],
+        }],
+    })
+    a, b = mgr.groups["root.a"], mgr.groups["root.b"]
+    starts = []
+
+    def mk(g):
+        return lambda: starts.append(g)
+
+    for _ in range(40):
+        a.submit(mk(a))
+        b.submit(mk(b))
+    order = []
+    for _ in range(30):
+        g = starts[-1]
+        order.append(g.name)
+        g.finish()
+    n_a, n_b = order.count("a"), order.count("b")
+    assert n_a + n_b == 30
+    assert n_b > 0
+    assert 1.5 <= n_a / n_b <= 2.5, order
+
+
+def test_query_priority_policy_starts_highest_first():
+    g = InternalResourceGroup(
+        "p", 1, 10, scheduling_policy=QUERY_PRIORITY
+    )
+    ran = []
+    g.submit(lambda: ran.append("first"))
+    g.submit(lambda: ran.append("low"), priority=1)
+    g.submit(lambda: ran.append("high"), priority=9)
+    g.submit(lambda: ran.append("mid"), priority=5)
+    for _ in range(3):
+        g.finish()
+    assert ran == ["first", "high", "mid", "low"]
+
+
+def test_selector_matches_nested_group_and_tenant():
+    mgr = ResourceGroupManager({
+        "groups": [{
+            "name": "serve",
+            "subGroups": [
+                {"name": "interactive", "memoryShare": 0.4,
+                 "subGroups": [{"name": "dash"}]},
+            ],
+        }],
+        "selectors": [
+            {"user": "dash-.*", "group": "serve.interactive.dash"},
+        ],
+    })
+    g = mgr.select("dash-42")
+    assert g.full_name == "serve.interactive.dash"
+    # tenant = top-level group under the root; memory share inherits
+    assert g.tenant == "interactive"
+    assert mgr.tenant_memory_share("interactive") == pytest.approx(0.4)
+    assert mgr.select("somebody-else").full_name == "global"
+
+
+# -- overload shedding ---------------------------------------------------
+
+
+def test_queue_deadline_sheds_structured_and_journaled():
+    g = InternalResourceGroup("d", 1, 10, queue_deadline_s=0.05)
+    ran, sheds = [], []
+    g.submit(lambda: ran.append(1))
+    g.submit(lambda: ran.append(2), query_id="q-shed-me",
+             on_shed=sheds.append)
+    time.sleep(0.12)
+    assert g.shed_expired() == 1
+    assert ran == [1]
+    err = sheds[0]
+    assert isinstance(err, QueryShedError)
+    assert err.error_code == "ADMISSION_TIMEOUT"
+    assert err.retryable
+    assert "overloaded" in str(err)
+    assert g.shed_total == 1
+    evts = [e for e in journal.get_journal().tail()
+            if e.get("eventType") == journal.QUERY_SHED
+            and e.get("queryId") == "q-shed-me"]
+    assert evts, "shed must land in the incident journal"
+    assert evts[-1]["detail"]["group"] == "d"
+
+
+def test_queue_full_rejects_with_structured_code():
+    g = InternalResourceGroup("full", 1, 1)
+    g.submit(lambda: None)
+    g.submit(lambda: None)  # queued
+    with pytest.raises(QueryQueueFullError) as exc:
+        g.submit(lambda: None)
+    assert exc.value.error_code == "QUERY_QUEUE_FULL"
+    assert exc.value.retryable
+
+
+def test_classify_error_maps_serving_codes():
+    assert classify_error(
+        'Query shed after 1.5s in the queue of resource group "x"'
+    ) == "ADMISSION_TIMEOUT"
+    assert classify_error(
+        "ADMISSION_TIMEOUT: retry with backoff"
+    ) == "ADMISSION_TIMEOUT"
+    assert classify_error(
+        'QUERY_QUEUE_FULL: Too many queued queries for "global" (max 5)'
+    ) == "QUERY_QUEUE_FULL"
+    assert classify_error(
+        "Query q timed out in the memory admission queue: ..."
+    ) == "ADMISSION_TIMEOUT"
+
+
+# -- per-tenant memory shares -------------------------------------------
+
+
+def test_admission_tenant_share_caps_and_fifo_bypass():
+    shares = {"capped": 0.5}
+    ctl = MemoryAdmissionController(
+        lambda: 100, timeout_s=0.2,
+        tenant_share_fn=lambda t: shares.get(t, 0.0),
+    )
+    ctl.acquire("q1", 40, tenant="capped")
+    # 40 + 20 > 50 = the tenant's share of 100: blocked, times out
+    with pytest.raises(ExceededMemoryLimitError):
+        ctl.acquire("q2", 20, timeout_s=0.1, tenant="capped")
+    # the timeout leaves a structured queue_timeout journal event
+    evts = [e for e in journal.get_journal().tail()
+            if e.get("eventType") == journal.QUEUE_TIMEOUT
+            and e.get("queryId") == "q2"]
+    assert evts and evts[-1]["detail"]["tenant"] == "capped"
+
+    # FIFO bypass: with a tenant-capped waiter parked at the head,
+    # another tenant with global headroom still admits
+    blocked = threading.Event()
+    unblocked = {"ok": False}
+
+    def waiter():
+        try:
+            ctl.acquire("q3", 30, timeout_s=5.0, tenant="capped",
+                        on_queue=blocked.set)
+            unblocked["ok"] = True
+        except ExceededMemoryLimitError:
+            pass
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    assert blocked.wait(2.0)
+    ctl.acquire("q4", 30, timeout_s=0.5, tenant="other")
+    assert ctl.tenant_reserved() == {"capped": 40, "other": 30}
+    # freeing the capped tenant's first query wakes the parked waiter
+    ctl.release("q1")
+    t.join(timeout=2.0)
+    assert unblocked["ok"]
+    ctl.release("q3")
+    ctl.release("q4")
+
+    # oversized-singleton escape hatch: a tenant with nothing admitted
+    # may exceed its share (the local manager owns that failure)
+    ctl2 = MemoryAdmissionController(
+        lambda: 100, tenant_share_fn=lambda t: 0.1
+    )
+    ctl2.acquire("big", 90, timeout_s=0.2, tenant="capped")
+
+
+# -- the doctor's overload rule -----------------------------------------
+
+
+def test_doctor_overload_rule_cites_shed_and_scale_events():
+    ev_shed = journal.emit(
+        journal.QUERY_SHED, query_id="q-over", severity=journal.WARN,
+        group="serve.adhoc", waitedS=2.0, queued=24,
+    )
+    ev_scale = journal.emit(
+        journal.SCALE_OUT, severity=journal.INFO, workers=3, backlog=12,
+    )
+    events = [e for e in journal.get_journal().tail()
+              if e.get("eventId") in (ev_shed, ev_scale)]
+    diag = diagnose(
+        "q-over", events,
+        error='ADMISSION_TIMEOUT: Query shed after 2.0s in the queue '
+              'of resource group "serve.adhoc"',
+    )
+    assert diag["verdict"] == "ROOT_CAUSE"
+    assert diag["rootCause"] == "overload"
+    assert "shed" in diag["summary"]
+    assert "added 1 worker" in diag["summary"]
+    assert ev_shed in diag["eventIds"]
+    assert ev_scale in diag["eventIds"]
+    assert diag["errorCode"] == "ADMISSION_TIMEOUT"
+
+
+def test_doctor_ranks_overload_below_node_churn():
+    from trino_tpu.obs.doctor import _RULES, _rule_node_churn, \
+        _rule_memory_pressure, _rule_overload
+
+    order = {r: i for i, r in enumerate(_RULES)}
+    assert order[_rule_node_churn] < order[_rule_overload]
+    assert order[_rule_overload] < order[_rule_memory_pressure]
+
+
+# -- system.runtime.resource_groups + end-to-end coordinator -------------
+
+
+def test_system_runtime_resource_groups_table():
+    from trino_tpu.client.client import ClientError, StatementClient
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.session import tpch_session
+
+    session = tpch_session(0.001)
+    server = CoordinatorServer(
+        session,
+        resource_groups={
+            "groups": [{
+                "name": "serve",
+                "hardConcurrencyLimit": 4,
+                "schedulingPolicy": "weighted_fair",
+                "subGroups": [
+                    {"name": "t1", "schedulingWeight": 3,
+                     "memoryShare": 0.5, "queueDeadlineS": 9.0},
+                ],
+            }],
+            "selectors": [{"user": "t1", "group": "serve.t1"}],
+        },
+    ).start()
+    try:
+        client = StatementClient(server.uri, user="t1")
+        _, rows = client.execute("select count(*) from nation")
+        assert rows == [[25]]
+        _, rows = client.execute(
+            "select name, scheduling_policy, scheduling_weight, "
+            "queue_deadline_s, memory_share, started_total "
+            "from system.runtime.resource_groups order by name"
+        )
+        by_name = {r[0]: r for r in rows}
+        assert by_name["serve"][1] == "weighted_fair"
+        assert by_name["serve.t1"][2] == 3
+        assert by_name["serve.t1"][3] == pytest.approx(9.0)
+        assert by_name["serve.t1"][4] == pytest.approx(0.5)
+        assert by_name["serve.t1"][5] >= 1  # the nation query started here
+    finally:
+        server.stop()
+
+
+def test_coordinator_persists_queue_full_error_code():
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.session import tpch_session
+
+    session = tpch_session(0.001)
+    server = CoordinatorServer(
+        session,
+        resource_groups={
+            "groups": [{"name": "global", "hardConcurrencyLimit": 1,
+                        "maxQueued": 0}],
+        },
+    ).start()
+    try:
+        co = server.coordinator
+        holder = co.resource_groups.groups["global"]
+        holder.submit(lambda: None)  # occupy the only slot
+        q = co.submit("select 1")
+        deadline = time.time() + 5.0
+        while q.state != "FAILED" and time.time() < deadline:
+            time.sleep(0.01)
+        assert q.state == "FAILED"
+        assert q.error.startswith("QUERY_QUEUE_FULL")
+        holder.finish()
+        # the rejection persists with its structured code
+        recs = session.history.completed()
+        rec = [r for r in recs if r.get("queryId") == q.query_id]
+        assert rec and rec[-1]["errorCode"] == "QUERY_QUEUE_FULL"
+    finally:
+        server.stop()
+
+
+# -- the autoscaler ------------------------------------------------------
+
+
+def test_autoscaler_scales_out_and_in_with_zero_failed_queries():
+    """The headline acceptance test: saturate a one-worker cluster until
+    the autoscaler adds a worker, then thin the load and keep querying
+    while it drains one — every query in flight during scale-in must
+    succeed."""
+    from trino_tpu.testing.runner import DistributedQueryRunner
+
+    failures, results = [], []
+    stop = threading.Event()
+
+    with DistributedQueryRunner(
+        workers=1,
+        catalogs=(("tpch", "tpch", {"tpch.scale-factor": 0.01}),),
+        resource_groups={
+            # 3 slots under 8 closed-loop sessions: a standing backlog
+            # of ~5 queued queries drives the scale-out signal
+            "groups": [{"name": "global", "hardConcurrencyLimit": 3,
+                        "maxQueued": 500}],
+        },
+    ) as runner:
+        scaler = runner.enable_autoscaler(
+            min_workers=1, max_workers=2, backlog_high=3,
+            hold_s=0.1, cooldown_s=0.5, idle_grace_s=0.8,
+        )
+        heavy = threading.Event()
+        heavy.set()
+
+        def loop():
+            from trino_tpu.client.client import StatementClient
+
+            client = StatementClient(runner.coordinator.uri)
+            while not stop.is_set():
+                try:
+                    _, rows = client.execute(
+                        "select count(*) from lineitem "
+                        "where l_quantity > 10"
+                    )
+                    results.append(rows[0][0])
+                except Exception as e:  # noqa: BLE001 — the assertion
+                    failures.append(str(e))
+                if not heavy.is_set():
+                    time.sleep(0.25)
+
+        threads = [threading.Thread(target=loop, daemon=True)
+                   for _ in range(8)]
+        for t in threads:
+            t.start()
+        # phase 1: saturation -> scale-out to 2 workers
+        deadline = time.time() + 60.0
+        while runner.alive_workers() < 2 and time.time() < deadline:
+            time.sleep(0.2)
+        assert runner.alive_workers() == 2, (
+            f"autoscaler never scaled out: {scaler.stats()}"
+        )
+        # phase 2: thin the load mid-traffic -> scale-in drains a worker
+        heavy.clear()
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            if runner.alive_workers() == 1:
+                break
+            time.sleep(0.2)
+        assert runner.alive_workers() == 1, (
+            f"autoscaler never scaled in: {scaler.stats()}"
+        )
+        # queries keep flowing after the drain, and NONE failed
+        n = len(results)
+        deadline = time.time() + 30.0
+        while len(results) < n + 3 and time.time() < deadline:
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert len(results) > n, "traffic stopped after scale-in"
+        assert not failures, failures[:3]
+        actions = [e["action"] for e in scaler.stats()["events"]]
+        assert "scale_out" in actions and "scale_in" in actions
+        # every scale event carries a citable journal event id
+        assert all(e["eventId"] > 0 for e in scaler.stats()["events"])

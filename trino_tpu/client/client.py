@@ -7,6 +7,8 @@ FINISHED/FAILED, accumulating data pages.
 from __future__ import annotations
 
 import json
+import time
+import urllib.error
 import urllib.request
 from typing import List, Optional, Tuple
 
@@ -56,9 +58,19 @@ class StatementClient:
             nxt = doc.get("nextUri")
             if not nxt:
                 break
-            poll = urllib.request.Request(
-                self.server + nxt, headers=headers
-            )
-            with urllib.request.urlopen(poll) as resp:
-                doc = json.load(resp)
+            # status polls are idempotent GETs: retry transient
+            # transport failures (a loaded ThreadingHTTPServer resets
+            # the odd connection) instead of failing the whole query
+            for attempt in range(3):
+                poll = urllib.request.Request(
+                    self.server + nxt, headers=headers
+                )
+                try:
+                    with urllib.request.urlopen(poll) as resp:
+                        doc = json.load(resp)
+                    break
+                except (ConnectionResetError, urllib.error.URLError):
+                    if attempt == 2:
+                        raise
+                    time.sleep(0.05 * (attempt + 1))
         return columns, rows

@@ -94,6 +94,41 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             float, 0.0,
         ),
         PropertyMetadata(
+            "resource_group_queue_deadline_s",
+            "default per-group queue deadline: queries queued longer "
+            "are shed with a retryable ADMISSION_TIMEOUT instead of "
+            "waiting forever (0 = queue forever); groups may override "
+            "via queueDeadlineS",
+            float, 0.0,
+        ),
+        PropertyMetadata(
+            "autoscale_min_workers",
+            "autoscaler floor: scale-in never drains below this many "
+            "ACTIVE workers",
+            int, 1,
+        ),
+        PropertyMetadata(
+            "autoscale_max_workers",
+            "autoscaler ceiling: scale-out stops adding workers here",
+            int, 4,
+        ),
+        PropertyMetadata(
+            "autoscale_backlog_high",
+            "queued queries (groups + memory admission) that count as "
+            "sustained overload and trigger scale-out",
+            int, 4,
+        ),
+        PropertyMetadata(
+            "autoscale_cooldown_s",
+            "seconds between autoscaler actions (anti-flap)",
+            float, 2.0,
+        ),
+        PropertyMetadata(
+            "autoscale_idle_grace_s",
+            "seconds of empty backlog before scale-in drains a worker",
+            float, 1.5,
+        ),
+        PropertyMetadata(
             "distributed",
             "execute over the full device mesh instead of one device",
             _bool, False,

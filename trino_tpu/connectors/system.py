@@ -96,6 +96,25 @@ SCHEMAS: Dict[str, List] = {
         ("queries", T.BIGINT),
         ("blocked_queries", T.BIGINT),
     ],
+    # one row per resource group in the coordinator's tree
+    # (server/resource_groups.py): live queued/running/shed state plus
+    # the scheduling configuration the arbiter runs on
+    "resource_groups": [
+        ("name", T.VARCHAR),
+        ("scheduling_policy", T.VARCHAR),
+        ("scheduling_weight", T.BIGINT),
+        ("running", T.BIGINT),
+        ("queued", T.BIGINT),
+        ("hard_concurrency_limit", T.BIGINT),
+        ("max_queued", T.BIGINT),
+        ("queue_deadline_s", T.DOUBLE),
+        ("memory_share", T.DOUBLE),
+        ("memory_usage_bytes", T.BIGINT),
+        ("soft_memory_limit_bytes", T.BIGINT),
+        ("decayed_cost", T.DOUBLE),
+        ("started_total", T.BIGINT),
+        ("shed_total", T.BIGINT),
+    ],
     # one row per ANALYZEd table (the session's analyze registry): when
     # stats were collected, over which columns, and at which data_version
     "table_stats": [
@@ -359,6 +378,31 @@ class _SystemSource:
                     out["queries"].append(len(p.get("byQuery") or {}))
                     out["blocked_queries"].append(blocked)
             return out
+        if table == "resource_groups":
+            mgr = getattr(s, "resource_group_manager", None)
+            stats = mgr.info() if mgr is not None else []
+            return {
+                "name": [g["name"] for g in stats],
+                "scheduling_policy": [g["schedulingPolicy"] for g in stats],
+                "scheduling_weight": [g["schedulingWeight"] for g in stats],
+                "running": [g["running"] for g in stats],
+                "queued": [g["queued"] for g in stats],
+                "hard_concurrency_limit": [
+                    g["hardConcurrencyLimit"] for g in stats
+                ],
+                "max_queued": [g["maxQueued"] for g in stats],
+                "queue_deadline_s": [g["queueDeadlineS"] for g in stats],
+                "memory_share": [g["memoryShare"] for g in stats],
+                "memory_usage_bytes": [
+                    g["memoryUsageBytes"] for g in stats
+                ],
+                "soft_memory_limit_bytes": [
+                    g["softMemoryLimitBytes"] for g in stats
+                ],
+                "decayed_cost": [g["decayedCost"] for g in stats],
+                "started_total": [g["startedTotal"] for g in stats],
+                "shed_total": [g["shedTotal"] for g in stats],
+            }
         if table == "table_stats":
             entries = sorted(
                 getattr(s, "analyzed_tables", {}).values(),

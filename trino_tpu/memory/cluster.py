@@ -44,6 +44,10 @@ class ClusterMemoryManager:
         self._node_seen: Dict[str, float] = {}
         self._blocked_since: Dict[str, float] = {}
         self.kills: List[dict] = []
+        # query -> tenant (top-level resource group) so the cluster view
+        # can bill reservations per tenant; registered by the
+        # coordinator at submit, dropped when the query finalizes
+        self._query_tenants: Dict[str, str] = {}
 
     # -- view ----------------------------------------------------------
     def update_node(self, node_id: str, snapshot: Optional[dict]):
@@ -104,6 +108,35 @@ class ClusterMemoryManager:
             for pool in (node.get("pools") or {}).values():
                 for qid, bytes_ in (pool.get("byQuery") or {}).items():
                     totals[qid] = totals.get(qid, 0) + int(bytes_)
+        return totals
+
+    # -- tenancy -------------------------------------------------------
+    def note_query_tenant(self, query_id: str, tenant: str):
+        if tenant:
+            with self._lock:
+                self._query_tenants[query_id] = tenant
+
+    def forget_query_tenant(self, query_id: str):
+        with self._lock:
+            self._query_tenants.pop(query_id, None)
+
+    def tenant_totals(self) -> Dict[str, int]:
+        """Per-tenant reservation: query_totals() rolled up through the
+        registered query->tenant map (one tenant's live footprint, the
+        share the admission controller is holding it to)."""
+        with self._lock:
+            tenants = dict(self._query_tenants)
+        totals: Dict[str, int] = {}
+        for qid, bytes_ in self.query_totals().items():
+            tenant = tenants.get(qid)
+            if tenant:
+                totals[tenant] = totals.get(tenant, 0) + bytes_
+        for tenant, bytes_ in totals.items():
+            REGISTRY.gauge(
+                "trino_tpu_memory_tenant_reserved_bytes",
+                "Cluster-wide reserved bytes per tenant (top-level "
+                "resource group)",
+            ).set(bytes_, tenant=tenant)
         return totals
 
     def blocked_nodes(self) -> List[str]:
@@ -175,6 +208,7 @@ class ClusterMemoryManager:
             "nodes": self.nodes_view(),
             "blockedNodes": self.blocked_nodes(),
             "queryTotals": self.query_totals(),
+            "tenantTotals": self.tenant_totals(),
             "killerPolicy": self.killer.name,
             "kills": list(self.kills),
         }

@@ -78,6 +78,8 @@ def classify_error(error) -> str:
         ("device_loss", "DEVICE_LOSS"),
         ("DeviceFaultError", "DEVICE_FAULT"),
         ("REMOTE_HOST_GONE", "REMOTE_HOST_GONE"),
+        ("ADMISSION_TIMEOUT", "ADMISSION_TIMEOUT"),
+        ("shed after", "ADMISSION_TIMEOUT"),
         ("admission queue", "ADMISSION_TIMEOUT"),
         ("ExceededMemoryLimit", "EXCEEDED_MEMORY_LIMIT"),
         ("memory limit", "EXCEEDED_MEMORY_LIMIT"),
@@ -216,6 +218,53 @@ def _rule_mesh_shrink(ctx) -> Optional[Dict]:
     return _finding("mesh_shrink", J.WARN, summary, shrinks)
 
 
+def _rule_overload(ctx) -> Optional[Dict]:
+    """The cluster shed load or scaled under offered-load pressure.
+    Below node churn (a dead worker is a fault, not demand) and above
+    memory pressure (an overloaded cluster's admission queue backs up,
+    so overload routinely *causes* the memory-pressure symptoms)."""
+    sheds = _events_of(ctx, J.QUERY_SHED)
+    timeouts = _events_of(ctx, J.QUEUE_TIMEOUT)
+    rescues = _events_of(ctx, J.STARVATION_AVERTED)
+    scales = _events_of(ctx, J.SCALE_OUT, J.SCALE_IN)
+    if not (sheds or timeouts or rescues) \
+            and ctx.get("errorCode") not in ("QUERY_QUEUE_FULL",
+                                             "ADMISSION_TIMEOUT"):
+        return None
+    parts = []
+    if sheds:
+        group = (sheds[0].get("detail") or {}).get("group", "")
+        parts.append(
+            f"{len(sheds)} query(s) shed past the queue deadline"
+            + (f" in group {group}" if group else "")
+        )
+    if timeouts:
+        parts.append(
+            f"{len(timeouts)} admission wait(s) timed out"
+        )
+    if rescues:
+        parts.append(
+            f"{len(rescues)} aged query(s) rescued by fair-share "
+            "arbitration"
+        )
+    if not parts:
+        parts.append(
+            "rejected at submit (queue full)"
+            if ctx.get("errorCode") == "QUERY_QUEUE_FULL"
+            else "timed out waiting for admission"
+        )
+    outs = sum(1 for e in scales if e.get("eventType") == J.SCALE_OUT)
+    ins = len(scales) - outs
+    if outs:
+        parts.append(f"autoscaler added {outs} worker(s)")
+    if ins:
+        parts.append(f"autoscaler drained {ins} worker(s)")
+    summary = "overload: " + ", ".join(parts)
+    sev = J.ERROR if ctx.get("error") else J.WARN
+    return _finding("overload", sev, summary,
+                    sheds + timeouts + rescues + scales)
+
+
 def _rule_memory_pressure(ctx) -> Optional[Dict]:
     oom = _events_of(ctx, J.FAULT_INJECTED, sites=("oom",))
     revokes = _events_of(ctx, J.MEMORY_REVOKE)
@@ -348,6 +397,10 @@ _RULES = (
     _rule_memory_kill,
     _rule_node_churn,
     _rule_mesh_shrink,
+    # overload below node churn (a dead worker is a fault, not demand),
+    # above memory pressure (a backed-up admission queue is usually the
+    # overload's symptom, not an independent cause)
+    _rule_overload,
     _rule_memory_pressure,
     # corruption heals before straggler/hedge: a healed producer re-run
     # is slow, so corruption routinely *causes* a straggler flag — the

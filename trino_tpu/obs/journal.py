@@ -75,6 +75,12 @@ FUSION_REJECT = "fusion_reject"
 FORCED_STREAMING = "forced_streaming"
 FAULT_INJECTED = "fault_injected"
 QUERY_FAILED = "query_failed"
+# multi-tenant serving: overload shedding and elasticity transitions
+QUERY_SHED = "query_shed"
+QUEUE_TIMEOUT = "queue_timeout"
+SCALE_OUT = "scale_out"
+SCALE_IN = "scale_in"
+STARVATION_AVERTED = "starvation_averted"
 
 # severities
 INFO = "info"

@@ -92,7 +92,11 @@ class Coordinator:
         # optional PasswordAuthenticator (security.py); None = open access
         self.authenticator = authenticator
         self.queries: Dict[str, QueryExecution] = {}
-        self.pool = ThreadPoolExecutor(max_workers=workers)
+        # dispatch pool: sized above the typical resource-group
+        # hard_concurrency_limit so the groups, not this executor, are
+        # the concurrency authority (idle threads are cheap; a 4-thread
+        # pool under a 10-slot group would silently serialize dispatch)
+        self.pool = ThreadPoolExecutor(max_workers=max(workers, 16))
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
         self.distributed = distributed
@@ -123,8 +127,29 @@ class Coordinator:
         # through the session (coordinator_only system scans)
         session.node_manager = self.node_manager
         # memory admission gate (resource-group softMemoryLimit role):
-        # queries wait in QUEUED until their estimated peak fits
-        self.admission = MemoryAdmissionController(self._memory_capacity)
+        # queries wait in QUEUED until their estimated peak fits; tenant
+        # shares cap how much of the budget one tenant's admitted
+        # reservations may hold
+        self.admission = MemoryAdmissionController(
+            self._memory_capacity,
+            tenant_share_fn=self.resource_groups.tenant_memory_share,
+        )
+        # system.runtime.resource_groups reads live group state through
+        # the session (coordinator_only system scans)
+        session.resource_group_manager = self.resource_groups
+        # a session-wide default queue deadline applies to root groups
+        # that don't configure their own (0 = queue forever)
+        default_deadline = float(
+            session.properties.get("resource_group_queue_deadline_s")
+            or 0.0
+        )
+        if default_deadline > 0:
+            for g in self.resource_groups.groups.values():
+                if g.parent is None and g.queue_deadline_s <= 0:
+                    g.queue_deadline_s = default_deadline
+        # elasticity control loop (enable_autoscaler wires the harness's
+        # scale-out hook); ticked from the enforcement loop
+        self.autoscaler = None
         # live straggler detector fed by announcement-piggybacked task
         # rollups (obs/opstats); one summary per task id, ever
         from ..obs.opstats import StragglerDetector
@@ -150,6 +175,42 @@ class Coordinator:
             threading.Thread(
                 target=self._enforcement_loop, daemon=True
             ).start()
+        elif any(
+            g.queue_deadline_s > 0
+            for g in self.resource_groups.groups.values()
+        ):
+            # coordinator-only clusters with queue deadlines still need
+            # a ticker: an idle queue must shed on time, not on the next
+            # submit
+            threading.Thread(target=self._shed_loop, daemon=True).start()
+
+    def enable_autoscaler(self, scale_out=None, **overrides):
+        """Attach the elasticity control loop.  ``scale_out`` is the
+        add-a-worker hook (DistributedQueryRunner.add_subprocess_worker
+        in the harness); knobs default from session properties."""
+        from .autoscaler import Autoscaler
+
+        props = self.session.properties
+        kw = {
+            "min_workers": int(
+                props.get("autoscale_min_workers") or 1
+            ),
+            "max_workers": int(
+                props.get("autoscale_max_workers") or 4
+            ),
+            "backlog_high": int(
+                props.get("autoscale_backlog_high") or 4
+            ),
+            "cooldown_s": float(
+                props.get("autoscale_cooldown_s") or 2.0
+            ),
+            "idle_grace_s": float(
+                props.get("autoscale_idle_grace_s") or 1.5
+            ),
+        }
+        kw.update(overrides)
+        self.autoscaler = Autoscaler(self, scale_out=scale_out, **kw)
+        return self.autoscaler
 
     def _memory_capacity(self) -> int:
         """Admission budget: announced host pools, or the coordinator's
@@ -168,6 +229,24 @@ class Coordinator:
         while not self._stop_enforcement.wait(0.1):
             try:
                 self.check_cluster_memory()
+            except Exception:
+                pass
+            try:
+                self.resource_groups.shed_expired()
+            except Exception:
+                pass
+            if self.autoscaler is not None:
+                try:
+                    self.autoscaler.tick()
+                except Exception:
+                    pass
+
+    def _shed_loop(self):
+        """Deadline-shed ticker for coordinator-only clusters (the
+        distributed enforcement loop already covers this)."""
+        while not self._stop_enforcement.wait(0.1):
+            try:
+                self.resource_groups.shed_expired()
             except Exception:
                 pass
 
@@ -234,14 +313,43 @@ class Coordinator:
         ).inc()
         group = self.resource_groups.select(user, source)
         q.group = group
+        self.cluster_memory.note_query_tenant(q.query_id, group.tenant)
+
+        def on_shed(err):
+            # queue-deadline shed: structured, retryable, and journaled
+            # (the group emitted query_shed before calling us)
+            with q.lock:
+                if q.state in ("FINISHED", "FAILED"):
+                    return
+                q.state = "FAILED"
+                q.error = f"{getattr(err, 'error_code', 'ADMISSION_TIMEOUT')}: {err}"
+                q.finished = time.time()
+                q.group = None
+            REGISTRY.counter(
+                "trino_tpu_query_failed_total",
+                "Queries that reached FAILED",
+            ).inc()
+            try:
+                self._finalize_query(q)
+            except Exception:
+                pass
+
         try:
-            group.submit(lambda: self.pool.submit(self._run, q))
+            group.submit(
+                lambda: self.pool.submit(self._run, q),
+                query_id=q.query_id,
+                on_shed=on_shed,
+            )
         except QueryQueueFullError as e:
             with q.lock:
                 q.state = "FAILED"
                 q.error = f"QUERY_QUEUE_FULL: {e}"
                 q.finished = time.time()
                 q.group = None
+            try:
+                self._finalize_query(q)
+            except Exception:
+                pass
         return q
 
     def _estimated_peak_bytes(self, sql: str) -> int:
@@ -262,9 +370,19 @@ class Coordinator:
             return 0
 
     def _run(self, q: QueryExecution):
+        cancelled_group = None
         with q.lock:
             if q.state == "FAILED":  # cancelled while queued
-                return
+                cancelled_group, q.group = q.group, None
+                cancelled = True
+            else:
+                cancelled = False
+        if cancelled:
+            if cancelled_group is not None:
+                # the dequeue charged a running slot before cancel won
+                # the race: release it or the group leaks capacity
+                cancelled_group.finish()
+            return
         admitted = False
         try:
             est = self._estimated_peak_bytes(q.sql)
@@ -278,6 +396,7 @@ class Coordinator:
                             "memory_admission_timeout_s"
                         ) or 60.0
                     ),
+                    tenant=q.group.tenant if q.group is not None else "",
                 )
                 admitted = True
                 if q.group is not None:
@@ -334,6 +453,12 @@ class Coordinator:
             except Exception:
                 pass  # observability must never fail the query
             if q.group is not None:
+                # decayed CPU/slot cost: a flooding tenant's charge
+                # rises with every second it burns, sinking its
+                # weighted-fair arbitration until the decay forgives it
+                q.group.charge_cpu(
+                    (q.finished or time.time()) - q.created
+                )
                 q.group.finish()
 
     def _on_node_gone(self, node_id: str, uri: str) -> None:
@@ -434,6 +559,7 @@ class Coordinator:
             )
             doctor.record_diagnosis(diag)
             q.diagnosis = diag
+        self.cluster_memory.forget_query_tenant(q.query_id)
 
     def _plan_is_coordinator_only(self, plan) -> bool:
         """True when the plan scans a connector marked coordinator_only
@@ -1100,7 +1226,13 @@ class CoordinatorServer:
             resource_groups=resource_groups, authenticator=authenticator,
         )
         handler = type("Handler", (_Handler,), {"coordinator": self.coordinator})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        # serving posture: the stdlib default listen backlog of 5 resets
+        # connections the moment a few dozen sessions POST at once
+        server_cls = type(
+            "CoordinatorHTTPServer", (ThreadingHTTPServer,),
+            {"request_queue_size": 128},
+        )
+        self.httpd = server_cls(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 

@@ -613,7 +613,13 @@ class WorkerServer:
             )
         self.started = time.time()
         handler = type("Handler", (_WorkerHandler,), {"worker": self})
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+        # match the coordinator's raised listen backlog: a task fan-out
+        # from many concurrent queries connects in bursts
+        server_cls = type(
+            "WorkerHTTPServer", (ThreadingHTTPServer,),
+            {"request_queue_size": 128},
+        )
+        self.httpd = server_cls(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
         self.thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True

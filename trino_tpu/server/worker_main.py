@@ -44,6 +44,13 @@ def main(argv=None) -> int:
     )
     args = p.parse_args(argv)
 
+    # parity with the in-process topology: conftest/force_cpu enable
+    # x64 everywhere else, and a worker stuck on int32 overflows on
+    # wide aggregates the coordinator planned in int64
+    from .. import enable_x64
+
+    enable_x64()
+
     from ..testing.runner import _build_catalogs
     from .worker import WorkerServer
 

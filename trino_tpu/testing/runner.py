@@ -56,6 +56,7 @@ class DistributedQueryRunner:
         catalogs: Sequence[Tuple[str, str, dict]] = DEFAULT_CATALOGS,
         properties: Optional[dict] = None,
         startup_timeout: float = 10.0,
+        resource_groups: Optional[dict] = None,
     ):
         self.session = Session(config=properties)
         self._catalog_spec = [
@@ -65,7 +66,8 @@ class DistributedQueryRunner:
         for name, connector, config in catalogs:
             self.session.create_catalog(name, connector, config)
         self.coordinator = CoordinatorServer(
-            self.session, distributed=True
+            self.session, distributed=True,
+            resource_groups=resource_groups,
         ).start()
         self.workers: List[WorkerServer] = []
         # real child processes (worker_main.py), killable with SIGKILL:
@@ -156,6 +158,16 @@ class DistributedQueryRunner:
         entry = (proc, node_id, uri)
         self.subprocess_workers.append(entry)
         return entry
+
+    def enable_autoscaler(self, **overrides):
+        """Turn on the coordinator autoscaler with this runner's
+        subprocess-worker spawner as the scale-out path: new capacity
+        arrives as real child processes (late joiners, schedulable the
+        moment they announce) and scale-in drains through the PR 10
+        lifecycle.  Returns the Autoscaler."""
+        return self.coordinator.coordinator.enable_autoscaler(
+            scale_out=self.add_subprocess_worker, **overrides
+        )
 
     def sigkill_subprocess_worker(self, index: int = -1) -> tuple:
         """kill -9 a subprocess worker: the process dies mid-whatever,

@@ -44,6 +44,8 @@ METRIC_SUBSYSTEMS = (
     "node",
     "journal",
     "doctor",
+    "resource_group",
+    "autoscaler",
 )
 
 METRIC_NAME_RE = re.compile(
