@@ -427,18 +427,64 @@ def _crash_forensics() -> dict:
     return out
 
 
+def _compile_marks() -> dict:
+    """Cumulative per-cause compile counts + compile wall from the
+    process-global compile observatory.  Cluster configs run their workers
+    in-process (testing/runner.py), so one snapshot covers the whole
+    engine."""
+    try:
+        from trino_tpu.obs import compile_observatory as _co
+
+        obs = _co.get_observatory()
+        return {"byCause": dict(obs.counts_by_cause()),
+                "wallS": obs.total_compile_wall_s()}
+    except Exception:  # noqa: BLE001 — telemetry must not fail the bench
+        return {"byCause": {}, "wallS": 0.0}
+
+
+def _compile_ledger(before: dict):
+    """Delta rollup of the compile observatory across one config run:
+    per-cause compile counts, total compile wall, and the census top
+    families — the raw material for scripts/bucket_ladder.py."""
+    try:
+        from trino_tpu.obs import compile_observatory as _co
+
+        after = _compile_marks()
+        by_cause = {
+            c: after["byCause"].get(c, 0) - before["byCause"].get(c, 0)
+            for c in set(after["byCause"]) | set(before["byCause"])
+        }
+        by_cause = {c: n for c, n in sorted(by_cause.items()) if n}
+        return {
+            "by_cause": by_cause,
+            "compiles": sum(by_cause.values()),
+            "compile_wall_s": round(after["wallS"] - before["wallS"], 4),
+            "census_top_families":
+                _co.get_observatory().merged_census().top_families(5),
+        }
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def _safe(fn):
     """One config failing (tunnel crash, OOM, budget alarm) must not kill
-    the whole bench: record the error and keep measuring the rest."""
+    the whole bench: record the error and keep measuring the rest.  Every
+    result — crashed or not — carries the config's compile-ledger delta."""
+    marks = _compile_marks()
     try:
-        return fn()
+        out = fn()
     except BudgetExceeded:
         _STOP["flag"] = True
-        return {"error": "budget_timeout: BENCH_BUDGET_S reached mid-config",
-                **_crash_forensics()}
+        out = {"error": "budget_timeout: BENCH_BUDGET_S reached mid-config",
+               **_crash_forensics()}
     except Exception as e:  # noqa: BLE001
-        return {"error": f"{type(e).__name__}: {str(e)[:160]}",
-                **_crash_forensics()}
+        out = {"error": f"{type(e).__name__}: {str(e)[:160]}",
+               **_crash_forensics()}
+    if isinstance(out, dict):
+        ledger = _compile_ledger(marks)
+        if ledger is not None:
+            out["compile_ledger"] = ledger
+    return out
 
 
 def _cache_counts(session):
@@ -1152,7 +1198,16 @@ def main():
         steady_s = float(os.environ.get(
             "BENCH_SERVE_S", "8" if smoke else "12"
         ))
+        warmup_s = float(os.environ.get(
+            "BENCH_SERVE_WARMUP_S", "3" if smoke else "4"
+        ))
         flood_s = 0.0 if smoke else steady_s
+        # persist the compile ledger + shape census for this run so
+        # scripts/bucket_ladder.py can recommend a padding ladder from
+        # the real serve traffic afterwards
+        obs_dir = os.environ.get("BENCH_OBS_DIR") or tempfile.mkdtemp(
+            prefix="bench-compile-obs-"
+        )
 
         point_sqls = [
             "select l_extendedprice, l_discount from lineitem "
@@ -1211,7 +1266,7 @@ def main():
         samples = []  # (tenant, phase, latency_ms, outcome) — append-only
         error_samples = []  # first few distinct unexpected failures
         stop_evt = threading.Event()
-        phase_ref = {"phase": "steady"}
+        phase_ref = {"phase": "warmup"}
 
         def classify(msg: str) -> str:
             if (
@@ -1249,7 +1304,7 @@ def main():
         with DistributedQueryRunner(
             workers=1 if not smoke else 2,
             catalogs=(("tpch", "tpch", {"tpch.scale-factor": 0.01}),),
-            properties=dict(CACHE_PROPS),
+            properties={**CACHE_PROPS, "compile_observatory_dir": obs_dir},
             resource_groups=resource_groups,
         ) as runner:
             scaler = None
@@ -1267,6 +1322,18 @@ def main():
                     )
                     t.start()
                     threads.append(t)
+            # warm-up: every kernel family the serve mix will present gets
+            # traced once.  The flip to steady snapshots the engine-wide
+            # shape_miss count — the cluster runs in-process, so the global
+            # observatory sees every worker's compiles directly.  Compiles
+            # against warm families after this mark are the retrace storms
+            # the padding ladder exists to prevent (the CI gate asserts
+            # the smoke records zero).
+            time.sleep(warmup_s)
+            from trino_tpu.obs import compile_observatory as _co
+
+            miss_mark = _compile_marks()["byCause"].get(_co.SHAPE_MISS, 0)
+            phase_ref["phase"] = "steady"
             time.sleep(steady_s)
             if flood_s:
                 # fairness chaos: adhoc floods 10x its steady sessions
@@ -1288,6 +1355,11 @@ def main():
             )
             scale_events = scaler.stats()["events"] if scaler else []
             workers_final = runner.alive_workers()
+            steady_miss = (
+                _compile_marks()["byCause"].get(_co.SHAPE_MISS, 0)
+                - miss_mark
+            )
+            _co.sync()  # flush census-*.json for bucket_ladder.py
         wall = time.perf_counter() - t_run
 
         def pctl(lats, q):
@@ -1296,7 +1368,7 @@ def main():
             xs = sorted(lats)
             return round(xs[min(len(xs) - 1, int(q * len(xs)))], 1)
 
-        duration = steady_s + flood_s
+        duration = warmup_s + steady_s + flood_s
         per_tenant = {}
         for name, weight, n, _think, _w in tenants:
             mine = [s for s in samples if s[0] == name]
@@ -1317,7 +1389,10 @@ def main():
         result = {
             "mode": SERVE_MODE,
             "duration_s": round(duration, 1),
+            "warmup_s": round(warmup_s, 1),
             "wall_s": round(wall, 1),
+            "observatory_dir": obs_dir,
+            "steady_state_shape_miss_compiles": steady_miss,
             "sessions_total": (
                 sum(n for _, _, n, _, _ in tenants)
                 + (9 * tenants[-1][2] if flood_s else 0)
@@ -1338,6 +1413,18 @@ def main():
             "workers_final": workers_final,
             "groups": group_stats,
         }
+        if steady_miss:
+            # name the offenders so the CI failure is actionable
+            try:
+                evs = [e for e in _co.get_observatory().tail()
+                       if e.get("cause") == _co.SHAPE_MISS]
+                result["steady_shape_miss_samples"] = [
+                    {k: e.get(k)
+                     for k in ("kernel", "family", "shapes", "queryId")}
+                    for e in evs[-min(steady_miss, 5):]
+                ]
+            except Exception:  # noqa: BLE001
+                pass
         if flood_s:
             vic = [s for s in samples if s[0] == "interactive"]
             vic_steady = [s[2] for s in vic

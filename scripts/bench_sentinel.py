@@ -38,6 +38,13 @@ round:
                       longer protecting tenants; advisory — it never
                       joins the exit-1 set (serving SLOs on a CPU proxy
                       under CI load are noisy)
+    retrace-regression
+                      a serve_* config recorded steady-state shape-miss
+                      compiles (compile observatory): warm traffic is
+                      retracing, so every affected query pays compile
+                      wall at p99; advisory — the hard zero-miss gate
+                      lives in scripts/check_serve_smoke.py, this only
+                      annotates the trajectory
     unknown           ran clean but shares no metric names with any
                       earlier round (nothing to diff)
 
@@ -176,6 +183,9 @@ def load_round(path: str) -> dict:
         serve[name] = {
             "failed_queries": int(cfg.get("failed_queries") or 0),
             "victim_p99_ratio": fairness.get("victim_p99_ratio"),
+            "steady_shape_miss": cfg.get(
+                "steady_state_shape_miss_compiles"
+            ),
         }
     blob = tail + (json.dumps(parsed) if parsed else "")
     crashes = sum(blob.count(sig) for sig in CRASH_SIGNATURES)
@@ -388,6 +398,27 @@ def judge(rounds: List[dict]) -> List[dict]:
             v["verdict"] = "serve-slo-regression"
             sep = "; " if v["reason"] else ""
             v["reason"] += sep + "; ".join(broken)
+        # retrace check (compile observatory): a serve config that
+        # records steady-state shape-miss compiles is retracing on warm
+        # traffic — every miss is many milliseconds of compile wall on
+        # the query path, the exact p99 hazard the padding ladder
+        # exists to absorb.  Advisory — the serve-smoke CI gate
+        # (check_serve_smoke.py) is the hard zero-miss assertion; here
+        # it only annotates otherwise-healthy rounds
+        retraced = []
+        for name, s in sorted((r.get("serve") or {}).items()):
+            miss = s.get("steady_shape_miss")
+            if miss is not None and int(miss) > 0:
+                retraced.append(
+                    "%s retraced %d time(s) in steady state"
+                    % (name, int(miss))
+                )
+        if retraced and v["verdict"] in (
+            "steady", "improved", "baseline", "unknown"
+        ):
+            v["verdict"] = "retrace-regression"
+            sep = "; " if v["reason"] else ""
+            v["reason"] += sep + "; ".join(retraced)
         verdicts.append(v)
     return verdicts
 
@@ -411,6 +442,7 @@ def to_markdown(verdicts: List[dict]) -> str:
         if v["verdict"] in (
             "regression", "crash-introduced", "bandwidth-regression",
             "mesh-scaling-regression", "serve-slo-regression",
+            "retrace-regression",
         )
     ]
     lines.append("")

@@ -55,6 +55,11 @@ RESOURCE_GROUP_LITERAL_RE = re.compile(
 AUTOSCALER_LITERAL_RE = re.compile(
     r'["\'](trino_tpu_autoscaler_[a-z0-9_]*)["\']'
 )
+# compile-observatory literals likewise: the retrace gate and the
+# observatory acceptance tests assert on these series by full name
+COMPILE_LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_compile_[a-z0-9_]*)["\']'
+)
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -95,6 +100,7 @@ def check_tree(root: str):
             REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE,
             NODE_LITERAL_RE, JOURNAL_LITERAL_RE, DOCTOR_LITERAL_RE,
             RESOURCE_GROUP_LITERAL_RE, AUTOSCALER_LITERAL_RE,
+            COMPILE_LITERAL_RE,
         ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
@@ -134,6 +140,10 @@ def check_tree(root: str):
          "trino_tpu.obs.journal", "EVENT_FIELDS"),
         ("trino_tpu/obs/doctor.py",
          "trino_tpu.obs.doctor", "DIAGNOSIS_FIELDS"),
+        ("trino_tpu/obs/compile_observatory.py",
+         "trino_tpu.obs.compile_observatory", "COMPILE_FIELDS"),
+        ("trino_tpu/obs/compile_observatory.py",
+         "trino_tpu.obs.compile_observatory", "CENSUS_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

@@ -11,7 +11,12 @@ and fails when:
   * no tenant completed work, or
   * the fairness signal (per-tenant percentiles + starts-per-weight) is
     missing from the artifact — the bench stopped measuring what the
-    multi-tenant scheduler is for.
+    multi-tenant scheduler is for, or
+  * the compile observatory recorded ANY steady-state shape-miss
+    compile: after the warm-up window every kernel family the serve mix
+    presents has been traced, so a shape-miss retrace in steady state
+    means the padding buckets stopped absorbing real traffic (each one
+    is many milliseconds of compile on the query path).
 
 Exit 0 with a one-line summary on success, 1 with the reason otherwise.
 """
@@ -61,10 +66,26 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    miss = result.get("steady_state_shape_miss_compiles")
+    if miss is None:
+        print(
+            "serve smoke: steady_state_shape_miss_compiles missing — "
+            "the bench stopped splitting warm-up from steady state",
+            file=sys.stderr,
+        )
+        return 1
+    if int(miss):
+        print(
+            f"serve smoke: {miss} steady-state shape-miss compile(s) — "
+            "warm traffic is retracing "
+            f"(offenders: {result.get('steady_shape_miss_samples')})",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"serve smoke ok: {done} queries across {len(tenants)} tenants, "
         f"qps={result.get('qps')}, shed={result.get('shed_total')}, "
-        f"0 failed"
+        f"0 failed, 0 steady-state shape-miss compiles"
     )
     return 0
 

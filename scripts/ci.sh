@@ -21,9 +21,11 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 
 echo "== serve smoke =="
 # ~30s closed-loop serving smoke: two tenants behind weighted-fair
-# resource groups at tiny QPS — zero failed queries, and the fairness
-# signal must be present in the artifact (scripts/check_serve_smoke.py
-# asserts both from bench.py's child-mode JSON line)
+# resource groups at tiny QPS — zero failed queries, the fairness
+# signal must be present in the artifact, and the compile observatory
+# must record ZERO steady-state shape-miss compiles (warm traffic that
+# retraces is a p99 regression; scripts/check_serve_smoke.py asserts
+# all three from bench.py's child-mode JSON line)
 timeout -k 10 180 env JAX_PLATFORMS=cpu BENCH_SERVE=smoke \
     BENCH_ONLY=serve_smoke python bench.py \
     | python scripts/check_serve_smoke.py || rc=1

@@ -12,6 +12,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import trino_tpu
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 gate (-m 'not slow'); run "
+        "explicitly or via the full suite",
+    )
+
+
 if os.environ.get("TRINO_TPU_TEST_TPU") == "1":
     # hardware-validation mode: run single-device suites on the real
     # TPU backend (mesh/distributed suites need 8 devices — skip them)

@@ -256,6 +256,16 @@ class CompileCache:
         with self._lock:
             self._entries.clear()
 
+    def persistent_known(self, key) -> bool:
+        """Advisory, stat-free probe: was this program compiled by an
+        earlier process (persistent-tier index hit)?  The compile
+        observatory's cause classifier asks before a compile so the
+        resulting re-trace is recorded as ``persistent_load`` rather
+        than a genuine shape miss."""
+        with self._lock:
+            return (self._persistent_dir is not None
+                    and stable_key_digest(key) in self._index)
+
     # -- poisoned-entry handling -----------------------------------------
     def evict_poisoned(self, key) -> bool:
         """Drop an entry whose executable faulted (the axon tunnel
@@ -274,6 +284,16 @@ class CompileCache:
         REGISTRY.counter(
             "trino_tpu_cache_op_total", "Cache operations by tier and op"
         ).inc(tier="compile", op=op)
+        # the observatory's tier view of the same ops: which compile-
+        # cache tier (in-memory executable vs on-disk persistent index)
+        # answered, feeding the cause taxonomy's persistent_load split
+        REGISTRY.counter(
+            "trino_tpu_compile_cache_tier_total",
+            "Compile-cache operations by serving tier",
+        ).inc(
+            tier="persistent" if op == "persistent_hit" else "memory",
+            op=op,
+        )
         if self._on_event is not None:
             self._on_event("compile", op, 0)
 

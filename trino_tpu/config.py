@@ -255,6 +255,20 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 20,
         ),
         PropertyMetadata(
+            "compile_observatory_dir",
+            "directory for the crash-safe engine-wide compile ledger "
+            "(mmap'd JSONL segments plus per-writer census snapshots, "
+            "scripts/bucket_ladder.py reads them); empty keeps the "
+            "observatory in-memory only",
+            str, "",
+        ),
+        PropertyMetadata(
+            "compile_census_max_families",
+            "bound on distinct kernel families the shape census tracks "
+            "(overflow folds into __other__, never dropped)",
+            int, 64,
+        ),
+        PropertyMetadata(
             "query_doctor",
             "run the automated query doctor at query finalize and "
             "attach its ranked root-cause diagnosis to EXPLAIN ANALYZE, "

@@ -207,6 +207,35 @@ SCHEMAS: Dict[str, List] = {
         ("detail", T.VARCHAR),
         ("ts", T.DOUBLE),
     ],
+    # the in-memory tail of the engine-wide compile observatory
+    # (obs/compile_observatory.py): one row per trace/compile event,
+    # including worker events ingested via the announcement piggyback
+    "compiles": [
+        ("compile_id", T.BIGINT),
+        ("kernel", T.VARCHAR),
+        ("family", T.VARCHAR),
+        ("cause", T.VARCHAR),
+        ("mode", T.VARCHAR),
+        ("shapes", T.VARCHAR),
+        ("actual_rows", T.BIGINT),
+        ("padded_rows", T.BIGINT),
+        ("compile_wall_s", T.DOUBLE),
+        ("query_id", T.VARCHAR),
+        ("task_id", T.VARCHAR),
+        ("node_id", T.VARCHAR),
+        ("ts", T.DOUBLE),
+    ],
+    # the shape census: one row per (kernel family, pow2 row bucket) —
+    # the observed traffic-shape distribution scripts/bucket_ladder.py
+    # turns into a padding-ladder recommendation
+    "shape_census": [
+        ("family", T.VARCHAR),
+        ("bucket", T.BIGINT),
+        ("count", T.BIGINT),
+        ("min_rows", T.BIGINT),
+        ("max_rows", T.BIGINT),
+        ("total_rows", T.BIGINT),
+    ],
     # one row per query-doctor verdict (obs/doctor.py finalize pass):
     # the ranked causal root-cause report, newest last
     "diagnoses": [
@@ -546,6 +575,48 @@ class _SystemSource:
                     for e in tail
                 ],
                 "ts": [float(e.get("ts") or 0.0) for e in tail],
+            }
+        if table == "compiles":
+            import json as _json
+
+            from ..obs import compile_observatory as _co
+
+            tail = _co.get_observatory().tail()
+            return {
+                "compile_id": [int(e.get("compileId") or 0) for e in tail],
+                "kernel": [e.get("kernel", "") for e in tail],
+                "family": [e.get("family", "") for e in tail],
+                "cause": [e.get("cause", "") for e in tail],
+                "mode": [e.get("mode", "") for e in tail],
+                "shapes": [
+                    _json.dumps(e.get("shapes") or {}, sort_keys=True)
+                    for e in tail
+                ],
+                "actual_rows": [
+                    int(e.get("actualRows") or 0) for e in tail
+                ],
+                "padded_rows": [
+                    int(e.get("paddedRows") or 0) for e in tail
+                ],
+                "compile_wall_s": [
+                    float(e.get("compileWallS") or 0.0) for e in tail
+                ],
+                "query_id": [e.get("queryId", "") for e in tail],
+                "task_id": [e.get("taskId", "") for e in tail],
+                "node_id": [e.get("nodeId", "") for e in tail],
+                "ts": [float(e.get("ts") or 0.0) for e in tail],
+            }
+        if table == "shape_census":
+            from ..obs import compile_observatory as _co
+
+            recs = _co.get_observatory().merged_census().rows()
+            return {
+                "family": [r["family"] for r in recs],
+                "bucket": [r["bucket"] for r in recs],
+                "count": [r["count"] for r in recs],
+                "min_rows": [r["minRows"] for r in recs],
+                "max_rows": [r["maxRows"] for r in recs],
+                "total_rows": [r["totalRows"] for r in recs],
             }
         if table == "diagnoses":
             from ..obs import doctor as _doctor

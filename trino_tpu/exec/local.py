@@ -37,6 +37,7 @@ from ..expr.lower import LoweringContext, compile_expr
 from ..ops import aggregation as agg_ops
 from ..ops import join as join_ops
 from ..ops import sort as sort_ops
+from ..obs import compile_observatory as _compile_obs
 from ..obs.bandwidth import BandwidthLedger
 from ..ops import tree_nbytes
 from ..ops import window as window_ops
@@ -644,6 +645,9 @@ class LocalExecutor:
                 )
             )
             for attempt in range(7):
+                # the observatory classifies attempt>0 compiles as
+                # ladder rungs (capacity/fallback re-traces)
+                self._ladder_attempt = attempt
                 # ONE round trip for all control scalars AND the output
                 # lanes (the accelerator may sit behind a high-latency
                 # tunnel: each device_get costs an RTT; on the rare
@@ -679,11 +683,38 @@ class LocalExecutor:
                         # eager mode has no XLA compile step; the trace
                         # wall is the honest analog (and each ladder rung
                         # re-traces, so rungs count as recompiles)
+                        ev = _compile_obs.record_compile(
+                            kernel="eager-%d" % attempt,
+                            family=self._compile_family(plan),
+                            mode="eager",
+                            shapes=_shape_summary(scans),
+                            shape_sig=self._compile_shape_sig(counts),
+                            actual_rows=sum(
+                                int(c) for c in counts.values()
+                            ),
+                            padded_rows=sum(
+                                _pad_capacity(int(c))
+                                for c in counts.values()
+                            ),
+                            compile_wall_s=time.time() - eager_start,
+                            query_id=self.query_id,
+                            task_id=str(
+                                self.config.get("task_id") or ""
+                            ),
+                            node_id=str(
+                                self.config.get("node_id") or ""
+                            ),
+                            ladder_attempt=attempt,
+                            scan_rows=[
+                                int(c) for c in counts.values()
+                            ],
+                        )
                         self._record_kernel(
                             "eager-%d" % attempt,
                             compile_s=time.time() - eager_start,
                             cached=False,
                             mode="eager",
+                            cause=ev["cause"],
                         )
                     last = getattr(self, "_last_crumb", None)
                     (dup_vals, check_vals, coll_vals, wide_vals,
@@ -1394,8 +1425,31 @@ class LocalExecutor:
         return _pad_capacity(min(best * 2, max_rows, 1 << 18))
 
     # ------------------------------------------------------------------
+    def _compile_family(self, plan) -> str:
+        """Shape- and capacity-invariant kernel-family digest: the
+        observatory's unit of 'same program modulo padding bucket'."""
+        from ..cache.compile_cache import stable_key_digest
+        from ..cache.signature import fragment_fingerprint
+
+        try:
+            fp = fragment_fingerprint(plan)
+        except Exception:  # unknown node kinds: per-object identity
+            fp = id(plan)
+        return stable_key_digest(("family", fp))[:12]
+
+    @staticmethod
+    def _compile_shape_sig(counts) -> str:
+        """Padded-bucket signature of one execution's scan shapes (the
+        eager/mesh analog of the jit key's per-scan bucket component)."""
+        from ..cache.compile_cache import stable_key_digest
+
+        return stable_key_digest(tuple(sorted(
+            _pad_capacity(int(c)) for c in counts.values()
+        )))[:12]
+
     def _record_kernel(
-        self, digest: str, compile_s: float, cached: bool, mode: str = "jit"
+        self, digest: str, compile_s: float, cached: bool, mode: str = "jit",
+        cause: Optional[str] = None,
     ) -> dict:
         """Accumulate one fragment-program execution into kernel_profile."""
         kernels: List[dict] = self.kernel_profile["kernels"]  # type: ignore[assignment]
@@ -1412,26 +1466,31 @@ class LocalExecutor:
                 "compileWallS": 0.0,
                 "executions": 0,
                 "cacheHits": 0,
+                "causes": {},
             }
             kernels.append(rec)
         rec["executions"] += 1
         if cached:
             rec["cacheHits"] += 1
         else:
-            prior = sum(k["compiles"] for k in kernels)
             rec["compiles"] += 1
             rec["compileWallS"] += compile_s
+            cause = cause or _compile_obs.FIRST_COMPILE
+            causes = rec.setdefault("causes", {})
+            causes[cause] = causes.get(cause, 0) + 1
             REGISTRY.histogram(
                 "trino_tpu_kernel_compile_seconds",
                 "XLA fragment compile (or eager trace) wall time",
             ).observe(compile_s)
-            if prior > 0:
-                # any compile after the query's first is a recompile:
-                # capacity-ladder rungs, poison evictions, fallback re-traces
+            if cause != _compile_obs.FIRST_COMPILE:
+                # recompiles split by the observatory's cause taxonomy:
+                # ladder rungs, shape misses, poison recovery,
+                # persistent-tier loads — no longer conflated
                 REGISTRY.counter(
                     "trino_tpu_kernel_recompile_total",
-                    "Fragment programs compiled beyond the first per query",
-                ).inc()
+                    "Fragment programs compiled beyond a family's first,"
+                    " by cause",
+                ).inc(cause=cause)
         return rec
 
     def _finalize_kernel_profile(self, scans, counts, host_lanes, sel_np):
@@ -1452,10 +1511,21 @@ class LocalExecutor:
             d2h += int(getattr(ok, "nbytes", 0)) if ok is not None else 0
         kernels: List[dict] = self.kernel_profile["kernels"]  # type: ignore[assignment]
         compiles = sum(k["compiles"] for k in kernels)
+        by_cause: Dict[str, int] = {}
+        for k in kernels:
+            for c, n in (k.get("causes") or {}).items():
+                by_cause[c] = by_cause.get(c, 0) + n
         self.kernel_profile["summary"] = {
             "kernels": len(kernels),
             "compiles": compiles,
-            "recompiles": max(0, compiles - 1),
+            # a recompile is any compile whose cause is NOT a family's
+            # first — the old max(0, compiles - 1) conflated ladder
+            # rungs, poison recovery, and genuine shape misses
+            "recompiles": max(
+                0,
+                compiles - by_cause.get(_compile_obs.FIRST_COMPILE, 0),
+            ),
+            "compilesByCause": by_cause,
             "cacheHits": sum(k["cacheHits"] for k in kernels),
             "compileWallS": sum(k["compileWallS"] for k in kernels),
             "actualRows": actual,
@@ -1603,7 +1673,36 @@ class LocalExecutor:
             compile_start = time.time()
             bc = self._dispatch_crumb(digest, "jit", prep)
             self._last_crumb = bc
-            with TRACER.span("xla_compile", fragment=digest):
+            # observatory cause, classified BEFORE the compile so the
+            # tracer span carries it: poisoned recovery > ladder rung >
+            # persistent-tier load > shape miss vs first compile
+            family = self._compile_family(plan)
+            poisoned = key in getattr(self, "_poisoned_jit_keys", ())
+            persistent = bool(
+                getattr(cache, "persistent_known", None) is not None
+                and cache.persistent_known(key)
+            )
+            ladder_attempt = int(getattr(self, "_ladder_attempt", 0))
+            cause = _compile_obs.get_observatory().classify(
+                family, digest, ladder_attempt=ladder_attempt,
+                poisoned=poisoned, persistent=persistent,
+                query_id=self.query_id,
+            )
+            shapes = _shape_summary(prep)
+            actual_rows = sum(int(c) for c in counts.values())
+            padded_rows = sum(
+                _pad_capacity(int(c)) for c in counts.values()
+            )
+            with TRACER.span(
+                "xla_compile", fragment=digest, cause=cause,
+                shapeSig=";".join(
+                    "%s=%s" % kv for kv in sorted(shapes.items())
+                ),
+                actualRows=actual_rows, paddedRows=padded_rows,
+                paddedRatio=round(
+                    padded_rows / actual_rows, 3
+                ) if actual_rows else 1.0,
+            ):
                 if donate and donatable_ords:
                     fn = jax.jit(  # dispatch-guard: ok (lazy wrapper)
                         raw, donate_argnums=(1,)
@@ -1619,8 +1718,19 @@ class LocalExecutor:
                 # (inseparable under jax.jit); warm executions dominate
                 # the accumulated GB/s
                 self._ledger_bracket(out, digest, "jit", plan, scans, led_t0)
+            compile_s = time.time() - compile_start
+            _compile_obs.record_compile(
+                kernel=digest, family=family, cause=cause,
+                mode="jit", shapes=shapes,
+                actual_rows=actual_rows, padded_rows=padded_rows,
+                compile_wall_s=compile_s,
+                query_id=self.query_id,
+                task_id=str(self.config.get("task_id") or ""),
+                node_id=str(self.config.get("node_id") or ""),
+                scan_rows=[int(c) for c in counts.values()],
+            )
             self._record_kernel(
-                digest, compile_s=time.time() - compile_start, cached=False
+                digest, compile_s=compile_s, cached=False, cause=cause
             )
             cell["dicts"] = dict(self.dicts)
             # the plan reference pins id(plan) (fingerprint memo validity)

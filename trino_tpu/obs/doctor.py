@@ -291,6 +291,27 @@ def _rule_memory_pressure(ctx) -> Optional[Dict]:
                     oom + revokes + blocks + streamed)
 
 
+def _rule_retrace_storm(ctx) -> Optional[Dict]:
+    """A burst of shape-miss recompiles (compile observatory sliding
+    window) put many-millisecond XLA compiles on this query's path —
+    the Tail-at-Scale rare-event p99 signature.  Ranked below memory
+    pressure: an engine under memory churn re-traces as a *symptom*
+    (evictions, capacity retreats), so pressure wins when both fire."""
+    storms = _events_of(ctx, J.RETRACE_STORM)
+    if not storms:
+        return None
+    misses = max(
+        int((e.get("detail") or {}).get("misses") or 0) for e in storms
+    )
+    window = (storms[-1].get("detail") or {}).get("windowS")
+    summary = (
+        f"retrace storm: {misses} shape-miss compile(s) inside a "
+        f"{window}s window — padding buckets do not fit this traffic "
+        "shape (see system.runtime.shape_census / scripts/bucket_ladder.py)"
+    )
+    return _finding("retrace_storm", J.WARN, summary, storms)
+
+
 def _rule_straggler(ctx) -> Optional[Dict]:
     flags = _events_of(ctx, J.STRAGGLER_FLAG)
     hedges = _events_of(ctx, J.HEDGE)
@@ -402,6 +423,10 @@ _RULES = (
     # overload's symptom, not an independent cause)
     _rule_overload,
     _rule_memory_pressure,
+    # retrace storms directly below memory pressure: recompile bursts
+    # under memory churn are usually the pressure's symptom (capacity
+    # retreats re-trace), so the pressure verdict must outrank them
+    _rule_retrace_storm,
     # corruption heals before straggler/hedge: a healed producer re-run
     # is slow, so corruption routinely *causes* a straggler flag — the
     # flag is the symptom, the corrupt frame is the cause

@@ -75,6 +75,8 @@ FUSION_REJECT = "fusion_reject"
 FORCED_STREAMING = "forced_streaming"
 FAULT_INJECTED = "fault_injected"
 QUERY_FAILED = "query_failed"
+# compile observatory: sliding-window shape-miss retrace burst
+RETRACE_STORM = "retrace_storm"
 # multi-tenant serving: overload shedding and elasticity transitions
 QUERY_SHED = "query_shed"
 QUEUE_TIMEOUT = "queue_timeout"

@@ -39,8 +39,11 @@ from ..exec.local import (
     LocalExecutor,
     merge_pages_to_arrays,
     _pad_capacity,
+    _shape_summary,
     _TraceCtx,
 )
+from ..obs import compile_observatory as _compile_obs
+from ..utils.tracing import TRACER
 from ..expr import ir
 from ..expr.lower import compile_expr
 from ..ops import aggregation as agg_ops
@@ -244,14 +247,16 @@ class MeshExecutor(LocalExecutor):
             objs, bc, device_id=self._mesh_device_ids[0]
         )
 
-    def _record_kernel(self, digest, compile_s, cached, mode="jit"):
+    def _record_kernel(self, digest, compile_s, cached, mode="jit",
+                       cause=None):
         # every mesh-path kernel record carries the axis-size tag, so
         # flight records, the bandwidth ledger, and bench profiles can
         # tell 8-way from single-chip executions of the same plan
         tag = "mesh:%d" % self.mesh.devices.size
         if not str(digest).startswith("mesh:"):
             digest = "%s/%s" % (tag, digest)
-        return super()._record_kernel(digest, compile_s, cached, mode=mode)
+        return super()._record_kernel(digest, compile_s, cached,
+                                      mode=mode, cause=cause)
 
     def _ledger_input_bytes(self, scans) -> int:
         # mesh scan args are flat {sym: [ndev, cap]} ndarray dicts (the
@@ -316,14 +321,63 @@ class MeshExecutor(LocalExecutor):
             compile_start = time.time()
             bc = self._dispatch_crumb(digest, "mesh", scan_args)
             self._last_crumb = bc
-            fn = jax.jit(shard_fn)  # dispatch-guard: ok (lazy wrapper)
-            led_t0 = time.perf_counter()
-            out = self._dispatch(lambda: fn(scan_args, counts_args), bc)
+            # mesh compiles fresh each attempt (no executable cache):
+            # attempt 0 classifies by family warmth, later attempts are
+            # ladder rungs — same taxonomy as the jit path
+            family = "mesh%d:%s" % (ndev, self._compile_family(plan))
+            scan_rows = [
+                int(r)
+                for c in counts_args.values()
+                for r in np.asarray(c).reshape(-1)
+            ]
+            actual_rows = sum(scan_rows)
+            padded_rows = sum(
+                int(np.prod(v.shape))
+                for arrays in scan_args.values()
+                for v in list(arrays.values())[:1]
+            )
+            shape_sig = self._compile_shape_sig({
+                nid: int(np.max(np.asarray(c))) if len(
+                    np.asarray(c).reshape(-1)
+                ) else 0
+                for nid, c in counts_args.items()
+            })
+            shapes = _shape_summary(scan_args)
+            cause = _compile_obs.get_observatory().classify(
+                family, shape_sig, ladder_attempt=attempt,
+                query_id=self.query_id,
+            )
+            with TRACER.span(
+                "xla_compile", fragment=digest, cause=cause,
+                shapeSig=";".join(
+                    "%s=%s" % kv for kv in sorted(shapes.items())
+                ),
+                actualRows=actual_rows, paddedRows=padded_rows,
+                paddedRatio=round(
+                    padded_rows / actual_rows, 3
+                ) if actual_rows else 1.0,
+            ):
+                fn = jax.jit(shard_fn)  # dispatch-guard: ok (lazy wrapper)
+                led_t0 = time.perf_counter()
+                out = self._dispatch(
+                    lambda: fn(scan_args, counts_args), bc
+                )
             self._ledger_bracket(out, digest, "mesh", plan, scan_args,
                                  led_t0)
+            compile_s = time.time() - compile_start
+            _compile_obs.record_compile(
+                kernel=digest, family=family, cause=cause,
+                mode="mesh", shapes=shapes, shape_sig=shape_sig,
+                actual_rows=actual_rows, padded_rows=padded_rows,
+                compile_wall_s=compile_s,
+                query_id=self.query_id,
+                task_id=str(self.config.get("task_id") or ""),
+                node_id=str(self.config.get("node_id") or ""),
+                scan_rows=scan_rows,
+            )
             self._record_kernel(
-                digest, compile_s=time.time() - compile_start,
-                cached=False, mode="mesh",
+                digest, compile_s=compile_s,
+                cached=False, mode="mesh", cause=cause,
             )
             # one supervised transfer covers every retry-ladder check
             (checks, dups, colls, wides, sflags) = self._device_get(

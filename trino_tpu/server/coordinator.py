@@ -482,6 +482,17 @@ class Coordinator:
         except Exception:
             pass
 
+    def ingest_compiles(self, node_id: str, snapshot) -> None:
+        """Announcement piggyback: merge one worker's compile-
+        observatory snapshot (per-cause counts, census sketch, new
+        ledger events) into the coordinator's engine-wide view."""
+        from ..obs import compile_observatory as _co
+
+        try:
+            _co.get_observatory().ingest(node_id, snapshot)
+        except Exception:  # noqa: BLE001 — telemetry must not fail announce
+            pass
+
     def ingest_opstats(self, node_id: str, summaries) -> None:
         """Heartbeat piggyback: each worker announce carries its recent
         per-task rollups.  New task ids are grouped by stage and replayed
@@ -832,10 +843,15 @@ class Coordinator:
         if summaries:
             actual = sum(s.get("actualRows", 0) for s in summaries)
             padded = sum(s.get("paddedRows", 0) for s in summaries)
+            by_cause: Dict[str, int] = {}
+            for s in summaries:
+                for c, n in (s.get("compilesByCause") or {}).items():
+                    by_cause[c] = by_cause.get(c, 0) + int(n)
             summary = {
                 "kernels": sum(s.get("kernels", 0) for s in summaries),
                 "compiles": sum(s.get("compiles", 0) for s in summaries),
                 "recompiles": sum(s.get("recompiles", 0) for s in summaries),
+                "compilesByCause": by_cause,
                 "cacheHits": sum(s.get("cacheHits", 0) for s in summaries),
                 "compileWallS": sum(
                     s.get("compileWallS", 0.0) for s in summaries
@@ -992,6 +1008,13 @@ class _Handler(BaseHTTPRequestHandler):
                     self.coordinator.ingest_opstats(
                         doc["nodeId"], doc["opstats"]
                     )
+                if doc.get("compiles"):
+                    # compile-observatory piggyback: worker per-cause
+                    # counts, census sketches, and ledger events merge
+                    # into the coordinator's engine-wide observatory
+                    self.coordinator.ingest_compiles(
+                        doc["nodeId"], doc["compiles"]
+                    )
             self._json(202, {})
         else:
             self._json(404, {"error": "not found"})
@@ -1078,6 +1101,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if self.path == "/v1/resourceGroupState":
             self._json(200, co.resource_groups.info())
+            return
+        if self.path == "/v1/compiles":
+            # the engine-wide compile observatory: ledger tail, per-
+            # cause totals (local + ingested worker piggybacks), and
+            # the shape census (HTTP face of system.runtime.compiles /
+            # system.runtime.shape_census)
+            from ..obs import compile_observatory as _co
+
+            obs = _co.get_observatory()
+            self._json(200, {
+                "summary": obs.rollup(),
+                "compiles": obs.tail(256),
+                "census": obs.merged_census().snapshot(),
+            })
             return
         if self.path == "/v1/cache":
             # per-tier cache stats (the HTTP face of system.runtime.caches)

@@ -88,6 +88,11 @@ async function refresh() {
             '\\n  compile wall: ' + (s.compileWallS * 1000).toFixed(2) + 'ms' +
             '\\n  compiles: ' + s.compiles + ' (recompiles ' + s.recompiles +
             ', cache hits ' + s.cacheHits + ')' +
+            (s.compilesByCause && Object.keys(s.compilesByCause).length
+              ? '\\n  by cause: ' + Object.entries(s.compilesByCause)
+                  .filter(([, n]) => n > 0)
+                  .map(([c, n]) => c + '=' + n).join(', ')
+              : '') +
             '\\n  padding ratio: ' + s.paddingRatio.toFixed(2) + 'x (' +
             s.actualRows + ' -> ' + s.paddedRows + ' rows)' +
             '\\n  transfers: ~' + s.h2dBytes + 'B h2d, ~' + s.d2hBytes + 'B d2h';

@@ -698,6 +698,12 @@ class WorkerServer:
             journal.sync()
         except Exception:  # noqa: BLE001
             pass
+        try:
+            from ..obs import compile_observatory
+
+            compile_observatory.sync()
+        except Exception:  # noqa: BLE001
+            pass
 
     def start_graceful_shutdown(self):
         """PUT /v1/info/state SHUTTING_DOWN: drain then stop (the
@@ -723,6 +729,17 @@ class WorkerServer:
         threading.Thread(target=drain, daemon=True).start()
 
     # ------------------------------------------------------------------
+    def _compile_snapshot(self):
+        """This node's compile-observatory piggyback for one
+        announcement round (None on any failure: announcing must never
+        die on a telemetry bug)."""
+        try:
+            from ..obs import compile_observatory
+
+            return compile_observatory.get_observatory().announce_snapshot()
+        except Exception:  # noqa: BLE001
+            return None
+
     def _announce_loop(self):
         while not self._stop.is_set():
             winj = self.task_manager.fault_injector
@@ -754,6 +771,10 @@ class WorkerServer:
                     # completed-task wall/row rollups for the
                     # coordinator's live straggler detector
                     "opstats": list(self.task_manager.recent_opstats),
+                    # compile-observatory piggyback: per-cause counts,
+                    # shape-census sketch, and new ledger events since
+                    # the last round (coordinator merges engine-wide)
+                    "compiles": self._compile_snapshot(),
                 }).encode()
                 req = urllib.request.Request(
                     f"{self.coordinator_uri}/v1/announcement",

@@ -113,6 +113,27 @@ class Session:
                     or (1 << 20)
                 ),
             )
+        # compile observatory (obs/compile_observatory.py): the process-
+        # global trace/compile ledger + shape census; a directory
+        # upgrades it to the same crash-safe segment store
+        from .obs import compile_observatory as _compile_obs
+
+        _census_fams = int(
+            self.properties.get("compile_census_max_families")
+            or _compile_obs.DEFAULT_MAX_FAMILIES
+        )
+        if self.properties.get("compile_observatory_dir"):
+            _compile_obs.configure(
+                self.properties.get("compile_observatory_dir"),
+                census_max_families=_census_fams,
+            )
+        elif _census_fams != _compile_obs.DEFAULT_MAX_FAMILIES:
+            # resize the census without re-pointing (or dropping) the
+            # directory an earlier session configured
+            _compile_obs.configure(
+                _compile_obs.get_observatory().directory,
+                census_max_families=_census_fams,
+            )
         # ranked root-cause verdict of the most recent doctored query
         # (bench.py attaches it to slow configs)
         self.last_diagnosis: Optional[dict] = None
@@ -991,6 +1012,20 @@ class Session:
                     f"executions {k['executions']}, "
                     f"compiles {k['compiles']}"
                 )
+            # the observatory's cause taxonomy: benign first compiles
+            # vs the shape-miss retraces ROADMAP item 3 wants at zero
+            # in steady state
+            from .obs import compile_observatory as _co
+
+            by_cause = summary.get("compilesByCause") or {}
+            text += "\n\nCompiles:"
+            if any(by_cause.values()):
+                for cause in _co.CAUSES:
+                    n = by_cause.get(cause, 0)
+                    if n:
+                        text += f"\n  {cause}: {n}"
+            else:
+                text += "\n  (no compiles this query)"
         bandwidth = prof.get("bandwidth") or []
         if bandwidth:
             text += (

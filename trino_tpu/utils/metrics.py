@@ -46,6 +46,7 @@ METRIC_SUBSYSTEMS = (
     "doctor",
     "resource_group",
     "autoscaler",
+    "compile",
 )
 
 METRIC_NAME_RE = re.compile(
