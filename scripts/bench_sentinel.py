@@ -45,6 +45,17 @@ round:
                       wall at p99; advisory — the hard zero-miss gate
                       lives in scripts/check_serve_smoke.py, this only
                       annotates the trajectory
+    padding-waste-regression
+                      the bucketed-batch ABI's padding overhead blew
+                      its budget: a config's padded/actual row ratio
+                      exceeded the waste bound (geomean > 2.0), or a
+                      serve config's warm_start_wall_s (cold boot ->
+                      first zero-compile query) grew past 1.5x the
+                      baseline round's — the ladder is rounding too far
+                      up, or the disk-warmed cold start stopped
+                      working; advisory — it never joins the exit-1 set
+                      (waste trades against retraces by design, and
+                      boot walls on shared CI are noisy)
     unknown           ran clean but shares no metric names with any
                       earlier round (nothing to diff)
 
@@ -74,6 +85,8 @@ IMPROVED_RATIO = 1.25     # ...above this => improved
 BW_REGRESSION_RATIO = 0.70  # effective GB/s below this while wall holds
 MESH_SCALING_RATIO = 1.00   # widest mesh must beat the narrowest outright
 SERVE_VICTIM_P99_RATIO = 4.0  # victim p99 flood/steady past this => SLO broken
+PADDED_WASTE_RATIO = 2.0    # geomean padded/actual rows past this => wasteful
+WARM_START_GROWTH = 1.5     # warm_start_wall_s vs baseline past this => cold
 
 # hard-crash signatures: runtime death, not ordinary query errors (a
 # compile HTTP 500 is a failure, but nobody's process died)
@@ -186,7 +199,18 @@ def load_round(path: str) -> dict:
             "steady_shape_miss": cfg.get(
                 "steady_state_shape_miss_compiles"
             ),
+            "warm_start_wall_s": cfg.get("warm_start_wall_s"),
         }
+    # bucketed-batch ABI padding overhead: every config (timed or serve)
+    # may carry padded_waste_ratio — padded rows the dispatched ladder
+    # rungs cost over the actual rows the query presented
+    padded_waste: Dict[str, float] = {}
+    for name, cfg in configs.items():
+        if not isinstance(cfg, dict):
+            continue
+        pw = cfg.get("padded_waste_ratio")
+        if isinstance(pw, (int, float)) and pw > 0:
+            padded_waste[name] = float(pw)
     blob = tail + (json.dumps(parsed) if parsed else "")
     crashes = sum(blob.count(sig) for sig in CRASH_SIGNATURES)
     errors = sum(
@@ -218,6 +242,7 @@ def load_round(path: str) -> dict:
         "op_walls": op_walls,
         "root_causes": root_causes,
         "serve": serve,
+        "padded_waste": padded_waste,
     }
 
 
@@ -419,6 +444,50 @@ def judge(rounds: List[dict]) -> List[dict]:
             v["verdict"] = "retrace-regression"
             sep = "; " if v["reason"] else ""
             v["reason"] += sep + "; ".join(retraced)
+        # padding-budget check (bucketed-batch ABI): the ladder buys a
+        # bounded program count by rounding capacities up — the sentinel
+        # watches the price.  A config whose padded/actual ratio blew
+        # the waste bound, or a serve config whose warm-start wall (cold
+        # boot -> first zero-compile query) grew well past the baseline
+        # round's, gets the round annotated.  Advisory — waste trades
+        # against retraces by design and boot walls are CI-noisy, so it
+        # never joins the exit-1 set
+        wasteful = []
+        pw = r.get("padded_waste") or {}
+        if pw:
+            logs = [math.log(x) for x in pw.values() if x > 0]
+            if logs:
+                gm = math.exp(sum(logs) / len(logs))
+                v["padded_waste_geomean"] = round(gm, 3)
+                if gm > PADDED_WASTE_RATIO:
+                    wasteful.append(
+                        "padded/actual rows geomean x%.2f over %d "
+                        "config(s) (budget x%.1f)"
+                        % (gm, len(logs), PADDED_WASTE_RATIO)
+                    )
+        if baseline is not None:
+            for name, s in sorted((r.get("serve") or {}).items()):
+                ws = s.get("warm_start_wall_s")
+                base_ws = (baseline.get("serve") or {}).get(
+                    name, {}
+                ).get("warm_start_wall_s")
+                if (
+                    isinstance(ws, (int, float))
+                    and isinstance(base_ws, (int, float))
+                    and base_ws > 0
+                    and ws / base_ws > WARM_START_GROWTH
+                ):
+                    wasteful.append(
+                        "%s warm start %.1fs vs %.1fs baseline (x%.1f "
+                        "bound) — disk-warmed cold start degraded"
+                        % (name, ws, base_ws, WARM_START_GROWTH)
+                    )
+        if wasteful and v["verdict"] in (
+            "steady", "improved", "baseline", "unknown"
+        ):
+            v["verdict"] = "padding-waste-regression"
+            sep = "; " if v["reason"] else ""
+            v["reason"] += sep + "; ".join(wasteful)
         verdicts.append(v)
     return verdicts
 

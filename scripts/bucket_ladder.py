@@ -68,10 +68,37 @@ def main() -> int:
     ap.add_argument(
         "--json", action="store_true", help="machine-readable output"
     )
+    ap.add_argument(
+        "--emit", metavar="PATH", default=None,
+        help="write the census-tuned ladder as a JSON file the engine "
+        "loads at startup (the padding_ladder_file session property)",
+    )
     args = ap.parse_args()
 
     census = load_census(args)
     rec = recommend_ladder(census, max_rungs=args.rungs, lane=args.lane)
+    if args.emit:
+        if not rec["observations"]:
+            print("refusing to emit an empty ladder (no census "
+                  "observations)", file=sys.stderr)
+            return 1
+        doc = {
+            "ladder": rec["ladder"],
+            "lane": args.lane,
+            "wasteRatio": rec["wasteRatio"],
+            "observations": rec["observations"],
+            "source": "census",
+        }
+        # atomic write: a worker booting mid-emit must read the old
+        # ladder or the new one, never a torn file
+        tmp = args.emit + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+        os.replace(tmp, args.emit)
+        print(f"wrote {args.emit}: {len(rec['ladder'])} rungs, "
+              f"predicted waste {rec['wasteRatio']:.3f}x")
+        if not args.json:
+            return 0
     if args.json:
         print(json.dumps(rec, indent=2, sort_keys=True))
         return 0 if rec["observations"] else 1

@@ -16,7 +16,11 @@ and fails when:
     compile: after the warm-up window every kernel family the serve mix
     presents has been traced, so a shape-miss retrace in steady state
     means the padding buckets stopped absorbing real traffic (each one
-    is many milliseconds of compile on the query path).
+    is many milliseconds of compile on the query path), or
+  * any kernel family compiled more distinct programs than the padding
+    ladder has rungs — the bucketed-batch ABI's whole contract is that
+    program counts are bounded by ladder size, so exceeding it means a
+    capacity leaked around the ladder's quantize.
 
 Exit 0 with a one-line summary on success, 1 with the reason otherwise.
 """
@@ -82,10 +86,34 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    max_prog = result.get("max_programs_per_family")
+    ladder_size = result.get("ladder_size")
+    if max_prog is None or ladder_size is None:
+        print(
+            "serve smoke: compiled-programs-per-family accounting missing "
+            f"(max_programs_per_family={max_prog}, "
+            f"ladder_size={ladder_size}) — the bench stopped measuring "
+            "the bucketed-batch ABI's program bound",
+            file=sys.stderr,
+        )
+        return 1
+    if int(ladder_size) > 0 and int(max_prog) > int(ladder_size):
+        worst = sorted(
+            (result.get("programs_per_family") or {}).items(),
+            key=lambda kv: -kv[1],
+        )[:3]
+        print(
+            f"serve smoke: a kernel family compiled {max_prog} distinct "
+            f"programs but the padding ladder only has {ladder_size} "
+            f"rungs — a capacity is bypassing the ladder (worst: {worst})",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"serve smoke ok: {done} queries across {len(tenants)} tenants, "
         f"qps={result.get('qps')}, shed={result.get('shed_total')}, "
-        f"0 failed, 0 steady-state shape-miss compiles"
+        f"0 failed, 0 steady-state shape-miss compiles, "
+        f"max programs/family {max_prog} <= ladder {ladder_size}"
     )
     return 0
 

@@ -8,6 +8,8 @@ Aggregates the source linters:
   - ``check_session_props.py``   — session-property hygiene
   - ``check_donation.py``        — hot-path jits declare donation (or a
     ``# no-donate:`` reason); pallas kernels are registry-attributed
+  - ``check_pad_discipline.py``  — all shape padding quantizes through
+    trino_tpu/exec/shapes.py (no ad-hoc next-multiple-of-128)
 
 Exit code is non-zero when ANY linter fails; each linter's own output is
 printed under a header.  Wired into tier-1 via tests/test_lint.py, so a
@@ -23,6 +25,7 @@ sys.path.insert(0, os.path.dirname(__file__))
 import check_dispatch_guard  # noqa: E402
 import check_donation  # noqa: E402
 import check_metric_names  # noqa: E402
+import check_pad_discipline  # noqa: E402
 import check_session_props  # noqa: E402
 
 LINTERS = (
@@ -30,6 +33,7 @@ LINTERS = (
     ("check_metric_names", check_metric_names),
     ("check_session_props", check_session_props),
     ("check_donation", check_donation),
+    ("check_pad_discipline", check_pad_discipline),
 )
 
 

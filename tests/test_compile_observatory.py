@@ -351,6 +351,7 @@ def _healthy_result(**over):
         "tenants": {"interactive": {"ok": 5, "p99_ms": 10.0}},
         "fairness": {"starts_per_weight": {"interactive": 1.2}},
         "steady_state_shape_miss_compiles": 0,
+        "ladder_size": 24, "max_programs_per_family": 2,
         "qps": 5.0, "shed_total": 0,
     }
     base.update(over)
@@ -367,6 +368,24 @@ def test_check_serve_smoke_asserts_zero_steady_shape_miss():
     r = _gate(_healthy_result(steady_state_shape_miss_compiles=2))
     assert r.returncode == 1
     assert "steady-state shape-miss" in r.stderr
+
+
+def test_check_serve_smoke_bounds_programs_per_family():
+    """The bucketed-batch ABI gate: compiled programs per kernel family
+    must stay within the padding ladder, and the accounting itself must
+    be present in the artifact."""
+    missing = _healthy_result()
+    del missing["max_programs_per_family"]
+    r = _gate(missing)
+    assert r.returncode == 1
+    assert "programs-per-family accounting missing" in r.stderr
+    r = _gate(_healthy_result(max_programs_per_family=25, ladder_size=24))
+    assert r.returncode == 1
+    assert "bypassing the ladder" in r.stderr
+    # ladder off (size 0) disables the bound, not the presence check
+    assert _gate(
+        _healthy_result(ladder_size=0, max_programs_per_family=99)
+    ).returncode == 0
 
 
 @pytest.mark.slow

@@ -1,0 +1,297 @@
+"""Bucketed-batch ABI tests: the engine-wide padding ladder.
+
+Every fragment input shape quantizes through one PaddingLadder before
+tracing (exec/shapes.py), so arbitrary split sizes collapse onto a
+bounded set of compiled programs per kernel family.  Covered here:
+
+  - rung arithmetic: geometric ladder, quantize at rung boundaries
+    (n == rung, n == rung + 1), lane alignment, off mode, doubling
+    continuation above the top rung;
+  - spec/file plumbing: parse_ladder_spec modes, bucket_ladder.py
+    --emit -> load_ladder_file -> engine (padding_ladder_file) round
+    trip;
+  - correctness: Q1/Q3/Q6 byte-identical with the ladder ON vs OFF on
+    the local and mesh paths, and matching the sqlite oracle — masks
+    and row counts make the answer independent of the rung chosen;
+  - the headline bound: a randomized split-size storm compiles at most
+    ladder-size distinct shapes;
+  - disk-warmed cold start: CompileCache.prewarm streams artifacts and
+    seeds the observatory so a boot retrace never classifies as a
+    steady-state shape miss.
+"""
+import json
+import os
+import random
+import sqlite3
+import subprocess
+import sys
+
+import jax
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from tpch_sql import QUERIES, oracle_dialect
+
+from trino_tpu.cache.compile_cache import CompileCache, _key_buckets
+from trino_tpu.exec.shapes import (
+    DEFAULT_LANE,
+    PaddingLadder,
+    ladder_waste,
+    lane_align,
+    load_ladder_file,
+    parse_ladder_spec,
+    resolve_ladder,
+)
+from trino_tpu.obs import compile_observatory as co
+from trino_tpu.parallel.mesh_executor import MeshExecutor, default_mesh
+from trino_tpu.session import tpch_session
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SF = 0.001
+_TABLES = ("lineitem", "orders", "customer")
+
+
+# --- rung arithmetic -----------------------------------------------------
+
+
+def test_geometric_ladder_shape():
+    ladder = PaddingLadder.geometric()
+    assert ladder.size() == len(ladder.rungs) > 0
+    assert ladder.rungs[0] == DEFAULT_LANE
+    for a, b in zip(ladder.rungs, ladder.rungs[1:]):
+        assert b == 2 * a
+    assert all(r % DEFAULT_LANE == 0 for r in ladder.rungs)
+
+
+def test_quantize_rung_boundaries():
+    ladder = PaddingLadder.geometric()
+    for i, rung in enumerate(ladder.rungs[:8]):
+        # n == rung sits exactly on the rung — no rounding up
+        assert ladder.quantize(rung) == rung
+        # n == rung + 1 must take the NEXT rung (the off-by-one that
+        # would silently corrupt the last row if it rounded down)
+        nxt = ladder.rungs[i + 1]
+        assert ladder.quantize(rung + 1) == nxt
+        assert ladder.quantize(rung - 1) == rung
+    assert ladder.quantize(0) == ladder.rungs[0]
+    assert ladder.quantize(1) == ladder.rungs[0]
+
+
+def test_quantize_continues_doubling_above_top():
+    ladder = PaddingLadder([256, 1024])
+    assert ladder.quantize(1024) == 1024
+    assert ladder.quantize(1025) == 2048
+    assert ladder.quantize(5000) == 8192
+    q = ladder.quantize(3_000_000)
+    assert q >= 3_000_000 and q % DEFAULT_LANE == 0
+
+
+def test_explicit_rungs_lane_aligned_sorted_deduped():
+    ladder = PaddingLadder([300, 100, 300])
+    assert ladder.rungs == (128, 384)
+    assert ladder.quantize(129) == 384
+
+
+def test_off_mode_is_plain_lane_align():
+    off = parse_ladder_spec("off")
+    assert off.size() == 0
+    assert off.quantize(1) == 128
+    assert off.quantize(128) == 128
+    assert off.quantize(129) == lane_align(129) == 256
+    assert off.quantize(6001215) == lane_align(6001215)
+
+
+def test_waste_ratio():
+    ladder = PaddingLadder.geometric()
+    assert ladder.waste(129) == pytest.approx(256 / 129)
+    assert ladder.waste(128) == pytest.approx(1.0)
+
+
+def test_ladder_waste_observation_weighted():
+    ladder = PaddingLadder.geometric()
+    w = ladder_waste([(100, 3), (129, 1)], ladder)
+    assert w["observations"] == 4
+    assert w["geomean"] >= 1.0
+    assert w["mean"] >= 1.0
+    # padding 100 -> 128 and 129 -> 256: both ratios bounded by 2x
+    assert w["geomean"] <= 2.0
+
+
+# --- spec / file plumbing ------------------------------------------------
+
+
+def test_parse_ladder_spec_modes():
+    for spec in ("", "geometric", "auto", "on", "default"):
+        assert parse_ladder_spec(spec).size() > 0
+    for spec in ("off", "none", "lane"):
+        assert parse_ladder_spec(spec).size() == 0
+    explicit = parse_ladder_spec("256, 1024, 4096")
+    assert explicit.rungs == (256, 1024, 4096)
+    for bad in ("totally-bogus", "12,abc", "256;1024"):
+        with pytest.raises(ValueError):
+            parse_ladder_spec(bad)
+
+
+def test_emit_roundtrip_census_to_engine(tmp_path):
+    # a census snapshot the bucket_ladder CLI can read
+    census_file = tmp_path / "census.json"
+    census_file.write_text(json.dumps({
+        "families": {
+            "agg": {
+                "count": 6, "minRows": 100, "maxRows": 9000,
+                "totalRows": 20000,
+                "buckets": {"128": 3, "8192": 3},
+            },
+        },
+    }))
+    ladder_file = tmp_path / "ladder.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bucket_ladder.py"),
+         "--census-file", str(census_file), "--emit", str(ladder_file)],
+        capture_output=True, text=True, cwd=ROOT,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert ladder_file.exists()
+
+    ladder = load_ladder_file(str(ladder_file))
+    assert ladder.size() > 0
+    assert ladder.rungs == tuple(sorted(set(ladder.rungs)))
+    assert all(r % DEFAULT_LANE == 0 for r in ladder.rungs)
+
+    # the engine loads the same rungs through the session property
+    resolved = resolve_ladder({"padding_ladder_file": str(ladder_file)})
+    assert resolved.rungs == ladder.rungs
+    assert str(ladder_file) in resolved.source
+
+    s = tpch_session(SF, padding_ladder_file=str(ladder_file))
+    assert s.execute("select count(*) from nation").to_pylist() == [(25,)]
+    assert s._ladder_cache is not None
+    assert s._ladder_cache[1].rungs == ladder.rungs
+
+
+def test_ladder_file_fallback_on_missing_file(tmp_path):
+    # an unreadable ladder file must degrade to the spec, not crash boot
+    resolved = resolve_ladder({
+        "padding_ladder_file": str(tmp_path / "nope.json"),
+        "padding_ladder": "geometric",
+    })
+    assert resolved.rungs == PaddingLadder.geometric().rungs
+
+
+# --- correctness: byte parity ladder ON vs OFF vs oracle -----------------
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, _TABLES)
+    return conn
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 6])
+def test_ladder_byte_parity_local(qnum, oracle_conn):
+    sql, oracle_sql, ordered, skip = QUERIES[qnum]
+    if skip:
+        pytest.skip(skip)
+    on = tpch_session(SF).execute(sql).to_pylist()
+    off = tpch_session(SF, padding_ladder="off").execute(sql).to_pylist()
+    # masks + row counts make the rung choice invisible: byte-identical
+    assert on == off
+    expected = oracle_conn.execute(
+        oracle_sql or oracle_dialect(sql)
+    ).fetchall()
+    assert_rows_match(on, expected, tol=2e-2, ordered=ordered)
+
+
+@pytest.mark.parametrize("qnum", [1, 3, 6])
+def test_ladder_byte_parity_mesh(qnum):
+    sql, _oracle_sql, ordered, skip = QUERIES[qnum]
+    if skip:
+        pytest.skip(skip)
+    assert len(jax.devices()) >= 8, "conftest must provide 8 devices"
+    s_on = tpch_session(SF)
+    on = MeshExecutor(
+        s_on.catalogs, default_mesh(8)
+    ).execute(s_on.plan(sql)).to_pylist()
+    s_off = tpch_session(SF)
+    off = MeshExecutor(
+        s_off.catalogs, default_mesh(8), {"padding_ladder": "off"}
+    ).execute(s_off.plan(sql)).to_pylist()
+    assert on == off
+
+
+# --- the headline bound: bounded programs under a split-size storm -------
+
+
+def test_bounded_rungs_under_randomized_split_storm():
+    ladder = PaddingLadder.geometric()
+    rng = random.Random(20260805)
+    sizes = [rng.randint(1, 3_000_000) for _ in range(10_000)]
+    rungs = {ladder.quantize(n) for n in sizes}
+    # however many distinct split sizes traffic presents, the compiled
+    # shape set stays within the ladder
+    assert len(rungs) <= ladder.size()
+    assert all(ladder.quantize(n) >= n for n in sizes)
+
+
+def test_executor_shape_sigs_bounded():
+    # the executor-level version of the storm: the eager/mesh shape
+    # signature (what the observatory sees) collapses onto the ladder
+    from trino_tpu.exec.local import LocalExecutor
+
+    s = tpch_session(SF)
+    ex = LocalExecutor(s.catalogs, {})
+    rng = random.Random(7)
+    sigs = {
+        ex._compile_shape_sig({0: rng.randint(1, 500_000)})
+        for _ in range(2_000)
+    }
+    assert len(sigs) <= ex.ladder.size()
+
+
+# --- disk-warmed cold start ----------------------------------------------
+
+
+def test_compile_cache_prewarm(tmp_path):
+    cc = CompileCache()
+    cc._index = {
+        "a" * 64: {"fp": "fp1", "buckets": [256, 4096]},
+        "b" * 64: {"fp": "fp2", "buckets": [256]},
+    }
+    (tmp_path / "xla_blob").write_bytes(b"z" * 4096)
+    r = cc.prewarm(str(tmp_path))
+    assert r["entries"] == 2
+    assert r["families"] == 2
+    assert r["rungShapes"] == [256, 4096]
+    assert r["bytesPreloaded"] >= 4096
+    assert cc.last_prewarm == r
+    # idempotent per directory: a second boot against the same dir no-ops
+    assert cc.prewarm(str(tmp_path)) is None
+
+
+def test_seed_family_boot_retrace_is_not_a_shape_miss():
+    obs = co.CompileObservatory(family_cold_s=5.0)
+    obs.seed_family("fam1", "sigA")
+    # re-tracing an indexed program right after boot: cold, not a miss
+    assert obs.classify("fam1", "sigA") == co.FIRST_COMPILE
+    # even a new shape inside the cold window gets the boot grace
+    assert obs.classify("fam1", "sigB") == co.FIRST_COMPILE
+    # after the window, the seeded shape is still known...
+    obs._family_intro["fam1"] = ("__prewarm__", 0.0)
+    assert obs.classify("fam1", "sigA") == co.FIRST_COMPILE
+    # ...but a genuinely new shape in the warm family IS a retrace
+    assert obs.classify("fam1", "sigC") == co.SHAPE_MISS
+
+
+def test_key_buckets_found_by_shape_not_position():
+    # the per-scan component is found by structure even with marker
+    # components appended after it (the index.json rung provenance that
+    # prewarm reports came back empty before this)
+    scans = ((0, 256, "tpch:lineitem"), (1, 4096, "tpch:orders"))
+    key = (
+        "fp", 4096, 1, 1, 1, 0, False, frozenset(), frozenset(), scans,
+        ("donate", True, (0,)), ("megakernels", "off"),
+    )
+    assert _key_buckets(key) == [256, 4096]
+    assert _key_buckets(("fp", 1, 2)) == []
+    assert _key_buckets("not-a-tuple") == []

@@ -115,6 +115,27 @@ def fragment_key(ex, plan, scans, counts, pad_capacity):
     return key, order, by_ord
 
 
+def _key_buckets(key) -> list:
+    """The padded shape buckets (ladder rungs) baked into a jit key.
+
+    The per-scan component is the one element that is a tuple of
+    ``(ordinal, rung, scan-identity)`` tuples — executors may append
+    further marker components (``("donate", ...)``, ``("megakernels",
+    ...)``) after it, so it is found by shape, not position."""
+    if not isinstance(key, tuple):
+        return []
+    for comp in reversed(key):
+        if (
+            isinstance(comp, tuple) and comp
+            and all(
+                isinstance(c, tuple) and len(c) > 1
+                and isinstance(c[1], int) for c in comp
+            )
+        ):
+            return [int(c[1]) for c in comp]
+    return []
+
+
 class CompileCache:
     """LRU of compiled fragment entries ({"fn", "cell", "plan"}) exposing
     the dict surface the executor uses, with hit/miss/eviction accounting
@@ -132,6 +153,7 @@ class CompileCache:
         self.evictions = 0
         self.poison_evictions = 0
         self.persistent_hits = 0
+        self.last_prewarm: Optional[dict] = None
         self.max_entries = int(max_entries)
 
     # -- persistent tier -------------------------------------------------
@@ -158,6 +180,83 @@ class CompileCache:
                 pass
         self._persistent_dir = cache_dir
         self._index = self._load_index()
+
+    def prewarm(self, cache_dir: str) -> Optional[dict]:
+        """Cold-start prewarm of the persistent tier (once per directory).
+
+        Two honest effects — no executables can be conjured without their
+        plans, so this does exactly what a cold worker CAN do before the
+        first query:
+
+        - stream every cached artifact through a read so the XLA
+          executables are in the OS page cache when the first re-trace
+          asks for them (the disk read leaves the query path);
+        - seed the compile observatory's family registry with every
+          (family, kernel-digest) pair in the index, so the boot burst of
+          re-traces classifies as ``persistent_load``/``first_compile``
+          and the zero-retrace serve gate stays meaningful across
+          restarts.
+
+        Returns ``{"entries", "families", "rungShapes", "bytesPreloaded",
+        "wallS"}`` for the bench's warm-start accounting, or None when
+        already warmed / nothing to warm."""
+        import time
+
+        cache_dir = os.path.abspath(cache_dir)
+        with self._lock:
+            warmed = getattr(self, "_prewarmed_dirs", None)
+            if warmed is None:
+                warmed = self._prewarmed_dirs = set()
+            if cache_dir in warmed:
+                return None
+            warmed.add(cache_dir)
+        t0 = time.perf_counter()
+        from ..obs import compile_observatory as _co
+
+        obs = _co.get_observatory()
+        families = set()
+        rungs = set()
+        for digest, rec in list(self._index.items()):
+            fp = rec.get("fp")
+            if fp is None:
+                continue
+            family = stable_key_digest(("family", fp))[:12]
+            families.add(family)
+            obs.seed_family(family, str(digest)[:12])
+            for b in rec.get("buckets") or ():
+                try:
+                    rungs.add(int(b))
+                except (TypeError, ValueError):
+                    pass
+        preloaded = 0
+        try:
+            names = os.listdir(cache_dir)
+        except OSError:
+            names = []
+        for name in names:
+            if name.startswith("index.json"):
+                continue
+            path = os.path.join(cache_dir, name)
+            if not os.path.isfile(path):
+                continue
+            try:
+                with open(path, "rb") as f:
+                    while True:
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        preloaded += len(chunk)
+            except OSError:
+                continue
+        result = {
+            "entries": len(self._index),
+            "families": len(families),
+            "rungShapes": sorted(rungs),
+            "bytesPreloaded": preloaded,
+            "wallS": time.perf_counter() - t0,
+        }
+        self.last_prewarm = result
+        return result
 
     def _index_path(self) -> str:
         return os.path.join(self._persistent_dir, "index.json")
@@ -187,15 +286,9 @@ class CompileCache:
         digest = stable_key_digest(key)
         rec = self._index.get(digest)
         if rec is None:
-            buckets = []
-            if isinstance(key, tuple) and key and isinstance(key[-1], tuple):
-                buckets = [
-                    c[1] for c in key[-1]
-                    if isinstance(c, tuple) and len(c) > 1
-                ]
             self._index[digest] = {
                 "fp": key[0] if isinstance(key, tuple) and key else None,
-                "buckets": buckets,
+                "buckets": _key_buckets(key),
                 "seen": 1,
             }
         else:

@@ -36,9 +36,12 @@ _SEP = "\x1f"
 
 def shape_bucket(n: int) -> int:
     """Padded-shape bucket for compile-cache keys: next multiple of 128
-    (TPU lane width) — must agree with exec.local._pad_capacity so in-memory
-    and persistent keys coincide."""
-    return max(128, ((int(n) + 127) // 128) * 128)
+    (TPU lane width) — delegates to exec.shapes.lane_align so in-memory
+    and persistent keys coincide.  Note the jit key quantizes through the
+    executor's PaddingLadder; this is the ladder-off floor."""
+    from ..exec.shapes import lane_align
+
+    return lane_align(int(n))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -35,6 +35,16 @@ def _retry_policy(s: str) -> str:
     return v
 
 
+def _padding_ladder(s: str) -> str:
+    """Validate (but keep as string) the bucketed-batch ABI spec: the
+    executor resolves it to an exec.shapes.PaddingLadder lazily so SET
+    SESSION stays import-light."""
+    from .exec.shapes import parse_ladder_spec
+
+    parse_ladder_spec(str(s))  # raises ValueError on a bad spec
+    return str(s).strip().lower()
+
+
 def _megakernels(s: str) -> str:
     v = str(s).strip().lower()
     if v not in ("auto", "on", "off"):
@@ -386,6 +396,29 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             "(jax persistent compilation cache + fragment index); "
             "empty = in-memory only",
             str, "",
+        ),
+        PropertyMetadata(
+            "padding_ladder",
+            "bucketed-batch ABI rungs every padded capacity quantizes "
+            "onto before tracing: geometric (128*2^k, the default) | "
+            "off (legacy next-multiple-of-128) | explicit "
+            "comma-separated rung list",
+            _padding_ladder, "geometric",
+        ),
+        PropertyMetadata(
+            "padding_ladder_file",
+            "census-tuned ladder JSON written by scripts/bucket_ladder.py "
+            "--emit; when set (and readable) it overrides padding_ladder; "
+            "empty = use the padding_ladder spec",
+            str, "",
+        ),
+        PropertyMetadata(
+            "compile_prewarm",
+            "at session/worker boot with compile_cache_dir set, pre-warm "
+            "the persistent tier's indexed rung shapes (page-cache reads "
+            "+ observatory family seeding) so cold restarts reach "
+            "zero-retrace steady state without shape-miss classification",
+            _bool, True,
         ),
         PropertyMetadata(
             "device_generation",

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import shapes
 from .. import types as T
 from ..catalog import CatalogManager, Metadata
 from ..expr import ir
@@ -181,8 +182,13 @@ def _contains_host_aggs(plan: P.PlanNode) -> bool:
     )
 
 def _pad_capacity(n: int) -> int:
-    """Static tile capacity: next multiple of 128 (TPU lane width)."""
-    return max(128, ((n + 127) // 128) * 128)
+    """Static tile capacity: next multiple of 128 (TPU lane width).
+
+    Back-compat alias of :func:`shapes.lane_align`; executor paths
+    quantize through ``self.ladder`` (the bucketed-batch ABI) instead,
+    so arbitrary row counts collapse onto a bounded set of shapes.
+    """
+    return shapes.lane_align(n)
 
 
 class _LazyDeviceLane:
@@ -315,6 +321,16 @@ class LocalExecutor:
         self.metadata = Metadata(catalogs)
         self.config = config or {}
         self.query_id = str(self.config.get("query_id", "query"))
+        # the bucketed-batch ABI: every padded capacity in this executor
+        # quantizes through one ladder, so split sizes collapse onto a
+        # bounded set of compiled shapes per kernel family.  The session
+        # resolves the ladder once and shares the object via config;
+        # bare executors (tests) resolve from the spec/file props here.
+        self.ladder = shapes.resolve_ladder(self.config)
+        # scan-node id -> capacity actually dispatched (ladder rung after
+        # scan_cap_override): kernel profile + bandwidth ledger report
+        # padded bytes from these, never from recomputed lane alignment
+        self._scan_caps: Dict[int, int] = {}
         self.scan_bytes = 0
         # EXPLAIN ANALYZE: id(plan node) -> {rows, bytes, wall_s,
         # device_wall_s, calls} (OperatorStats analog, filled when
@@ -470,15 +486,25 @@ class LocalExecutor:
 
     # -- HBM bandwidth ledger ------------------------------------------
     def _ledger_input_bytes(self, scans) -> int:
-        """Unpadded host bytes fed to the program: the scan (and merged
-        exchange) arrays as loaded, before capacity padding — comparable
-        to hand-computed scan bytes for the fragment."""
+        """Padded host bytes fed to the program: the scan (and merged
+        exchange) arrays scaled to the ladder rung each scan actually
+        dispatched at (recorded by `_device_lanes`), so the ledger's
+        GB/s agrees with the buffers XLA really moved — and with the
+        padding ratios the observatory census reports."""
         total = 0
-        for arrays in scans.values():
+        for nid, arrays in scans.items():
+            rows = max(
+                (int(getattr(v, "shape", (0,))[0] or 0)
+                 for v, _ok in arrays.values() if hasattr(v, "shape")),
+                default=0,
+            )
+            cap = self._scan_caps.get(nid)
+            scale = (int(cap) / rows) if (cap and rows) else 1.0
             for v, ok in arrays.values():
-                total += int(getattr(v, "nbytes", 0) or 0)
+                nb = int(getattr(v, "nbytes", 0) or 0)
                 if ok is not None:
-                    total += int(getattr(ok, "nbytes", 0) or 0)
+                    nb += int(getattr(ok, "nbytes", 0) or 0)
+                total += int(nb * scale)
         return total
 
     def _ledger_bracket(self, out, digest, mode, plan, scans, start):
@@ -692,10 +718,7 @@ class LocalExecutor:
                             actual_rows=sum(
                                 int(c) for c in counts.values()
                             ),
-                            padded_rows=sum(
-                                _pad_capacity(int(c))
-                                for c in counts.values()
-                            ),
+                            padded_rows=self._padded_rows(counts),
                             compile_wall_s=time.time() - eager_start,
                             query_id=self.query_id,
                             task_id=str(
@@ -1278,7 +1301,7 @@ class LocalExecutor:
                 spec["table"], cols, int(spec["lo"]), int(spec["hi"]), cap,
                 float(spec["sf"]), int(spec["count"]),
                 cap_orders=(
-                    _pad_capacity(span)
+                    self.ladder.quantize(span)
                     if spec["table"] == "lineitem" else None
                 ),
             ),
@@ -1292,7 +1315,7 @@ class LocalExecutor:
         host->HBM transfer dominates when the TPU is tunnel-attached).
         `nid` keys the scan-keys table for node-less sources (streaming
         RemoteSource inputs, cached per run)."""
-        cap = _pad_capacity(count)
+        cap = self.ladder.quantize(count)
         override = int(self.config.get("scan_cap_override") or 0)
         if override and isinstance(node, P.TableScan):
             cap = max(cap, override)
@@ -1301,6 +1324,11 @@ class LocalExecutor:
         ) or getattr(self, "_streaming_cache", None)
         if nid is None and node is not None:
             nid = id(node)
+        if nid is not None:
+            # the rung actually dispatched — kernel profile and the
+            # bandwidth ledger read padded bytes from here, so EXPLAIN
+            # ANALYZE ratios match the observatory census
+            self._scan_caps[nid] = cap
         # lanes staged ahead by FragmentExecutor.preupload (prefetch
         # thread): consume them instead of re-uploading.  Donatability
         # was recorded when they were staged.
@@ -1422,7 +1450,7 @@ class LocalExecutor:
         # segment op pays O(capacity).  Cap the first try; the overflow
         # ladder (x8 per rung) covers genuinely huge group counts with one
         # recompile instead of every query paying worst-case capacity.
-        return _pad_capacity(min(best * 2, max_rows, 1 << 18))
+        return self.ladder.quantize(min(best * 2, max_rows, 1 << 18))
 
     # ------------------------------------------------------------------
     def _compile_family(self, plan) -> str:
@@ -1437,15 +1465,28 @@ class LocalExecutor:
             fp = id(plan)
         return stable_key_digest(("family", fp))[:12]
 
-    @staticmethod
-    def _compile_shape_sig(counts) -> str:
-        """Padded-bucket signature of one execution's scan shapes (the
+    def _compile_shape_sig(self, counts) -> str:
+        """Ladder-rung signature of one execution's scan shapes (the
         eager/mesh analog of the jit key's per-scan bucket component)."""
         from ..cache.compile_cache import stable_key_digest
 
         return stable_key_digest(tuple(sorted(
-            _pad_capacity(int(c)) for c in counts.values()
+            self.ladder.quantize(int(c)) for c in counts.values()
         )))[:12]
+
+    def _dispatched_cap(self, nid, count: int) -> int:
+        """The padded capacity actually dispatched for one scan: the
+        recorded rung when `_device_lanes` ran (includes any
+        scan_cap_override), the ladder's rung otherwise."""
+        cap = self._scan_caps.get(nid)
+        return int(cap) if cap else self.ladder.quantize(int(count))
+
+    def _padded_rows(self, counts) -> int:
+        """Total dispatched padded rows across the fragment's scans —
+        what the observatory census and EXPLAIN ANALYZE both report."""
+        return sum(
+            self._dispatched_cap(nid, int(c)) for nid, c in counts.items()
+        )
 
     def _record_kernel(
         self, digest: str, compile_s: float, cached: bool, mode: str = "jit",
@@ -1497,11 +1538,11 @@ class LocalExecutor:
         """Fill the profile summary once the fragment settles: padding
         waste and estimated host<->device transfer volume."""
         actual = sum(int(c) for c in counts.values())
-        padded = sum(_pad_capacity(int(c)) for c in counts.values())
+        padded = self._padded_rows(counts)
         h2d = 0
         for nid, arrays in scans.items():
             count = max(int(counts.get(nid, 1)), 1)
-            scale = _pad_capacity(count) / count
+            scale = self._dispatched_cap(nid, count) / count
             for v, ok in arrays.values():
                 nb = int(v.nbytes) + (int(ok.nbytes) if ok is not None else 0)
                 h2d += int(nb * scale)
@@ -1574,7 +1615,7 @@ class LocalExecutor:
         from ..cache.compile_cache import fragment_key, stable_key_digest
 
         key, order, by_ord = fragment_key(
-            self, plan, scans, counts, _pad_capacity
+            self, plan, scans, counts, self.ladder.quantize
         )
         # prep is keyed by plan ordinal, NOT id(node): dict keys are part
         # of the jit pytree structure, so id-based keys would force a
@@ -1690,9 +1731,7 @@ class LocalExecutor:
             )
             shapes = _shape_summary(prep)
             actual_rows = sum(int(c) for c in counts.values())
-            padded_rows = sum(
-                _pad_capacity(int(c)) for c in counts.values()
-            )
+            padded_rows = self._padded_rows(counts)
             with TRACER.span(
                 "xla_compile", fragment=digest, cause=cause,
                 shapeSig=";".join(
@@ -1866,7 +1905,7 @@ class _TraceCtx:
     # -- leaves ---------------------------------------------------------
     def _visit_tablescan(self, node: P.TableScan) -> Batch:
         count = self.counts[id(node)]
-        cap = _pad_capacity(count)
+        cap = self.ex.ladder.quantize(count)
         override = int(self.ex.config.get("scan_cap_override") or 0)
         if override and isinstance(node, P.TableScan):
             # streaming tiles share one padded shape (and therefore one
@@ -1885,7 +1924,7 @@ class _TraceCtx:
 
     def _visit_values(self, node: P.Values) -> Batch:
         n = len(node.rows)
-        cap = _pad_capacity(max(n, 1))
+        cap = self.ex.ladder.quantize(max(n, 1))
         lanes = {}
         tmap = dict(node.types_)
         for sym, d in getattr(node, "dicts", ()):
@@ -1946,7 +1985,7 @@ class _TraceCtx:
         ):
             return b
         factor = getattr(self.ex, "compact_factor", 1)
-        cap = _pad_capacity(int(est * 1.3) * factor)
+        cap = self.ex.ladder.quantize(int(est * 1.3) * factor)
         n = b.sel.shape[0]
         if cap >= n:
             return b
@@ -2032,7 +2071,7 @@ class _TraceCtx:
         )
         eff = np.maximum(lengths, 1) if node.outer else lengths
         total = int(eff.sum())
-        cap = _pad_capacity(max(total, 1))
+        cap = self.ex.ladder.quantize(max(total, 1))
         rep = np.repeat(rows, eff)  # source row per output row
         elems: list = []
         for c, ok, ln in zip(codes, avalid, lengths):
@@ -2173,7 +2212,7 @@ class _TraceCtx:
                 out_rows.append(m)
             i = j
         total = len(out_rows)
-        cap = _pad_capacity(max(total, 1))
+        cap = self.ex.ladder.quantize(max(total, 1))
         out_types = node.output_types()
         lanes = {}
         from ..page import column_from_pylist
@@ -2337,7 +2376,7 @@ class _TraceCtx:
             lanes[k] = kl
         for s in out:
             lanes[s] = out[s]
-        pad_cap = _pad_capacity(cap)
+        pad_cap = self.ex.ladder.quantize(cap)
         if pad_cap != cap:
             from ..ops.wide_decimal import pad_rows
 
@@ -2547,7 +2586,7 @@ class _TraceCtx:
             )
         outer = node.kind == "left"
         probe_cap = left.sel.shape[0]
-        capacity = _pad_capacity(
+        capacity = self.ex.ladder.quantize(
             int(probe_cap * getattr(self.ex, "join_factor", 1))
         )
         probe_row, build_row, matched, total, k = join_ops.expand_join_slots(
@@ -2760,7 +2799,7 @@ class _TraceCtx:
         build = join_ops.build_multi(bkey, filt.sel)
         counts, lo = join_ops.probe_counts(build, pkey, src.sel)
         n_src = src.sel.shape[0]
-        capacity = _pad_capacity(
+        capacity = self.ex.ladder.quantize(
             int(n_src * getattr(self.ex, "join_factor", 1))
         )
         probe_row, build_row, matched, total, _ = join_ops.expand_join_slots(

@@ -271,6 +271,10 @@ def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Pag
 
     def tile_config() -> dict:
         cfg = dict(executor.config)
+        # tiles quantize on the parent's resolved ladder object — not a
+        # re-parse of the spec — so a census-tuned ladder file read at
+        # session start governs every tile of the run identically
+        cfg["padding_ladder"] = executor.ladder
         # the per-query pool would double-count across tiles, and
         # spill-in-tile would recurse — but the LIMIT stays enforced:
         # when split granularity cannot realize the planned tile count
@@ -309,9 +313,10 @@ def execute_streaming(executor, plan: P.Output, frags, memory_limit: int) -> Pag
             except Exception:  # noqa: BLE001
                 rows = 0
             est_tile_rows = int(rows * per / max(len(splits), 1) * 1.3)
-            from .local import _pad_capacity
-
-            est_tile_rows = _pad_capacity(max(est_tile_rows, 128))
+            # quantize the shared tile shape onto the executor's ladder:
+            # tiles from different table sizes / split factors land on
+            # the same rung and reuse one compiled program engine-wide
+            est_tile_rows = executor.ladder.quantize(max(est_tile_rows, 128))
             tile_starts = list(range(0, len(splits), per))
 
             def make_loaded(i: int) -> FragmentExecutor:

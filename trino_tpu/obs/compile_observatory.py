@@ -48,6 +48,7 @@ from .journal import (  # the proven segment shape; one implementation
     MIN_SEGMENT_BYTES,
     _Segment,
 )
+from ..exec.shapes import lane_align as _lane_align
 
 # lowerCamelCase wire schema, linted by scripts/check_metric_names.py
 COMPILE_FIELDS = (
@@ -119,8 +120,10 @@ def _new_compile_id() -> int:
 
 def _pow2_bucket(rows: int) -> int:
     """The padding bucket a row count falls in: next power of two >= rows
-    (floor 128, the TPU lane width — matching exec/local._pad_capacity's
-    floor so census buckets and real padded shapes stay comparable)."""
+    (floor 128, the TPU lane width — matching exec/shapes.lane_align's
+    floor so census buckets and real padded shapes stay comparable).
+    These are exactly the geometric PaddingLadder's rungs, so census
+    sketches double as ladder-occupancy histograms."""
     rows = max(int(rows), 1)
     b = 128
     while b < rows:
@@ -343,6 +346,15 @@ class CompileObservatory:
                 )
             if len(seen) < 256:
                 seen.add(str(shape_sig))
+
+    def seed_family(self, family: str, shape_sig: str) -> None:
+        """Boot-time prewarm hook (CompileCache.prewarm): register a
+        family/shape pair from the persistent-tier index WITHOUT a
+        compile event.  Seeded families get the normal cold-window
+        grace, so the first post-restart traffic that re-traces indexed
+        programs classifies persistent_load / first_compile — a cold
+        boot must never look like a retrace storm."""
+        self._register(str(family), str(shape_sig), query_id="__prewarm__")
 
     # -- record ---------------------------------------------------------
     def record(
@@ -815,13 +827,13 @@ def recommend_ladder(
     for cover in covers:
         mass += points[cover]["count"]
         if mass >= threshold or cover == covers[-1]:
-            rung = ((cover + lane - 1) // lane) * lane
+            rung = _lane_align(cover, lane)
             if not rungs or rung > rungs[-1]:
                 rungs.append(rung)
             while threshold <= mass:
                 threshold += step
     # every observation must fit the top rung
-    top = ((covers[-1] + lane - 1) // lane) * lane
+    top = _lane_align(covers[-1], lane)
     if rungs[-1] < top:
         rungs.append(top)
     # predicted waste: each observation pads to the smallest rung that

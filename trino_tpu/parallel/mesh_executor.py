@@ -91,11 +91,13 @@ def _agather(x: jnp.ndarray) -> jnp.ndarray:
     return jax.lax.all_gather(x, AXIS, axis=0, tiled=True)
 
 
-def _shuffle_chunk(cap: int, ndev: int, factor: int) -> int:
+def _shuffle_chunk(cap: int, ndev: int, factor: int, quantize=None) -> int:
     """Per-destination chunk capacity for a hash repartition: expected
     cap/ndev rows per bucket with 2x skew slack, grown by the retry-ladder
-    factor on overflow."""
-    return _pad_capacity(max(128, (2 * cap * factor) // ndev))
+    factor on overflow.  `quantize` is the executor ladder's rung
+    function (plain lane alignment when absent)."""
+    q = quantize or _pad_capacity
+    return q(max(128, (2 * cap * factor) // ndev))
 
 
 def _decode_direct_keys(domains, cap):
@@ -606,7 +608,7 @@ class MeshExecutor(LocalExecutor):
                 ).max()))
             if worst == 0:
                 return None
-            return _pad_capacity(max(128, int(worst * 1.3)))
+            return self.ladder.quantize(max(128, int(worst * 1.3)))
 
         def _wide_key(node, sym):
             t = node.output_types().get(sym)
@@ -706,7 +708,7 @@ class MeshExecutor(LocalExecutor):
                 for s, t in types:
                     if t.is_dictionary and s not in dicts:
                         dicts[s] = np.array([], dtype=object)
-                cap = _pad_capacity(max(max(dev_counts), 1))
+                cap = self.ladder.quantize(max(max(dev_counts), 1))
                 merged: Dict[str, np.ndarray] = {}
                 for c in cols:
                     sym = sym_of[c]
@@ -990,7 +992,7 @@ class _MeshTraceCtx(_TraceCtx):
             lanes[k] = kl
         for s in out:
             lanes[s] = out[s]
-        pad_cap = _pad_capacity(cap)
+        pad_cap = self.ex.ladder.quantize(cap)
         if pad_cap != cap:
             from ..ops.wide_decimal import pad_rows
 
@@ -1065,11 +1067,10 @@ class _MeshTraceCtx(_TraceCtx):
         exists (grown by the ladder factor as the backstop), else the
         2x-slack default."""
         h = getattr(self.ex, "shuffle_hints", {}).get((id(node), side))
+        q = self.ex.ladder.quantize
         if h is not None:
-            return min(
-                _pad_capacity(h * factor), _pad_capacity(max(128, cap))
-            )
-        return _shuffle_chunk(cap, ndev, factor)
+            return min(q(h * factor), q(max(128, cap)))
+        return _shuffle_chunk(cap, ndev, factor, quantize=q)
 
     def _use_partitioned(self, node: P.Join, left: Batch, right: Batch):
         """The DetermineJoinDistributionType decision at execution time:
@@ -1225,7 +1226,8 @@ class _MeshTraceCtx(_TraceCtx):
         # only, so route invalid-key rows to a stable device (0)
         bucket = jnp.where(kok, bucket, 0)
         chunk = _shuffle_chunk(
-            b.sel.shape[0], ndev, getattr(self.ex, "join_factor", 1)
+            b.sel.shape[0], ndev, getattr(self.ex, "join_factor", 1),
+            quantize=self.ex.ladder.quantize,
         )
         lanes, sel, mx = shuffle.repartition(
             b.lanes, b.sel, bucket, b.sel, ndev, chunk, AXIS
@@ -1283,7 +1285,8 @@ class _MeshTraceCtx(_TraceCtx):
             b.lanes[lead.column], lead, b.sel, ndev, AXIS
         )
         chunk = _shuffle_chunk(
-            b.sel.shape[0], ndev, getattr(self.ex, "join_factor", 1)
+            b.sel.shape[0], ndev, getattr(self.ex, "join_factor", 1),
+            quantize=self.ex.ladder.quantize,
         )
         lanes, sel, mx = shuffle.repartition(
             b.lanes, b.sel, bucket, b.sel, ndev, chunk, AXIS
@@ -1409,7 +1412,8 @@ class _MeshTraceCtx(_TraceCtx):
         all_lanes = dict(lanes0)
         all_lanes["__tag__"] = (tag, jnp.ones(tag.shape[0], bool))
         chunk = _shuffle_chunk(
-            sel.shape[0], ndev, getattr(self.ex, "join_factor", 1)
+            sel.shape[0], ndev, getattr(self.ex, "join_factor", 1),
+            quantize=self.ex.ladder.quantize,
         )
         lanes2, sel2, mx = shuffle.repartition(
             all_lanes, sel, bucket, keep, ndev, chunk, AXIS

@@ -137,6 +137,9 @@ class Session:
         # ranked root-cause verdict of the most recent doctored query
         # (bench.py attaches it to slow configs)
         self.last_diagnosis: Optional[dict] = None
+        # stats of the most recent persistent-compile-cache prewarm
+        # (cold-start path; bench --serve surfaces them)
+        self.last_prewarm: Optional[dict] = None
         # operator timeline of the last instrumented execution (EXPLAIN
         # ANALYZE / operator_stats=true), backing
         # system.runtime.operator_stats
@@ -266,12 +269,39 @@ class Session:
         exec_config["broadcast_join_threshold_rows"] = self.properties.get(
             "broadcast_join_threshold_rows"
         )
+        # bucketed-batch ABI: resolve the ladder once per (spec, file)
+        # and hand every executor (and its streaming tiles / mesh shards)
+        # the same PaddingLadder object, so the whole session quantizes
+        # onto identical rungs
+        ladder_key = (
+            self.properties.get("padding_ladder"),
+            self.properties.get("padding_ladder_file"),
+        )
+        cached = getattr(self, "_ladder_cache", None)
+        if not cached or cached[0] != ladder_key:
+            from .exec.shapes import resolve_ladder
+
+            cached = (ladder_key, resolve_ladder({
+                "padding_ladder": ladder_key[0],
+                "padding_ladder_file": ladder_key[1],
+            }))
+            self._ladder_cache = cached
+        exec_config["padding_ladder"] = cached[1]
         cc = self.caches.compile_cache
         cache_dir = self.properties.get("compile_cache_dir")
         if cache_dir:
             # persistent tier: point jax's compilation cache at the shared
             # directory so a second process skips the XLA compile
             cc.attach_persistent(cache_dir)
+            if self.properties.get("compile_prewarm"):
+                # cold-start prewarm: page the persistent executables into
+                # the OS cache and seed the observatory's family registry
+                # from the index, so boot-time compiles classify as
+                # persistent_load / first_compile — never shape_miss.
+                # Idempotent per directory; records stats for bench.
+                warm = cc.prewarm(cache_dir)
+                if warm is not None:
+                    self.last_prewarm = warm
         # session property compile_cache=false detaches the shared cache
         # (a throwaway dict keeps the executor's duck-typed surface)
         exec_config["jit_cache"] = (
