@@ -100,6 +100,21 @@ def _chaos_churn() -> bool:
     return "--chaos-churn" in sys.argv[1:]
 
 
+def _chaos_coordinator() -> bool:
+    """--chaos-coordinator (also BENCH_CHAOS_COORDINATOR=1).
+
+    Opt-in coordinator-crash chaos config: boot a subprocess
+    coordinator with a WAL (coordinator_recovery_dir) plus subprocess
+    workers, kill -9 the COORDINATOR mid-query, restart it on the same
+    port, and record how many queries still answer correctly after the
+    WAL replays and FTE resumes from committed spools.  Off by default —
+    it measures crash recovery, not speed.
+    """
+    if os.environ.get("BENCH_CHAOS_COORDINATOR") == "1":
+        return True
+    return "--chaos-coordinator" in sys.argv[1:]
+
+
 def _serve_mode() -> str:
     """--serve / --serve-smoke (also BENCH_SERVE=1|smoke).
 
@@ -152,6 +167,7 @@ def _mesh_sizes() -> tuple:
 
 CACHE_MODE = _cache_mode()
 CHAOS_CHURN = _chaos_churn()
+CHAOS_COORDINATOR = _chaos_coordinator()
 SERVE_MODE = _serve_mode()
 MESH_SIZES = _mesh_sizes()
 CACHE_PROPS = {
@@ -1184,6 +1200,84 @@ def main():
             "wall_s": round(time.perf_counter() - t0, 1),
         }
 
+    def _cfg_chaos_coordinator():
+        # coordinator-crash chaos (--chaos-coordinator): a killable
+        # subprocess coordinator journals every query-state transition
+        # to its WAL; the seeded coordinator_death site kill -9s it the
+        # instant a task_committed record lands mid-query, a same-port
+        # restart replays the WAL, and the FTE resume path finishes the
+        # query from the committed spools while the client rides out the
+        # outage on its restart grace.  Counts queries that still answer.
+        import threading
+
+        from trino_tpu.client.client import StatementClient
+        from trino_tpu.testing.runner import SubprocessCoordinator
+
+        t0 = time.perf_counter()
+        attempted = survived = restarts = 0
+        recovery_dir = tempfile.mkdtemp(prefix="bench-coord-wal-")
+        props = {
+            "retry_policy": "task",
+            "coordinator_recovery_dir": recovery_dir,
+            "coordinator_recovery_window_s": 30.0,
+            "node_gone_grace_s": 1.5,
+        }
+        catalogs = (("tpch", "tpch", {"tpch.scale-factor": 0.001}),)
+        sql = (
+            "select count(*), sum(l_extendedprice * l_discount) "
+            "from lineitem where l_quantity > 1"
+        )
+        with SubprocessCoordinator(
+            catalogs=catalogs, properties=props,
+            fault_injection={
+                "coordinator_death": {"match": "task_committed", "nth": 2},
+            },
+        ) as coord:
+            coord.add_worker()
+            coord.add_worker()
+            client = StatementClient(coord.uri, restart_grace_s=60.0)
+
+            def _restart_when_dead():
+                coord.proc.wait()
+                coord.restart()  # no fault injection the second time
+                coord.wait_for_workers(2)
+
+            monitor = threading.Thread(
+                target=_restart_when_dead, daemon=True
+            )
+            monitor.start()
+            attempted += 1
+            try:
+                _cols, rows = client.execute(sql)
+                if rows:
+                    survived += 1
+            except Exception:
+                pass
+            monitor.join(timeout=120.0)
+            restarts += 1
+            # one clean follow-up on the recovered coordinator proves
+            # it is fully serviceable, not just draining the WAL
+            attempted += 1
+            try:
+                _cols, rows = client.execute(sql)
+                if rows:
+                    survived += 1
+            except Exception:
+                pass
+            status = {}
+            try:
+                status = coord.status()
+            except Exception:
+                pass
+        return {
+            "coordinator_restarts": restarts,
+            "queries_attempted": attempted,
+            "queries_survived": survived,
+            "recovered_queries": status.get("recoveredQueries", 0),
+            "orphaned_queries": status.get("orphanedQueries", 0),
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+
     def _cfg_serve():
         # closed-loop multi-tenant serving bench (--serve / --serve-smoke):
         # a weighted-fair resource-group tree fronts a distributed cluster
@@ -1608,6 +1702,13 @@ def main():
         # appended after the CPU filter: the churn config runs on any
         # backend when explicitly requested
         plan.append(("chaos_churn_sf0.01", _cfg_chaos_churn, 90, []))
+    if CHAOS_COORDINATOR:
+        # appended after the CPU filter too: coordinator-crash recovery
+        # runs on any backend when explicitly requested; generous budget
+        # (two subprocess boots + a WAL replay, not a scan)
+        plan.append((
+            "chaos_coordinator_sf0.001", _cfg_chaos_coordinator, 120, []
+        ))
     if SERVE_MODE:
         # appended after the CPU filter too: serving behavior is worth
         # measuring on every backend when explicitly requested

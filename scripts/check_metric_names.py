@@ -144,6 +144,8 @@ def check_tree(root: str):
          "trino_tpu.obs.compile_observatory", "COMPILE_FIELDS"),
         ("trino_tpu/obs/compile_observatory.py",
          "trino_tpu.obs.compile_observatory", "CENSUS_FIELDS"),
+        ("trino_tpu/server/recovery.py",
+         "trino_tpu.server.recovery", "WAL_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

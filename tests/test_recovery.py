@@ -1,0 +1,384 @@
+"""Coordinator crash & recovery: the write-ahead intent log.
+
+Reference parity: Project Tardigrade made WORKER death survivable
+(BaseFailureRecoveryTest); the reference coordinator remains a SPOF —
+a restart loses every in-flight query.  server/recovery.py closes that
+gap: every query-state transition is journaled through the same mmap'd
+torn-tail-tolerant segment contract as the flight recorder, so a
+coordinator killed with -9 mid-query leaves a WAL a fresh process
+replays — FTE queries resume from committed spools (byte-identical
+answers), pipelined queries orphan with a structured retryable
+COORDINATOR_RESTART error the client re-submits, and the nextUri poll
+loop rides out the whole outage (refused sockets -> restart grace,
+503 + Retry-After -> recovery window wait).
+
+The crash victim is a REAL child process (server/coordinator_main.py):
+an in-process coordinator shares its fate with the test runner, so true
+kill -9 semantics need a subprocess.
+"""
+import json
+import sqlite3
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from oracle import assert_rows_match, load_tpch
+from tpch_sql import QUERIES, oracle_dialect
+from trino_tpu.obs import doctor, journal
+from trino_tpu.server import recovery
+from trino_tpu.server.protocol import error_json
+from trino_tpu.server.recovery import (
+    QUERY_FAILED,
+    QUERY_FINISHED,
+    QUERY_PLANNED,
+    QUERY_SUBMITTED,
+    TASK_COMMITTED,
+    TASK_DISPATCHED,
+    CoordinatorWAL,
+    read_wal_dir,
+    replay_wal,
+)
+from trino_tpu.client.client import StatementClient
+from trino_tpu.testing.runner import SubprocessCoordinator
+
+SF = 0.001
+TPCH = (("tpch", "tpch", {"tpch.scale-factor": SF}),)
+Q3 = QUERIES[3][0]
+
+
+@pytest.fixture(scope="module")
+def oracle_conn():
+    conn = sqlite3.connect(":memory:")
+    load_tpch(conn, SF, ["customer", "orders", "lineitem"])
+    return conn
+
+
+# --- WAL store (mmap'd two-segment, torn-tail tolerant) -------------------
+
+
+def test_wal_roundtrip(tmp_path):
+    wal = CoordinatorWAL(str(tmp_path))
+    wal.record(QUERY_SUBMITTED, "q_a", sql="select 1", slug="s1",
+               retryPolicy="task", resourceGroup="global")
+    wal.record(QUERY_PLANNED, "q_a", planDigest="abcd")
+    wal.record(TASK_COMMITTED, "q_a", fragmentSig="f0", taskIndex=0,
+               spoolPath="/tmp/spool/p0")
+    recs = read_wal_dir(str(tmp_path))
+    assert [r["recordType"] for r in recs] == [
+        QUERY_SUBMITTED, QUERY_PLANNED, TASK_COMMITTED,
+    ]
+    # walIds are monotone and every record is queryId-tagged
+    ids = [r["walId"] for r in recs]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    assert all(r["queryId"] == "q_a" for r in recs)
+    assert recs[0]["sql"] == "select 1"
+    assert recs[2]["spoolPath"] == "/tmp/spool/p0"
+
+
+def test_wal_torn_tail_is_skipped(tmp_path):
+    """A record half-written when the process died (torn JSON tail) is
+    skipped on read-back — never an error, never a phantom record."""
+    wal = CoordinatorWAL(str(tmp_path))
+    wal.record(QUERY_SUBMITTED, "q_a", sql="select 1", slug="s")
+    wal.record(QUERY_PLANNED, "q_a", planDigest="abcd")
+    seg = wal._segments[wal._active]
+    torn = b'{"walId": 3, "recordType": "task_commi'
+    with open(seg.path, "r+b") as f:
+        f.seek(seg.offset)
+        f.write(torn)
+    recs = read_wal_dir(str(tmp_path))
+    assert [r["recordType"] for r in recs] == [QUERY_SUBMITTED, QUERY_PLANNED]
+
+
+def test_wal_segment_flip_keeps_recent_records(tmp_path):
+    """Overflowing the active segment flips to the other one instead of
+    failing; the flipped-to records read back fine."""
+    wal = CoordinatorWAL(str(tmp_path), max_bytes=2 * (1 << 16))
+    for i in range(2000):
+        wal.record(TASK_DISPATCHED, f"q_{i % 7}", taskId=f"t{i}",
+                   uri="http://127.0.0.1:1")
+    recs = read_wal_dir(str(tmp_path))
+    assert recs, "flip lost everything"
+    # the newest record always survives (it is what recovery needs most)
+    assert any(r.get("taskId") == "t1999" for r in recs)
+
+
+def test_wal_truncates_oversize_sql(tmp_path):
+    wal = CoordinatorWAL(str(tmp_path))
+    wal.record(QUERY_SUBMITTED, "q_big", sql="x" * 100_000, slug="s")
+    (rec,) = read_wal_dir(str(tmp_path))
+    assert len(rec["sql"]) <= 2100
+
+
+# --- replay classification ------------------------------------------------
+
+
+def _rec(record_type, qid, ts, **fields):
+    return {"walId": ts, "recordType": record_type, "queryId": qid,
+            "ts": float(ts), **fields}
+
+
+def test_replay_classifies_resumable_pipelined_terminal():
+    records = [
+        # q_fte: mid-flight FTE query with two committed tasks -> resumable
+        _rec(QUERY_SUBMITTED, "q_fte", 1, sql="select 1", slug="s1",
+             retryPolicy="task"),
+        _rec(QUERY_PLANNED, "q_fte", 2, planDigest="d1"),
+        _rec(TASK_COMMITTED, "q_fte", 3, fragmentSig="f0", taskIndex=0,
+             spoolPath="/sp/a"),
+        _rec(TASK_COMMITTED, "q_fte", 4, fragmentSig="f0", taskIndex=1,
+             spoolPath="/sp/b"),
+        _rec(TASK_COMMITTED, "q_fte", 5, fragmentSig="f1", taskIndex=0,
+             spoolPath="/sp/c"),
+        # q_pipe: mid-flight pipelined query -> non-resumable, non-terminal
+        _rec(QUERY_SUBMITTED, "q_pipe", 6, sql="select 2", slug="s2",
+             retryPolicy=""),
+        _rec(QUERY_PLANNED, "q_pipe", 7, planDigest="d2"),
+        # q_done / q_dead: terminal either way -> nothing to recover
+        _rec(QUERY_SUBMITTED, "q_done", 8, sql="select 3", slug="s3"),
+        _rec(QUERY_FINISHED, "q_done", 9, state="FINISHED"),
+        _rec(QUERY_SUBMITTED, "q_dead", 10, sql="select 4", slug="s4"),
+        _rec(QUERY_FAILED, "q_dead", 11, state="FAILED", error="boom"),
+    ]
+    by_id = replay_wal(records)
+    assert set(by_id) == {"q_fte", "q_pipe", "q_done", "q_dead"}
+    fte, pipe = by_id["q_fte"], by_id["q_pipe"]
+    assert fte.resumable and fte.terminal is None
+    assert fte.retry_policy == "task" and fte.plan_digest == "d1"
+    assert fte.committed_lists() == {
+        "f0": ["/sp/a", "/sp/b"], "f1": ["/sp/c"],
+    }
+    assert not pipe.resumable and pipe.terminal is None
+    assert by_id["q_done"].terminal == "FINISHED"
+    assert by_id["q_dead"].terminal == "FAILED"
+    # a sparse commit map pads the missing attempt with None (the
+    # scheduler must re-run it, not crash)
+    sparse = replay_wal([
+        _rec(QUERY_SUBMITTED, "q_s", 1, sql="s", retryPolicy="task"),
+        _rec(TASK_COMMITTED, "q_s", 2, fragmentSig="f0", taskIndex=2,
+             spoolPath="/sp/z"),
+    ])["q_s"]
+    assert sparse.committed_lists() == {"f0": [None, None, "/sp/z"]}
+
+
+# --- structured retryable errors (wire protocol) --------------------------
+
+
+def test_error_json_structured_vs_generic():
+    doc = error_json("COORDINATOR_RESTART: coordinator restarted")
+    assert doc["errorName"] == "COORDINATOR_RESTART"
+    assert doc["errorType"] == "EXTERNAL" and doc["retriable"] is True
+    generic = error_json("division by zero")
+    assert generic["errorName"] == "GENERIC_INTERNAL_ERROR"
+    assert "retriable" not in generic
+    assert doctor.classify_error(
+        "COORDINATOR_RESTART: please re-submit"
+    ) == "COORDINATOR_RESTART"
+
+
+def test_doctor_cites_coordinator_restart_events():
+    events = [
+        {"eventId": 11, "ts": 1.0, "queryId": "q_r",
+         "eventType": journal.COORDINATOR_RESTART,
+         "detail": {"pendingQueries": 1}},
+        {"eventId": 12, "ts": 2.0, "queryId": "q_r",
+         "eventType": journal.QUERY_RESUMED,
+         "detail": {"reusedSpools": 3}},
+    ]
+    diag = doctor.diagnose("q_r", events)
+    assert diag["verdict"] == doctor.ROOT_CAUSE
+    assert diag["rootCause"] == "coordinator_restart"
+    assert "committed spool" in diag["summary"]
+    assert set(diag["eventIds"]) == {11, 12}
+
+
+# --- 503 + Retry-After during the recovery window -------------------------
+
+
+def test_unknown_query_polls_get_503_during_recovery_window(tmp_path):
+    """While a restarted coordinator is still replaying its WAL, a poll
+    for a query id it doesn't know yet answers 503 + Retry-After — the
+    client waits — instead of 404 — the client would die."""
+    from trino_tpu.server.coordinator import CoordinatorServer
+    from trino_tpu.session import Session
+
+    # a "crashed predecessor's" WAL: one resumable query pending, and no
+    # workers alive — the recovery pass blocks in await_alive for the
+    # whole window, holding it open deterministically
+    crashed = CoordinatorWAL(str(tmp_path), name="crashed")
+    crashed.record(QUERY_SUBMITTED, "q_pending", sql="select 1",
+                   slug="s", retryPolicy="task")
+    s = Session(config={
+        "coordinator_recovery_dir": str(tmp_path),
+        "coordinator_recovery_window_s": 8.0,
+    })
+    s.create_catalog("tpch", "tpch", {"tpch.scale-factor": SF})
+    server = CoordinatorServer(s, distributed=True).start()
+    try:
+        co = server.coordinator
+        assert co.in_recovery_window()
+        # the pending id itself was re-registered under its slug at boot
+        assert "q_pending" in co.queries
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"{server.uri}/v1/statement/executing/q_unknown/s/0",
+                timeout=5.0,
+            )
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After")
+        doc = json.loads(ei.value.read())
+        assert doc.get("retryable") is True
+    finally:
+        server.stop()
+        crashed.close()
+
+
+def test_await_alive_times_out_empty():
+    from trino_tpu.server.discovery import NodeManager
+
+    nm = NodeManager()
+    t0 = time.time()
+    assert nm.await_alive(1, timeout=0.3) == []
+    assert time.time() - t0 >= 0.25
+
+
+# --- kill -9 the coordinator mid-query (the acceptance scenarios) ---------
+
+
+def _obs_props(tmp_path):
+    return {
+        "coordinator_recovery_dir": str(tmp_path / "wal"),
+        "coordinator_recovery_window_s": 30.0,
+        "event_journal_dir": str(tmp_path / "journal"),
+        "query_history_dir": str(tmp_path / "history"),
+        "node_gone_grace_s": 1.5,
+    }
+
+
+def _restart_when_dead(coord, fired):
+    coord.proc.wait()
+    fired.append(coord.proc.returncode)
+    coord.restart()  # fresh process, crash site NOT re-armed
+    coord.wait_for_workers(len(coord.subprocess_workers))
+
+
+@pytest.mark.slow
+def test_kill9_coordinator_mid_q3_resumes_byte_identical(
+    oracle_conn, tmp_path
+):
+    """Acceptance: the seeded coordinator_death site hard-exits the
+    coordinator the instant the 2nd task_committed record lands mid-Q3;
+    a same-port restart replays the WAL, re-adopts the surviving
+    workers, resumes the query reusing the committed spools, and the
+    client — which never saw anything but its normal poll loop — gets
+    the same bytes as an undisturbed run."""
+    props = dict(_obs_props(tmp_path), retry_policy="task")
+    with SubprocessCoordinator(
+        catalogs=TPCH, properties=props,
+        fault_injection={
+            "coordinator_death": {"match": TASK_COMMITTED, "nth": 2},
+        },
+    ) as coord:
+        coord.add_worker()
+        coord.add_worker()
+        client = StatementClient(coord.uri, restart_grace_s=60.0)
+        fired = []
+        monitor = threading.Thread(
+            target=_restart_when_dead, args=(coord, fired), daemon=True
+        )
+        monitor.start()
+        _cols, rows = client.execute(Q3)
+        monitor.join(timeout=120.0)
+        assert fired, "coordinator was never killed"
+        assert fired[0] == -9 or fired[0] == 137
+
+        expected = oracle_conn.execute(oracle_dialect(Q3)).fetchall()
+        assert_rows_match(
+            [tuple(r) for r in rows], expected, tol=2e-2, ordered=True
+        )
+        # byte-identical vs an undisturbed run on the same cluster
+        _cols2, rows2 = client.execute(Q3)
+        assert rows == rows2
+
+        status = coord.status()
+        assert status.get("recoveredQueries", 0) >= 1
+
+        # the WAL holds the full intent trail, terminal record included
+        recs = read_wal_dir(props["coordinator_recovery_dir"])
+        types = {r["recordType"] for r in recs}
+        assert {QUERY_SUBMITTED, QUERY_PLANNED, TASK_COMMITTED,
+                QUERY_FINISHED} <= types
+
+        # the journal cites the resume, and the doctor turns it into a
+        # ranked verdict naming the events
+        events = journal.read_journal_dir(props["event_journal_dir"])
+        resumed = [e for e in events
+                   if e["eventType"] == journal.QUERY_RESUMED]
+        assert resumed, "no query_resumed event journaled"
+        qid = resumed[0]["queryId"]
+        assert resumed[0]["detail"]["reusedSpools"] >= 1
+        diag = doctor.diagnose(
+            qid, doctor.events_for_query(qid, events=events)
+        )
+        assert diag["rootCause"] == "coordinator_restart"
+        assert resumed[0]["eventId"] in diag["eventIds"]
+
+
+@pytest.mark.slow
+def test_kill9_coordinator_orphans_pipelined_query(oracle_conn, tmp_path):
+    """A pipelined query has no committed spools to resume from: after
+    the crash-restart it is orphaned with the structured retryable
+    COORDINATOR_RESTART error, the client auto-re-submits the original
+    SQL, and the orphan is visible in system.runtime.completed_queries
+    with its errorCode (it died BEFORE _finalize_query ever ran in the
+    crashed process)."""
+    sql = (
+        "select count(*), sum(l_extendedprice * l_discount) "
+        "from lineitem where l_quantity > 1"
+    )
+    props = _obs_props(tmp_path)  # no retry_policy: pipelined path
+    with SubprocessCoordinator(
+        catalogs=TPCH, properties=props,
+        fault_injection={
+            "coordinator_death": {"match": QUERY_PLANNED, "nth": 1},
+        },
+    ) as coord:
+        coord.add_worker()
+        coord.add_worker()
+        client = StatementClient(
+            coord.uri, restart_grace_s=60.0, max_resubmits=1
+        )
+        fired = []
+        monitor = threading.Thread(
+            target=_restart_when_dead, args=(coord, fired), daemon=True
+        )
+        monitor.start()
+        # the client rides out the crash, receives the structured
+        # retryable error for the orphaned attempt, and re-submits
+        _cols, rows = client.execute(sql)
+        monitor.join(timeout=120.0)
+        assert fired, "coordinator was never killed"
+
+        expected = oracle_conn.execute(sql).fetchall()
+        assert_rows_match([tuple(r) for r in rows], expected, tol=2e-2)
+
+        status = coord.status()
+        assert status.get("orphanedQueries", 0) >= 1
+
+        # the orphan reached the history store WITH its errorCode —
+        # the satellite fix: terminalized through _finalize_query
+        hist = client.execute(
+            "select query_id, state, error_code "
+            "from system.runtime.completed_queries "
+            "where error_code = 'COORDINATOR_RESTART'"
+        )[1]
+        assert hist, "orphaned query missing from completed_queries"
+        assert hist[0][1] == "FAILED"
+
+        events = journal.read_journal_dir(props["event_journal_dir"])
+        orphaned = [e for e in events
+                    if e["eventType"] == journal.QUERY_ORPHANED]
+        assert orphaned and orphaned[0]["queryId"] == hist[0][0]

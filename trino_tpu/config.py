@@ -265,6 +265,24 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 1 << 20,
         ),
         PropertyMetadata(
+            "coordinator_recovery_dir",
+            "directory for the coordinator's write-ahead intent log "
+            "(mmap'd torn-tail-tolerant JSONL segments journaling every "
+            "query-state transition); on boot the coordinator replays "
+            "it, resuming FTE queries from committed spools and failing "
+            "pipelined ones with a retryable COORDINATOR_RESTART error; "
+            "empty disables crash recovery",
+            str, "",
+        ),
+        PropertyMetadata(
+            "coordinator_recovery_window_s",
+            "how long a restarted coordinator answers polls for "
+            "still-recovering queries with 503+Retry-After (instead of "
+            "404) and waits for discovery re-announcements to rebuild "
+            "the live worker set before dispatching resumed work",
+            float, 10.0,
+        ),
+        PropertyMetadata(
             "compile_observatory_dir",
             "directory for the crash-safe engine-wide compile ledger "
             "(mmap'd JSONL segments plus per-writer census snapshots, "

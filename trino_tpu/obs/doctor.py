@@ -78,6 +78,7 @@ def classify_error(error) -> str:
         ("device_loss", "DEVICE_LOSS"),
         ("DeviceFaultError", "DEVICE_FAULT"),
         ("REMOTE_HOST_GONE", "REMOTE_HOST_GONE"),
+        ("COORDINATOR_RESTART", "COORDINATOR_RESTART"),
         ("ADMISSION_TIMEOUT", "ADMISSION_TIMEOUT"),
         ("shed after", "ADMISSION_TIMEOUT"),
         ("admission queue", "ADMISSION_TIMEOUT"),
@@ -188,6 +189,41 @@ def _rule_node_churn(ctx) -> Optional[Dict]:
         summary += " -> no schedulable nodes left"
     return _finding("node_churn", J.ERROR if ctx.get("error") else J.WARN,
                     summary, deaths + churn)
+
+
+def _rule_coordinator_restart(ctx) -> Optional[Dict]:
+    """The coordinator itself died and came back: the query was either
+    resumed from WAL-recorded committed spools (QUERY_RESUMED) or
+    orphaned with the structured retryable error (QUERY_ORPHANED).
+    Ranked below node churn — a dead WORKER loses spools and running
+    tasks, while a dead coordinator loses only in-memory bookkeeping
+    that the WAL reconstructs — and above mesh shrink."""
+    restarts = _events_of(ctx, J.COORDINATOR_RESTART)
+    resumed = _events_of(ctx, J.QUERY_RESUMED)
+    orphaned = _events_of(ctx, J.QUERY_ORPHANED)
+    deaths = _events_of(ctx, J.FAULT_INJECTED, sites=("coordinator_death",))
+    if not (restarts or resumed or orphaned or deaths) \
+            and ctx.get("errorCode") != "COORDINATOR_RESTART":
+        return None
+    parts = ["coordinator restarted mid-query"]
+    if resumed:
+        spools = sum(
+            int((e.get("detail") or {}).get("reusedSpools") or 0)
+            for e in resumed
+        )
+        parts.append(
+            "resumed from the WAL"
+            + (f" reusing {spools} committed spool(s)" if spools else "")
+        )
+    if orphaned or ctx.get("errorCode") == "COORDINATOR_RESTART":
+        parts.append(
+            "pipelined stream state lost -> orphaned with retryable "
+            "COORDINATOR_RESTART (client re-submits)"
+        )
+    summary = " -> ".join(parts)
+    sev = J.ERROR if ctx.get("error") else J.WARN
+    return _finding("coordinator_restart", sev, summary,
+                    deaths + restarts + resumed + orphaned)
 
 
 def _rule_mesh_shrink(ctx) -> Optional[Dict]:
@@ -417,6 +453,10 @@ _RULES = (
     _rule_device_fault,
     _rule_memory_kill,
     _rule_node_churn,
+    # coordinator restart below node churn (a dead worker loses spools
+    # and tasks; a dead coordinator loses only bookkeeping the WAL
+    # reconstructs), above mesh shrink
+    _rule_coordinator_restart,
     _rule_mesh_shrink,
     # overload below node churn (a dead worker is a fault, not demand),
     # above memory pressure (a backed-up admission queue is usually the

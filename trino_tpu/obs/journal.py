@@ -83,6 +83,10 @@ QUEUE_TIMEOUT = "queue_timeout"
 SCALE_OUT = "scale_out"
 SCALE_IN = "scale_in"
 STARVATION_AVERTED = "starvation_averted"
+# coordinator crash recovery: restart scan + per-query WAL dispositions
+COORDINATOR_RESTART = "coordinator_restart"
+QUERY_RESUMED = "query_resumed"
+QUERY_ORPHANED = "query_orphaned"
 
 # severities
 INFO = "info"

@@ -36,6 +36,7 @@ from ..utils.memory import ExceededMemoryLimitError
 from ..utils.metrics import REGISTRY
 from ..utils.tracing import TRACER
 from . import protocol
+from . import recovery as _recovery
 from .discovery import HeartbeatFailureDetector, NodeManager
 from .resource_groups import QueryQueueFullError, ResourceGroupManager
 
@@ -67,6 +68,9 @@ class QueryExecution:
         self.straggler_flags: list = []  # dispersion-detector verdicts
         self.session_executed = False  # ran via session.execute (history
         #                                already recorded there)
+        self.recovered = False  # re-registered from the WAL after restart
+        self.resume_event_id: Optional[int] = None  # QUERY_RESUMED citation
+        self.orphan_event_id: Optional[int] = None  # QUERY_ORPHANED citation
         self.page: Optional[Page] = None
         self.types = None
         self.created = time.time()
@@ -85,6 +89,7 @@ class Coordinator:
         distributed: bool = False,
         resource_groups: Optional[dict] = None,
         authenticator=None,
+        fault_injection: Optional[dict] = None,
     ):
         self.session = session
         # admission control (InternalResourceGroupManager)
@@ -170,6 +175,45 @@ class Coordinator:
             # node-death fan-out: memory-pool eviction + opstats ghost
             # retirement the moment a node is declared GONE
             self.node_manager.add_gone_listener(self._on_node_gone)
+        # -- coordinator crash recovery (server/recovery.py) ------------
+        # WAL + restart-time resume; the chaos injector arming the
+        # seeded coordinator_death site is only ever passed by
+        # coordinator_main (a subprocess coordinator) — an in-process
+        # coordinator firing os._exit would take the test runner down,
+        # exactly the worker_death containment rule
+        self.recovered_queries = 0
+        self.orphaned_queries = 0
+        self.wal = None
+        self.recovery = None
+        recovery_dir = str(
+            session.properties.get("coordinator_recovery_dir") or ""
+        )
+        if recovery_dir and distributed:
+            from ..utils.faults import FaultInjector
+
+            injector = (
+                FaultInjector.from_spec(fault_injection)
+                if fault_injection
+                else None
+            )
+            self.wal = _recovery.CoordinatorWAL(
+                recovery_dir, injector=injector
+            )
+            self.recovery = _recovery.RecoveryManager(
+                self, recovery_dir,
+                window_s=float(
+                    session.properties.get("coordinator_recovery_window_s")
+                    or 10.0
+                ),
+            )
+            # synchronous: every non-terminal WAL query is back in the
+            # tracker (same id, same slug) before the HTTP server ever
+            # answers a poll; the resume/orphan pass runs in background
+            # once discovery re-announcements rebuild the worker set
+            self.recovery.register()
+            threading.Thread(
+                target=self.recovery.run, daemon=True
+            ).start()
         self._stop_enforcement = threading.Event()
         if distributed:
             threading.Thread(
@@ -314,6 +358,19 @@ class Coordinator:
         group = self.resource_groups.select(user, source)
         q.group = group
         self.cluster_memory.note_query_tenant(q.query_id, group.tenant)
+        if self.wal is not None:
+            # intent first: the query durably exists (sql + slug + group
+            # + retry policy) before any dispatch — a crash from here on
+            # is recoverable, and the recorded slug keeps the client's
+            # nextUri valid across the restart
+            self.wal.record(
+                _recovery.QUERY_SUBMITTED, q.query_id,
+                sql=sql, user=user, source=source, slug=q.slug,
+                resourceGroup=getattr(group, "name", ""),
+                retryPolicy=str(
+                    self.session.properties.get("retry_policy") or ""
+                ),
+            )
 
         def on_shed(err):
             # queue-deadline shed: structured, retryable, and journaled
@@ -382,6 +439,13 @@ class Coordinator:
                 # the dequeue charged a running slot before cancel won
                 # the race: release it or the group leaks capacity
                 cancelled_group.finish()
+            # cancelled-while-queued queries died before the normal
+            # finally-block finalize: persist them with an errorCode too,
+            # or system.runtime.completed_queries never shows them
+            try:
+                self._finalize_query(q)
+            except Exception:
+                pass
             return
         admitted = False
         try:
@@ -522,6 +586,17 @@ class Coordinator:
         from ..obs import opstats as _opstats
         from ..obs.history import get_store
 
+        if self.wal is not None and q.state in ("FINISHED", "FAILED"):
+            # terminal WAL record: replay after a later restart must not
+            # try to resume (or orphan) a query that already completed
+            self.wal.record(
+                _recovery.QUERY_FINISHED
+                if q.state == "FINISHED"
+                else _recovery.QUERY_FAILED,
+                q.query_id,
+                state=q.state,
+                error=str(q.error)[:400] if q.error else None,
+            )
         tasks = getattr(q, "task_stats", None) or []
         if tasks and q.timeline is None:
             # fresh detector per merge so the timeline's straggler list
@@ -647,6 +722,14 @@ class Coordinator:
                     raise SchedulerError(
                         "NO_NODES_AVAILABLE: no alive workers to schedule on"
                     )
+                if self.wal is not None:
+                    # the fragment-graph digest is the resume sanity
+                    # check: replayed SQL must re-plan to this shape
+                    # before any committed spool is trusted
+                    self.wal.record(
+                        _recovery.QUERY_PLANNED, q.query_id,
+                        planDigest=_recovery.plan_digest(plan),
+                    )
                 # fragment result cache: a warm deterministic plan skips
                 # scheduling entirely (the coordinator-side tier — workers
                 # never see the query)
@@ -656,73 +739,14 @@ class Coordinator:
                 with q.lock:
                     q.state = "RUNNING"
                 props = self.session.properties
-                task_props = {
-                    "group_capacity": props.get("group_capacity"),
-                    "memory_limit_bytes":
-                        props.get("query_max_memory_bytes"),
-                    "spill_enabled": props.get("spill_enabled"),
-                    "dynamic_filtering": props.get("dynamic_filtering"),
-                    "speculative_execution":
-                        props.get("speculative_execution"),
-                    "fte_max_attempts": props.get("fte_max_attempts"),
-                    "fte_task_timeout_s": props.get("fte_task_timeout_s"),
-                    "fte_speculation_factor":
-                        props.get("fte_speculation_factor"),
-                    "fte_speculation_min_s":
-                        props.get("fte_speculation_min_s"),
-                    "fault_injection": props.get("fault_injection"),
-                    "memory_blocked_timeout_s":
-                        props.get("memory_blocked_timeout_s"),
-                    "exchange_retry_attempts":
-                        props.get("exchange_retry_attempts"),
-                    "exchange_retry_budget_s":
-                        props.get("exchange_retry_budget_s"),
-                    # adaptive replanning: estimate-vs-observed divergence
-                    # threshold + the broadcast cutoff the flip re-checks
-                    "statistics_enabled": props.get("statistics_enabled"),
-                    "adaptive_replan_factor":
-                        props.get("adaptive_replan_factor"),
-                    "broadcast_join_threshold_rows":
-                        props.get("broadcast_join_threshold_rows"),
-                    # device-fault supervision (runtime/supervisor.py)
-                    "device_fault_max_strikes":
-                        props.get("device_fault_max_strikes"),
-                    "device_probe_backoff_s":
-                        props.get("device_probe_backoff_s"),
-                    "device_watchdog_timeout_s":
-                        props.get("device_watchdog_timeout_s"),
-                    "device_cpu_fallback":
-                        props.get("device_cpu_fallback"),
-                    # per-operator timeline (obs/opstats): workers run
-                    # eager with node stats and roll frames into TaskInfo
-                    "operator_stats": props.get("operator_stats"),
-                    "straggler_dispersion_factor":
-                        props.get("straggler_dispersion_factor"),
-                }
+                task_props = self._task_properties()
                 try:
                     # the query span parents every scheduler dispatch made
                     # on this thread (traceparent rides the task POSTs), so
                     # worker task spans join this trace
                     with TRACER.span("query", query_id=q.query_id):
                         if props.get("retry_policy") == "task":
-                            from .fte import FaultTolerantScheduler
-
-                            fte = FaultTolerantScheduler(
-                                self.session.catalogs, self.node_manager,
-                                properties=task_props,
-                                metadata=self.session.metadata,
-                            )
-                            page = fte.run(plan, q.query_id)
-                            q.adaptive_actions = fte.adaptive_actions
-                            q.task_stats = getattr(
-                                fte, "task_stats", []
-                            )
-                            q.straggler_flags = list(
-                                getattr(
-                                    getattr(fte, "straggler", None),
-                                    "flags", (),
-                                )
-                            )
+                            page = self._run_fte(q, plan)
                         elif props.get("retry_policy") == "query":
                             page = self._run_with_query_retries(
                                 q, plan, workers, task_props, props
@@ -747,6 +771,100 @@ class Coordinator:
         # feeds /v1/query/{id}/profile for coordinator-only clusters
         q.kernel_profile = getattr(self.session, "last_kernel_profile", None)
         q.session_executed = True
+        return page
+
+    def _task_properties(self) -> dict:
+        """Session properties forwarded to every remote task (the
+        SystemSessionProperties subset workers act on)."""
+        props = self.session.properties
+        return {
+            "group_capacity": props.get("group_capacity"),
+            "memory_limit_bytes":
+                props.get("query_max_memory_bytes"),
+            "spill_enabled": props.get("spill_enabled"),
+            "dynamic_filtering": props.get("dynamic_filtering"),
+            "speculative_execution":
+                props.get("speculative_execution"),
+            "fte_max_attempts": props.get("fte_max_attempts"),
+            "fte_task_timeout_s": props.get("fte_task_timeout_s"),
+            "fte_speculation_factor":
+                props.get("fte_speculation_factor"),
+            "fte_speculation_min_s":
+                props.get("fte_speculation_min_s"),
+            "fault_injection": props.get("fault_injection"),
+            "memory_blocked_timeout_s":
+                props.get("memory_blocked_timeout_s"),
+            "exchange_retry_attempts":
+                props.get("exchange_retry_attempts"),
+            "exchange_retry_budget_s":
+                props.get("exchange_retry_budget_s"),
+            # adaptive replanning: estimate-vs-observed divergence
+            # threshold + the broadcast cutoff the flip re-checks
+            "statistics_enabled": props.get("statistics_enabled"),
+            "adaptive_replan_factor":
+                props.get("adaptive_replan_factor"),
+            "broadcast_join_threshold_rows":
+                props.get("broadcast_join_threshold_rows"),
+            # device-fault supervision (runtime/supervisor.py)
+            "device_fault_max_strikes":
+                props.get("device_fault_max_strikes"),
+            "device_probe_backoff_s":
+                props.get("device_probe_backoff_s"),
+            "device_watchdog_timeout_s":
+                props.get("device_watchdog_timeout_s"),
+            "device_cpu_fallback":
+                props.get("device_cpu_fallback"),
+            # per-operator timeline (obs/opstats): workers run
+            # eager with node stats and roll frames into TaskInfo
+            "operator_stats": props.get("operator_stats"),
+            "straggler_dispersion_factor":
+                props.get("straggler_dispersion_factor"),
+        }
+
+    def _run_fte(
+        self,
+        q: QueryExecution,
+        plan,
+        qid: Optional[str] = None,
+        precommitted=None,
+        wal_qid: Optional[str] = None,
+    ) -> Page:
+        """One FTE (retry_policy=task) run with WAL intent hooks bound
+        to ``wal_qid`` — always the ORIGINAL query id, so that a resumed
+        run (which spools under an epoch-suffixed ``qid``) keeps
+        journaling against the same replay key and a second crash
+        resumes from the union of both epochs' committed records."""
+        from .fte import FaultTolerantScheduler
+
+        wal_key = wal_qid or q.query_id
+        on_dispatch = on_commit = None
+        if self.wal is not None:
+            def on_dispatch(task_id, uri):
+                self.wal.record(
+                    _recovery.TASK_DISPATCHED, wal_key,
+                    taskId=task_id, uri=uri,
+                )
+
+            def on_commit(sig, task_index, path):
+                self.wal.record(
+                    _recovery.TASK_COMMITTED, wal_key,
+                    fragmentSig=sig, taskIndex=task_index,
+                    spoolPath=path,
+                )
+
+        fte = FaultTolerantScheduler(
+            self.session.catalogs, self.node_manager,
+            properties=self._task_properties(),
+            metadata=self.session.metadata,
+            precommitted=precommitted,
+            on_dispatch=on_dispatch, on_commit=on_commit,
+        )
+        page = fte.run(plan, qid or q.query_id)
+        q.adaptive_actions = fte.adaptive_actions
+        q.task_stats = getattr(fte, "task_stats", [])
+        q.straggler_flags = list(
+            getattr(getattr(fte, "straggler", None), "flags", ())
+        )
         return page
 
     def _run_with_query_retries(
@@ -882,6 +1000,17 @@ class Coordinator:
             "tasks": tasks,
         }
 
+    def in_recovery_window(self) -> bool:
+        """True while a restarted coordinator may still be replaying its
+        WAL: polls for query ids we don't know yet answer 503 +
+        Retry-After (the client waits) instead of 404 (the client would
+        fail).  Closed as soon as the recovery pass finishes — after
+        that an unknown id is genuinely unknown."""
+        r = self.recovery
+        if r is None or r.done.is_set():
+            return False
+        return time.time() < self.started + r.window_s
+
     def cancel(self, query_id: str):
         q = self.queries.get(query_id)
         if q:
@@ -889,6 +1018,7 @@ class Coordinator:
                 if q.state not in ("FINISHED", "FAILED"):
                     q.state = "FAILED"
                     q.error = "Query was canceled"
+                    q.finished = time.time()
 
     # -- protocol documents ---------------------------------------------
     def results_doc(self, q: QueryExecution, token: int) -> dict:
@@ -936,11 +1066,14 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet
         pass
 
-    def _json(self, code: int, doc: dict):
+    def _json(self, code: int, doc: dict,
+              headers: Optional[dict] = None):
         body = json.dumps(doc).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -1089,6 +1222,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # as the one executing node when no workers announced)
                 "activeWorkers": len(nm.alive()) if nm is not None else 1,
                 "uptimeSeconds": time.time() - co.started,
+                # restart-recovery outcome (0/0 when no WAL configured)
+                "recoveredQueries": co.recovered_queries,
+                "orphanedQueries": co.orphaned_queries,
             })
             return
         if self.path == "/v1/memory":
@@ -1221,6 +1357,16 @@ class _Handler(BaseHTTPRequestHandler):
         ):
             _, _, _, qid, slug, token = parts
             q = co.queries.get(qid)
+            if q is None and co.in_recovery_window():
+                # restart transparency: this id may still be sitting in
+                # the WAL scan — tell the client to wait, not to die
+                self._json(
+                    503,
+                    {"error": "coordinator recovering; retry shortly",
+                     "retryable": True},
+                    headers={"Retry-After": "1"},
+                )
+                return
             if q is None or q.slug != slug:
                 self._json(404, {"error": "query not found"})
                 return
@@ -1257,10 +1403,12 @@ class CoordinatorServer:
     def __init__(self, session: Session, port: int = 0,
                  distributed: bool = False,
                  resource_groups: Optional[dict] = None,
-                 authenticator=None):
+                 authenticator=None,
+                 fault_injection: Optional[dict] = None):
         self.coordinator = Coordinator(
             session, distributed=distributed,
             resource_groups=resource_groups, authenticator=authenticator,
+            fault_injection=fault_injection,
         )
         handler = type("Handler", (_Handler,), {"coordinator": self.coordinator})
         # serving posture: the stdlib default listen backlog of 5 resets

@@ -249,6 +249,22 @@ class NodeManager:
             ]
         return sorted(out)
 
+    def await_alive(
+        self, min_nodes: int = 1, timeout: float = 10.0
+    ) -> List[Tuple[str, str]]:
+        """Block until at least ``min_nodes`` workers are ACTIVE or the
+        timeout lapses; returns the alive view either way.  A restarted
+        coordinator uses this to re-adopt the surviving worker set from
+        discovery re-announcements (workers target the fixed coordinator
+        URI, so survivors re-announce within one heartbeat interval)
+        before dispatching any resumed work."""
+        deadline = time.time() + max(float(timeout), 0.0)
+        while True:
+            alive = self.alive()
+            if len(alive) >= int(min_nodes) or time.time() >= deadline:
+                return alive
+            time.sleep(0.05)
+
     def lifecycle_states(self) -> Dict[str, str]:
         """node_id -> lifecycle state (the scheduler's exclusion map)."""
         self.tick()

@@ -107,11 +107,26 @@ class FaultTolerantScheduler:
         exchange: Optional[FileSystemExchangeManager] = None,
         properties: Optional[dict] = None,
         metadata=None,
+        precommitted: Optional[Dict[str, List[Optional[str]]]] = None,
+        on_dispatch=None,
+        on_commit=None,
     ):
         self.catalogs = catalogs
         self.node_manager = node_manager
         self.exchange = exchange or FileSystemExchangeManager()
         self.properties = properties or {}
+        # coordinator-restart resume (server/recovery.py): committed
+        # spool paths replayed from the WAL, keyed by structural fragment
+        # signature — stages whose signature matches reuse them verbatim
+        # and only UNFINISHED work re-runs.  Entries are re-verified on
+        # disk (every path present + _COMMIT intact) before seeding.
+        self.precommitted = dict(precommitted or {})
+        # WAL intent hooks: on_dispatch(task_id, uri) after an attempt is
+        # POSTed, on_commit(fragment_sig, task_index, spool_path) once an
+        # attempt's spool wins — the coordinator journals both so a crash
+        # between stages resumes instead of re-running
+        self.on_dispatch = on_dispatch
+        self.on_commit = on_commit
         p = self.properties
         # table statistics for per-fragment output estimates (the
         # OutputStatsEstimator's *expected* side); None disables the
@@ -171,6 +186,17 @@ class FaultTolerantScheduler:
         # a structurally identical fragment: spools are reused by signature
         committed_by_sig: Dict[str, List[str]] = {}
         stats_by_sig: Dict[str, Tuple[int, int]] = {}
+        # restart resume: seed the signature map from WAL-replayed spool
+        # paths, but only stages whose every attempt is still committed
+        # on disk — a stale/evicted spool silently re-runs the stage
+        # (width and buffer count are in the signature, so a changed
+        # cluster size safely disables reuse instead of misrouting)
+        for sig, paths in self.precommitted.items():
+            if not paths or any(p is None for p in paths):
+                continue
+            if all(SpoolHandle(p).committed for p in paths):
+                committed_by_sig[sig] = list(paths)
+                stats_by_sig[sig] = self._spool_stats(list(paths))
         replans = 0
         try:
             while True:
@@ -225,7 +251,8 @@ class FaultTolerantScheduler:
                         self.output_rows[f.id] = r
                         continue
                     committed[f.id] = self._run_stage(
-                        epoch_qid, f, width, committed, by_id, consumer
+                        epoch_qid, f, width, committed, by_id, consumer,
+                        sig=sigs[f.id],
                     )
                     committed_by_sig[sigs[f.id]] = committed[f.id]
                     if not adaptive:
@@ -307,6 +334,7 @@ class FaultTolerantScheduler:
         committed: Dict[int, List[str]],
         by_id: Dict[int, PlanFragment],
         consumer: Dict[int, int],
+        sig: Optional[str] = None,
     ) -> List[str]:
         ntasks = width[f.id]
         out_buffers = (
@@ -330,6 +358,15 @@ class FaultTolerantScheduler:
                 for i in range(ntasks)
             ]
             paths = [fut.result() for fut in futures]
+        if self.on_commit is not None and sig is not None:
+            # journal every winning attempt's spool path BEFORE the next
+            # stage consumes it: a coordinator crash from here on resumes
+            # this stage by signature instead of re-running it
+            for i, path in enumerate(paths):
+                try:
+                    self.on_commit(sig, i, path)
+                except Exception:
+                    pass
         # retained so a later-detected corrupt committed attempt can be
         # healed by re-running exactly one producer task of this stage;
         # keyed by the fragment's spool dir (stable across a replan's
@@ -792,6 +829,14 @@ class FaultTolerantScheduler:
         # end-of-query cleanup must cover that half-created task too
         self._created_tasks.append((uri, task_id))
         _post_json(f"{uri}/v1/task/{task_id}", doc)
+        if self.on_dispatch is not None:
+            # WAL intent: this attempt now exists on a worker — a crashed
+            # coordinator's replay knows work was in flight even if no
+            # commit record ever follows
+            try:
+                self.on_dispatch(task_id, uri)
+            except Exception:
+                pass
         from ..utils.metrics import REGISTRY
 
         REGISTRY.counter(

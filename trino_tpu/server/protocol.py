@@ -68,10 +68,33 @@ def query_results(
         doc["columns"] = columns_json(page, types)
         doc["data"] = data_json(page)
     if error:
-        doc["error"] = {
-            "message": error,
-            "errorCode": 1,
-            "errorName": "GENERIC_INTERNAL_ERROR",
-            "errorType": "INTERNAL_ERROR",
-        }
+        doc["error"] = error_json(error)
     return doc
+
+
+# error strings carrying one of these structured prefixes ("CODE: ...")
+# render as named retryable errors (EXTERNAL, like the reference's
+# REMOTE_HOST_GONE retry class) instead of GENERIC_INTERNAL_ERROR —
+# clients re-submit instead of surfacing a failure
+RETRYABLE_ERROR_CODES = {
+    "COORDINATOR_RESTART": 65544,
+}
+
+
+def error_json(error: str) -> dict:
+    code, sep, _rest = str(error).partition(":")
+    code = code.strip()
+    if sep and code in RETRYABLE_ERROR_CODES:
+        return {
+            "message": error,
+            "errorCode": RETRYABLE_ERROR_CODES[code],
+            "errorName": code,
+            "errorType": "EXTERNAL",
+            "retriable": True,
+        }
+    return {
+        "message": error,
+        "errorCode": 1,
+        "errorName": "GENERIC_INTERNAL_ERROR",
+        "errorType": "INTERNAL_ERROR",
+    }

@@ -47,6 +47,40 @@ def _build_catalogs(catalogs: Sequence[Tuple[str, str, dict]]) -> CatalogManager
     return cm
 
 
+def spawn_subprocess_worker(
+    coordinator_uri: str,
+    catalog_spec: Sequence[Tuple[str, str, dict]],
+    fault_injection: Optional[dict] = None,
+) -> Tuple[subprocess.Popen, str, str]:
+    """Spawn one worker as a real child process (worker_main.py) and
+    block until it prints its announce line; returns (Popen, node_id,
+    uri).  Shared by the in-process runner and SubprocessCoordinator —
+    the caller decides how to wait for discovery adoption."""
+    cmd = [
+        sys.executable, "-m", "trino_tpu.server.worker_main",
+        "--coordinator", coordinator_uri,
+        "--catalogs", json.dumps(
+            [[n, c, cfg] for n, c, cfg in catalog_spec]
+        ),
+    ]
+    if fault_injection:
+        cmd += ["--fault-injection", json.dumps(fault_injection)]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env,
+    )
+    line = proc.stdout.readline()  # blocks until the worker is up
+    if not line:
+        proc.kill()
+        raise RuntimeError(
+            f"subprocess worker exited before announcing (rc={proc.poll()})"
+        )
+    doc = json.loads(line)
+    return proc, doc["nodeId"], doc["uri"]
+
+
 class DistributedQueryRunner:
     """Coordinator + N workers, all in-process, real HTTP between them."""
 
@@ -119,30 +153,9 @@ class DistributedQueryRunner:
         wait until it announces.  Unlike the in-process workers this one
         can be SIGKILLed for true kill -9 chaos: no drain, no goodbye,
         its sockets refuse instantly.  Returns (Popen, node_id, uri)."""
-        cmd = [
-            sys.executable, "-m", "trino_tpu.server.worker_main",
-            "--coordinator", self.coordinator.uri,
-            "--catalogs", json.dumps(
-                [[n, c, cfg] for n, c, cfg in self._catalog_spec]
-            ),
-        ]
-        if fault_injection:
-            cmd += ["--fault-injection", json.dumps(fault_injection)]
-        env = dict(os.environ)
-        env.setdefault("JAX_PLATFORMS", "cpu")
-        proc = subprocess.Popen(
-            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, env=env,
+        proc, node_id, uri = spawn_subprocess_worker(
+            self.coordinator.uri, self._catalog_spec, fault_injection
         )
-        line = proc.stdout.readline()  # blocks until the worker is up
-        if not line:
-            proc.kill()
-            raise RuntimeError(
-                "subprocess worker exited before announcing "
-                f"(rc={proc.poll()})"
-            )
-        doc = json.loads(line)
-        node_id, uri = doc["nodeId"], doc["uri"]
         nm = self.coordinator.coordinator.node_manager
         deadline = time.time() + startup_timeout
         while time.time() < deadline:
@@ -192,6 +205,172 @@ class DistributedQueryRunner:
             proc.wait()
         self.subprocess_workers = []
         self.coordinator.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class SubprocessCoordinator:
+    """A coordinator the OS can actually kill (coordinator_main.py).
+
+    The crash-recovery harness: the coordinator runs as a real child
+    process, so SIGKILL vaporizes its in-memory query state machine
+    mid-flight — only the mmap'd WAL in ``coordinator_recovery_dir``
+    survives.  ``restart()`` re-spawns it on the SAME port with the same
+    properties, which is exactly the production recovery contract:
+    surviving subprocess workers (spawned against the fixed URI)
+    re-announce within a heartbeat, the WAL replays, FTE queries resume
+    from committed spools, and clients polling query-id-addressed
+    nextUris reconnect through the restart grace.
+    """
+
+    def __init__(
+        self,
+        catalogs: Sequence[Tuple[str, str, dict]] = DEFAULT_CATALOGS,
+        properties: Optional[dict] = None,
+        port: int = 0,
+        fault_injection: Optional[dict] = None,
+        startup_timeout: float = 120.0,
+    ):
+        self._catalog_spec = [
+            (name, connector, dict(config))
+            for name, connector, config in catalogs
+        ]
+        self.properties = dict(properties or {})
+        self.fault_injection = fault_injection
+        self.startup_timeout = float(startup_timeout)
+        # (Popen, node_id, uri) of workers spawned via add_worker; they
+        # outlive a coordinator kill (that's the point) and re-announce
+        # to the same URI once it rebinds
+        self.subprocess_workers: List[tuple] = []
+        self.proc: Optional[subprocess.Popen] = None
+        self.uri = ""
+        self.port = int(port)
+        self.node_id = ""
+        self._spawn(self.port, fault_injection)
+
+    def _spawn(self, port: int, fault_injection: Optional[dict]):
+        cmd = [
+            sys.executable, "-m", "trino_tpu.server.coordinator_main",
+            "--port", str(port),
+            "--catalogs", json.dumps(
+                [[n, c, cfg] for n, c, cfg in self._catalog_spec]
+            ),
+            "--properties", json.dumps(self.properties),
+        ]
+        if fault_injection:
+            cmd += ["--fault-injection", json.dumps(fault_injection)]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env,
+        )
+        line = self.proc.stdout.readline()  # blocks until it binds
+        if not line:
+            self.proc.kill()
+            raise RuntimeError(
+                "subprocess coordinator exited before announcing "
+                f"(rc={self.proc.poll()})"
+            )
+        doc = json.loads(line)
+        self.uri, self.port = doc["uri"], int(doc["port"])
+        self.node_id = doc["nodeId"]
+
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"{self.uri}/v1/status", timeout=5.0
+        ) as resp:
+            return json.loads(resp.read())
+
+    def wait_for_workers(self, n: int, timeout: float = 60.0):
+        """Poll /v1/status until ``n`` workers are ACTIVE (the
+        coordinator is out-of-process, so its node manager is only
+        reachable over HTTP)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                if self.status().get("activeWorkers", 0) >= n:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.1)
+        raise RuntimeError(
+            f"fewer than {n} workers announced to {self.uri} "
+            f"in {timeout}s"
+        )
+
+    def add_worker(
+        self, fault_injection: Optional[dict] = None,
+        startup_timeout: float = 60.0,
+    ) -> tuple:
+        """Spawn a subprocess worker against this coordinator and wait
+        until discovery adopts it.  Returns (Popen, node_id, uri)."""
+        entry = spawn_subprocess_worker(
+            self.uri, self._catalog_spec, fault_injection
+        )
+        self.subprocess_workers.append(entry)
+        self.wait_for_workers(
+            len(self.subprocess_workers), startup_timeout
+        )
+        return entry
+
+    def sigkill(self) -> int:
+        """kill -9 the coordinator: no drain, no flush beyond the mmap'd
+        WAL pages, every client socket refuses instantly.  Workers stay
+        up.  Returns the pid that died."""
+        pid = self.proc.pid
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        self.proc.wait()
+        return pid
+
+    def restart(
+        self, fault_injection: Optional[dict] = None,
+        startup_timeout: Optional[float] = None,
+    ) -> "SubprocessCoordinator":
+        """Re-spawn on the SAME port with the same properties (recovery
+        dir included).  A fresh fault-injection spec replaces the old
+        one — the restarted coordinator usually must NOT re-arm the
+        crash site that killed its predecessor."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.sigkill()
+        deadline = time.time() + (startup_timeout or self.startup_timeout)
+        last_err = None
+        while True:
+            # the dying process's socket may linger in the kernel for a
+            # beat even after SIGKILL; same-port rebind retries briefly
+            try:
+                self._spawn(self.port, fault_injection)
+                return self
+            except RuntimeError as e:
+                last_err = e
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+
+    def stop(self):
+        for proc, _, _ in self.subprocess_workers:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+            proc.wait()
+        self.subprocess_workers = []
+        if self.proc is not None:
+            try:
+                self.proc.kill()
+            except Exception:
+                pass
+            self.proc.wait()
 
     def __enter__(self):
         return self

@@ -52,6 +52,14 @@ SITES = (
                             # crash) at the supervised boundary
     "device_wedge",         # kernel dispatch stalls past the watchdog
                             # timeout (rule field `stall_s` overrides)
+    "coordinator_death",    # hard coordinator exit (kill -9 analog) at a
+                            # chosen WAL transition, keyed
+                            # "{recordType}:{queryId}" so `match` picks
+                            # the exact transition; only honored by the
+                            # COORDINATOR-LEVEL injector of a subprocess
+                            # coordinator (server/coordinator_main.py) —
+                            # an in-process coordinator firing it would
+                            # take the whole test runner down
 )
 
 

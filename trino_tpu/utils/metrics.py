@@ -47,6 +47,7 @@ METRIC_SUBSYSTEMS = (
     "resource_group",
     "autoscaler",
     "compile",
+    "coordinator",
 )
 
 METRIC_NAME_RE = re.compile(
