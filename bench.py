@@ -1416,6 +1416,10 @@ def main():
                 **CACHE_PROPS,
                 "compile_observatory_dir": obs_dir,
                 "compile_cache_dir": cache_dir,
+                # the serving observatory shares the obs dir (distinct
+                # so- file prefix): the signature census this run
+                # records merges into the next run's boot
+                "serving_observatory_dir": obs_dir,
             },
             resource_groups=resource_groups,
         ) as runner:
@@ -1465,9 +1469,22 @@ def main():
                     warm_start_wall_s = time.perf_counter() - t_run
                 last_ok, last_compiles = ok_now, compiles
 
+            from trino_tpu.obs import journal as _journal
+
+            def _slo_burns():
+                return sum(
+                    1 for e in _journal.get_journal().tail()
+                    if e.get("eventType") == _journal.SLO_BURN
+                )
+
             miss_mark = _compile_marks()["byCause"].get(_co.SHAPE_MISS, 0)
+            burn_mark = _slo_burns()
             phase_ref["phase"] = "steady"
             time.sleep(steady_s)
+            # the CI gate asserts a warm steady state burns no tenant's
+            # fast-window budget; the flood phase after this mark is
+            # EXPECTED to burn (that's the chaos the doctor cites)
+            steady_burns = _slo_burns() - burn_mark
             if flood_s:
                 # fairness chaos: adhoc floods 10x its steady sessions
                 phase_ref["phase"] = "flood"
@@ -1492,7 +1509,11 @@ def main():
                 _compile_marks()["byCause"].get(_co.SHAPE_MISS, 0)
                 - miss_mark
             )
+            coord_node = runner.coordinator.coordinator.node_id
             _co.sync()  # flush census-*.json for bucket_ladder.py
+            from trino_tpu.obs import serving_observatory as _so
+
+            _so.sync()  # flush so-*.jsonl census segments
         wall = time.perf_counter() - t_run
 
         # compile-once ABI verdicts: distinct compiled programs per
@@ -1600,6 +1621,46 @@ def main():
             "workers_final": workers_final,
             "groups": group_stats,
         }
+        # per-tenant SLO compliance + burn peaks and the top-signatures
+        # census block (the serving observatory's decision-grade view of
+        # this run); steady_fast_window_burns is the CI gate's field
+        sobs = _so.get_observatory()
+        result["steady_fast_window_burns"] = steady_burns
+        result["slo"] = {
+            r["tenant"]: {
+                "latency_target_s": r["latencyTargetS"],
+                "error_budget": r["errorBudget"],
+                "fast_burn_rate": round(r["fastBurnRate"], 3),
+                "slow_burn_rate": round(r["slowBurnRate"], 3),
+                "peak_fast_burn": round(r["peakFastBurn"], 3),
+                "violations": r["violationsTotal"],
+                "observed": r["observedTotal"],
+                "burn_events": r["burnEvents"],
+                "compliance": (
+                    round(
+                        1.0 - r["violationsTotal"] / r["observedTotal"],
+                        4,
+                    )
+                    if r["observedTotal"] else None
+                ),
+                "p99_ms": round(r["p99S"] * 1e3, 1),
+            }
+            for r in sobs.slo_rows()
+        }
+        result["top_signatures"] = [
+            {
+                "signature": s["signature"][:12],
+                "tenant": s["tenant"],
+                "count": s["count"],
+                "rate_per_s": round(s["ratePerS"], 2),
+                "p99_ms": round(s["p99S"] * 1e3, 1),
+                "drift_ratio": round(s["driftRatio"], 2),
+                "cache_hits": s["cacheHits"],
+                "cache_misses": s["cacheMisses"],
+                "warmest_node": s["warmestNode"],
+            }
+            for s in sobs.top_signatures(10, local_node_id=coord_node)
+        ]
         if steady_miss:
             # name the offenders so the CI failure is actionable
             try:

@@ -45,6 +45,15 @@ round:
                       wall at p99; advisory — the hard zero-miss gate
                       lives in scripts/check_serve_smoke.py, this only
                       annotates the trajectory
+    slo-burn-regression
+                      a serve_* config journaled fast-window SLO burn
+                      events DURING the steady state (serving
+                      observatory): a warm, uncontended serve mix is
+                      burning tenant error budgets, so the flood phase
+                      no longer explains the violations; advisory — the
+                      hard zero-steady-burn gate lives in
+                      scripts/check_serve_smoke.py, this only annotates
+                      the trajectory
     padding-waste-regression
                       the bucketed-batch ABI's padding overhead blew
                       its budget: a config's padded/actual row ratio
@@ -200,6 +209,7 @@ def load_round(path: str) -> dict:
                 "steady_state_shape_miss_compiles"
             ),
             "warm_start_wall_s": cfg.get("warm_start_wall_s"),
+            "slo_fast_burns": cfg.get("steady_fast_window_burns"),
         }
     # bucketed-batch ABI padding overhead: every config (timed or serve)
     # may carry padded_waste_ratio — padded rows the dispatched ladder
@@ -444,6 +454,27 @@ def judge(rounds: List[dict]) -> List[dict]:
             v["verdict"] = "retrace-regression"
             sep = "; " if v["reason"] else ""
             v["reason"] += sep + "; ".join(retraced)
+        # steady-burn check (serving observatory): SLO burn events
+        # during a serve config's steady state mean tenant error
+        # budgets are being spent on warm, uncontended traffic — the
+        # flood no longer explains the violations.  Advisory — the
+        # serve-smoke CI gate (check_serve_smoke.py) is the hard
+        # zero-steady-burn assertion; here it only annotates
+        # otherwise-healthy rounds
+        burned = []
+        for name, s in sorted((r.get("serve") or {}).items()):
+            nb = s.get("slo_fast_burns")
+            if nb is not None and int(nb) > 0:
+                burned.append(
+                    "%s burned its fast SLO window %d time(s) in "
+                    "steady state" % (name, int(nb))
+                )
+        if burned and v["verdict"] in (
+            "steady", "improved", "baseline", "unknown"
+        ):
+            v["verdict"] = "slo-burn-regression"
+            sep = "; " if v["reason"] else ""
+            v["reason"] += sep + "; ".join(burned)
         # padding-budget check (bucketed-batch ABI): the ladder buys a
         # bounded program count by rounding capacities up — the sentinel
         # watches the price.  A config whose padded/actual ratio blew
@@ -511,7 +542,7 @@ def to_markdown(verdicts: List[dict]) -> str:
         if v["verdict"] in (
             "regression", "crash-introduced", "bandwidth-regression",
             "mesh-scaling-regression", "serve-slo-regression",
-            "retrace-regression",
+            "retrace-regression", "slo-burn-regression",
         )
     ]
     lines.append("")

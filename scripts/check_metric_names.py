@@ -60,6 +60,13 @@ AUTOSCALER_LITERAL_RE = re.compile(
 COMPILE_LITERAL_RE = re.compile(
     r'["\'](trino_tpu_compile_[a-z0-9_]*)["\']'
 )
+# serving-observatory literals likewise: the serve-smoke SLO gate and
+# the signature-census acceptance tests assert on these series by full
+# name
+SLO_LITERAL_RE = re.compile(r'["\'](trino_tpu_slo_[a-z0-9_]*)["\']')
+SIGNATURE_LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_signature_[a-z0-9_]*)["\']'
+)
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -100,7 +107,7 @@ def check_tree(root: str):
             REGISTRATION_RE, LITERAL_RE, MEMORY_LITERAL_RE,
             NODE_LITERAL_RE, JOURNAL_LITERAL_RE, DOCTOR_LITERAL_RE,
             RESOURCE_GROUP_LITERAL_RE, AUTOSCALER_LITERAL_RE,
-            COMPILE_LITERAL_RE,
+            COMPILE_LITERAL_RE, SLO_LITERAL_RE, SIGNATURE_LITERAL_RE,
         ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
@@ -146,6 +153,14 @@ def check_tree(root: str):
          "trino_tpu.obs.compile_observatory", "CENSUS_FIELDS"),
         ("trino_tpu/server/recovery.py",
          "trino_tpu.server.recovery", "WAL_FIELDS"),
+        ("trino_tpu/obs/serving_observatory.py",
+         "trino_tpu.obs.serving_observatory", "OBSERVATION_FIELDS"),
+        ("trino_tpu/obs/serving_observatory.py",
+         "trino_tpu.obs.serving_observatory", "SIGNATURE_FIELDS"),
+        ("trino_tpu/obs/serving_observatory.py",
+         "trino_tpu.obs.serving_observatory", "AFFINITY_FIELDS"),
+        ("trino_tpu/obs/serving_observatory.py",
+         "trino_tpu.obs.serving_observatory", "SLO_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

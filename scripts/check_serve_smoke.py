@@ -20,7 +20,13 @@ and fails when:
   * any kernel family compiled more distinct programs than the padding
     ladder has rungs — the bucketed-batch ABI's whole contract is that
     program counts are bounded by ladder size, so exceeding it means a
-    capacity leaked around the ladder's quantize.
+    capacity leaked around the ladder's quantize, or
+  * the per-tenant SLO accounting block is missing its burn-rate
+    fields — the serving observatory stopped measuring compliance, or
+  * ANY tenant burned its fast-window SLO budget during the steady
+    state: the smoke runs warm at tiny QPS under generous objectives,
+    so a steady-state slo_burn event means the serving path regressed
+    (floods are expected to burn; steady state never is).
 
 Exit 0 with a one-line summary on success, 1 with the reason otherwise.
 """
@@ -109,11 +115,42 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    slo = result.get("slo") or {}
+    need = ("fast_burn_rate", "slow_burn_rate", "peak_fast_burn",
+            "violations", "observed")
+    have_slo = bool(slo) and all(
+        all(k in t for k in need) for t in slo.values()
+    )
+    if not have_slo:
+        print(
+            "serve smoke: per-tenant SLO accounting missing or "
+            f"incomplete (slo={sorted(slo)}) — the serving observatory "
+            "stopped measuring compliance",
+            file=sys.stderr,
+        )
+        return 1
+    burns = result.get("steady_fast_window_burns")
+    if burns is None:
+        print(
+            "serve smoke: steady_fast_window_burns missing — the bench "
+            "stopped splitting steady-state SLO burns from the flood",
+            file=sys.stderr,
+        )
+        return 1
+    if int(burns):
+        print(
+            f"serve smoke: {burns} fast-window SLO burn(s) during the "
+            f"steady state (slo={slo}) — a warm, uncontended serve mix "
+            "is burning tenant error budgets",
+            file=sys.stderr,
+        )
+        return 1
     print(
         f"serve smoke ok: {done} queries across {len(tenants)} tenants, "
         f"qps={result.get('qps')}, shed={result.get('shed_total')}, "
         f"0 failed, 0 steady-state shape-miss compiles, "
-        f"max programs/family {max_prog} <= ladder {ladder_size}"
+        f"max programs/family {max_prog} <= ladder {ladder_size}, "
+        f"{len(slo)} tenant SLO(s) with 0 steady fast-window burns"
     )
     return 0
 

@@ -353,6 +353,11 @@ def _healthy_result(**over):
         "steady_state_shape_miss_compiles": 0,
         "ladder_size": 24, "max_programs_per_family": 2,
         "qps": 5.0, "shed_total": 0,
+        "steady_fast_window_burns": 0,
+        "slo": {"interactive": {
+            "fast_burn_rate": 0.0, "slow_burn_rate": 0.0,
+            "peak_fast_burn": 0.0, "violations": 0, "observed": 5,
+        }},
     }
     base.update(over)
     return base

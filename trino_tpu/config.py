@@ -297,6 +297,58 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 64,
         ),
         PropertyMetadata(
+            "serving_observatory_dir",
+            "directory for the crash-safe per-signature workload census "
+            "(mmap'd torn-tail-tolerant JSONL segments, merged across "
+            "restarts and backfilled from the persisted query history); "
+            "empty keeps the serving observatory in-memory only",
+            str, "",
+        ),
+        PropertyMetadata(
+            "serving_observatory_max_bytes",
+            "byte budget for the serving observatory's two on-disk "
+            "census segments",
+            int, 1 << 20,
+        ),
+        PropertyMetadata(
+            "signature_census_max",
+            "bound on distinct plan signatures the workload census "
+            "profiles (overflow folds into __other__, never dropped)",
+            int, 128,
+        ),
+        PropertyMetadata(
+            "slo_latency_target_s",
+            "default per-tenant latency objective: a finished query "
+            "slower than this (or any failed query) burns its tenant's "
+            "SLO error budget",
+            float, 1.0,
+        ),
+        PropertyMetadata(
+            "slo_error_budget",
+            "default fraction of a tenant's queries allowed to violate "
+            "the latency objective before the burn rate exceeds 1.0",
+            float, 0.1,
+        ),
+        PropertyMetadata(
+            "slo_fast_window_s",
+            "fast SLO burn-rate window (page-now signal; a burn past "
+            "slo_burn_threshold here journals a throttled slo_burn "
+            "event)",
+            float, 30.0,
+        ),
+        PropertyMetadata(
+            "slo_slow_window_s",
+            "slow SLO burn-rate window (sustained-breach signal for "
+            "system.runtime.slos and the webui panel)",
+            float, 300.0,
+        ),
+        PropertyMetadata(
+            "slo_burn_threshold",
+            "fast-window burn rate above which the serving observatory "
+            "journals slo_burn and the query doctor starts citing it",
+            float, 2.0,
+        ),
+        PropertyMetadata(
             "query_doctor",
             "run the automated query doctor at query finalize and "
             "attach its ranked root-cause diagnosis to EXPLAIN ANALYZE, "

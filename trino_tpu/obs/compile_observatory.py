@@ -579,6 +579,32 @@ class CompileObservatory:
                 wall += float(entry.get("compileWallS") or 0.0)
         return wall
 
+    def node_family_map(
+        self, local_node_id: str = "local"
+    ) -> Dict[str, set]:
+        """Which kernel-family digests each node holds warm compiles
+        for: the local process's census plus every ingested worker's
+        latest cumulative piggyback.  The serving observatory joins
+        this against per-signature family digests to build
+        ``system.runtime.signature_affinity``.  In an in-process
+        cluster the workers share this very observatory, so all warmth
+        appears under ``local_node_id`` — subprocess/remote workers
+        each get their own row via announcements."""
+        out: Dict[str, set] = {}
+        with self._lock:
+            local = set(self.census.families) | set(self._families)
+            if local:
+                out[str(local_node_id or "local")] = local
+            for node_id, entry in (
+                getattr(self, "_remote", None) or {}
+            ).items():
+                fams = set(
+                    ((entry.get("census") or {}).get("families") or {})
+                )
+                if fams:
+                    out[str(node_id)] = fams
+        return out
+
     def merged_census(self) -> ShapeCensus:
         """Engine-wide census view: the local sketch plus each ingested
         worker's latest cumulative snapshot (snapshots replace per node,

@@ -301,6 +301,33 @@ def _rule_overload(ctx) -> Optional[Dict]:
                     sheds + timeouts + rescues + scales)
 
 
+def _rule_slo_burn(ctx) -> Optional[Dict]:
+    """A tenant burned its SLO error budget past the fast-window
+    threshold while this query ran.  Ranked directly below overload:
+    shed/queue pressure is usually *why* the budget burns, so when both
+    fire the overload verdict stays the root cause and the burn rides
+    along as a secondary finding."""
+    burns = _events_of(ctx, J.SLO_BURN)
+    if not burns:
+        return None
+    worst = max(
+        burns,
+        key=lambda e: float((e.get("detail") or {}).get("burnRate") or 0.0),
+    )
+    d = worst.get("detail") or {}
+    summary = (
+        "slo burn: tenant %s burning its error budget at %.1fx over the "
+        "%.0fs fast window (target %.2fs, budget %.0f%%)" % (
+            d.get("tenant") or "?",
+            float(d.get("burnRate") or 0.0),
+            float(d.get("windowS") or 0.0),
+            float(d.get("latencyTargetS") or 0.0),
+            float(d.get("errorBudget") or 0.0) * 100.0,
+        )
+    )
+    return _finding("slo_burn", J.WARN, summary, burns)
+
+
 def _rule_memory_pressure(ctx) -> Optional[Dict]:
     oom = _events_of(ctx, J.FAULT_INJECTED, sites=("oom",))
     revokes = _events_of(ctx, J.MEMORY_REVOKE)
@@ -462,6 +489,10 @@ _RULES = (
     # above memory pressure (a backed-up admission queue is usually the
     # overload's symptom, not an independent cause)
     _rule_overload,
+    # slo burn directly below overload: shed/queue pressure is usually
+    # why a tenant's budget burns, so when both fire the overload
+    # verdict stays the root cause and the burn is secondary
+    _rule_slo_burn,
     _rule_memory_pressure,
     # retrace storms directly below memory pressure: recompile bursts
     # under memory churn are usually the pressure's symptom (capacity

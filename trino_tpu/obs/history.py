@@ -40,6 +40,8 @@ HISTORY_FIELDS = (
     "wallS",
     "error",
     "errorCode",
+    "tenant",
+    "planSignature",
     "operators",
     "ts",
 )
@@ -196,6 +198,14 @@ class QueryHistoryStore:
             "error": entry.get("error"),
             "errorCode": (
                 entry.get("error_code") or entry.get("errorCode") or ""
+            ),
+            # round 19: survive finalize so the serving observatory's
+            # census backfill at boot works from disk alone
+            "tenant": str(entry.get("tenant") or ""),
+            "planSignature": str(
+                entry.get("plan_signature")
+                or entry.get("planSignature")
+                or ""
             ),
             "operators": entry.get("operators"),
             "ts": time.time(),

@@ -83,6 +83,9 @@ QUEUE_TIMEOUT = "queue_timeout"
 SCALE_OUT = "scale_out"
 SCALE_IN = "scale_in"
 STARVATION_AVERTED = "starvation_averted"
+# serving observatory: a tenant's fast-window SLO burn rate crossed its
+# threshold (throttled: at most one event per fast window per tenant)
+SLO_BURN = "slo_burn"
 # coordinator crash recovery: restart scan + per-query WAL dispositions
 COORDINATOR_RESTART = "coordinator_restart"
 QUERY_RESUMED = "query_resumed"

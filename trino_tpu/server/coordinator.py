@@ -68,6 +68,11 @@ class QueryExecution:
         self.straggler_flags: list = []  # dispersion-detector verdicts
         self.session_executed = False  # ran via session.execute (history
         #                                already recorded there)
+        self.tenant = ""  # top-level resource group (serving observatory)
+        self.plan_signature = ""  # canonical plan digest (census key)
+        self.kernel_families: tuple = ()  # per-fragment family digests
+        self.result_cache_hit: Optional[bool] = None  # None = not keyed
+        self.result_cache_stored = False
         self.recovered = False  # re-registered from the WAL after restart
         self.resume_event_id: Optional[int] = None  # QUERY_RESUMED citation
         self.orphan_event_id: Optional[int] = None  # QUERY_ORPHANED citation
@@ -214,6 +219,13 @@ class Coordinator:
             threading.Thread(
                 target=self.recovery.run, daemon=True
             ).start()
+        # -- serving observatory (obs/serving_observatory.py) -----------
+        # per-signature census + cache-affinity map + per-tenant SLO
+        # burn monitor; persisted census when serving_observatory_dir is
+        # set, backfilled from whatever query history survived restarts
+        self.serving = self._configure_serving_observatory(
+            resource_groups
+        )
         self._stop_enforcement = threading.Event()
         if distributed:
             threading.Thread(
@@ -227,6 +239,110 @@ class Coordinator:
             # a ticker: an idle queue must shed on time, not on the next
             # submit
             threading.Thread(target=self._shed_loop, daemon=True).start()
+
+    def _configure_serving_observatory(self, resource_groups):
+        """Boot the process-global serving observatory from session
+        properties, declare per-tenant SLO objectives from the raw
+        resource-group spec (``sloLatencyTargetS``/``sloErrorBudget``
+        on top-level groups), and backfill the signature census from
+        whatever persisted query history survived earlier processes."""
+        from ..obs import serving_observatory as _so
+        from ..obs.history import get_store
+
+        props = self.session.properties
+
+        def _num(key, default):
+            try:
+                return float(props.get(key) or default)
+            except (TypeError, ValueError):
+                return default
+
+        obs = _so.configure(
+            props.get("serving_observatory_dir") or None,
+            max_bytes=props.get("serving_observatory_max_bytes"),
+            max_signatures=int(
+                props.get("signature_census_max")
+                or _so.DEFAULT_MAX_SIGNATURES
+            ),
+            slo={
+                "latency_target_s": _num(
+                    "slo_latency_target_s", _so.DEFAULT_LATENCY_TARGET_S
+                ),
+                "error_budget": _num(
+                    "slo_error_budget", _so.DEFAULT_ERROR_BUDGET
+                ),
+                "fast_window_s": _num(
+                    "slo_fast_window_s", _so.DEFAULT_FAST_WINDOW_S
+                ),
+                "slow_window_s": _num(
+                    "slo_slow_window_s", _so.DEFAULT_SLOW_WINDOW_S
+                ),
+                "burn_threshold": _num(
+                    "slo_burn_threshold", _so.DEFAULT_BURN_THRESHOLD
+                ),
+            },
+        )
+        # tenants are top-level groups OR the direct children of one
+        # (InternalResourceGroup.tenant), so SLO spec keys are honored
+        # on both levels of the tree
+        def _declare(spec):
+            if not isinstance(spec, dict) or not spec.get("name"):
+                return
+            target = spec.get("sloLatencyTargetS")
+            budget = spec.get("sloErrorBudget")
+            if target is not None or budget is not None:
+                obs.slo.set_objective(
+                    str(spec["name"]),
+                    latency_target_s=target,
+                    error_budget=budget,
+                )
+
+        for spec in (resource_groups or {}).get("groups") or []:
+            _declare(spec)
+            for sub in (
+                spec.get("subGroups") or ()
+                if isinstance(spec, dict)
+                else ()
+            ):
+                _declare(sub)
+        try:
+            store = get_store(
+                props.get("query_history_dir") or None,
+                max_bytes=int(
+                    props.get("query_history_max_bytes") or (1 << 20)
+                ),
+            )
+            obs.backfill_from_history(store.entries())
+        except Exception:  # noqa: BLE001 — backfill is best-effort
+            pass
+        # system-table scans run through the session; the affinity map
+        # needs to know which node id "this process" is
+        self.session.serving_node_id = self.node_id
+        return obs
+
+    def _stash_plan_telemetry(self, q: QueryExecution, plan) -> None:
+        """Stamp the query with its canonical plan signature and the
+        per-fragment kernel-family digests (the same
+        ``stable_key_digest(("family", fragment_fingerprint))`` the
+        executors' compile ledger records, so the affinity map can join
+        census rows against worker compile announcements)."""
+        try:
+            from ..cache.compile_cache import stable_key_digest
+            from ..cache.signature import (
+                fragment_fingerprint,
+                plan_signature,
+            )
+            from ..plan.fragment import fragment_plan
+
+            q.plan_signature = plan_signature(plan).digest
+            q.kernel_families = tuple(sorted({
+                stable_key_digest(
+                    ("family", fragment_fingerprint(f.root))
+                )[:12]
+                for f in fragment_plan(plan)
+            }))
+        except Exception:  # noqa: BLE001 — telemetry must not fail planning
+            pass
 
     def enable_autoscaler(self, scale_out=None, **overrides):
         """Attach the elasticity control loop.  ``scale_out`` is the
@@ -357,6 +473,9 @@ class Coordinator:
         ).inc()
         group = self.resource_groups.select(user, source)
         q.group = group
+        # the tenant survives on the query even after shed/queue-full
+        # paths null q.group — finalize charges the SLO monitor by it
+        q.tenant = group.tenant
         self.cluster_memory.note_query_tenant(q.query_id, group.tenant)
         if self.wal is not None:
             # intent first: the query durably exists (sql + slug + group
@@ -629,8 +748,15 @@ class Coordinator:
                 "wall_s": (q.finished or time.time()) - q.created,
                 "error": q.error,
                 "error_code": doctor.classify_error(q.error),
+                "tenant": getattr(q, "tenant", "") or "",
+                "plan_signature":
+                    getattr(q, "plan_signature", "") or "",
                 "operators": (q.timeline or {}).get("operators") or None,
             })
+        try:
+            self._observe_serving(q)
+        except Exception:
+            pass  # observability must never fail the query
         # the doctor's finalize pass: failed AND finished queries get a
         # verdict (HEALTHY is itself a signal), served by
         # GET /v1/query/{id}/diagnosis and system.runtime.diagnoses
@@ -646,6 +772,44 @@ class Coordinator:
             doctor.record_diagnosis(diag)
             q.diagnosis = diag
         self.cluster_memory.forget_query_tenant(q.query_id)
+
+    def _observe_serving(self, q: QueryExecution) -> None:
+        """Feed one terminal query into the serving observatory: the
+        signature census (latency/cost/drift/cache rollup keyed by the
+        canonical plan digest) and the tenant's SLO burn windows.
+        Guarded: shed paths and the dispatch finally block may both
+        finalize the same query."""
+        if getattr(q, "_serving_observed", False):
+            return
+        q._serving_observed = True
+        from ..obs import serving_observatory as _so
+
+        finished = q.finished or time.time()
+        device_wall = host_wall = 0.0
+        drift = None
+        for frame in (q.timeline or {}).get("operators") or []:
+            device_wall += float(frame.get("deviceWallS") or 0.0)
+            host_wall += float(frame.get("hostWallS") or 0.0)
+            est = float(frame.get("estimatedRows", 0.0) or 0.0)
+            obs = float(frame.get("outputRows", 0.0) or 0.0)
+            if est > 0 and obs > 0:
+                ratio = max(est / obs, obs / est)
+                drift = max(drift or 0.0, ratio)
+        _so.get_observatory().observe_query(
+            signature=getattr(q, "plan_signature", "") or "",
+            tenant=getattr(q, "tenant", "") or "",
+            query_id=q.query_id,
+            latency_s=finished - q.created,
+            ok=q.state == "FINISHED",
+            device_wall_s=device_wall,
+            host_wall_s=host_wall,
+            drift_ratio=drift,
+            cache_hit=getattr(q, "result_cache_hit", None),
+            cache_stored=getattr(q, "result_cache_stored", False),
+            families=getattr(q, "kernel_families", ()) or (),
+            node_id=self.node_id,
+            ts=finished,
+        )
 
     def _plan_is_coordinator_only(self, plan) -> bool:
         """True when the plan scans a connector marked coordinator_only
@@ -706,6 +870,7 @@ class Coordinator:
                 from .scheduler import DistributedScheduler, SchedulerError
 
                 plan = self.session._plan_stmt(stmt)
+                self._stash_plan_telemetry(q, plan)
                 if self._plan_is_coordinator_only(plan):
                     # system-catalog scans snapshot THIS process's live
                     # state (node manager, query history, metrics
@@ -734,6 +899,8 @@ class Coordinator:
                 # scheduling entirely (the coordinator-side tier — workers
                 # never see the query)
                 rkey, hit = self.session.cached_result(plan)
+                if rkey is not None:
+                    q.result_cache_hit = hit is not None
                 if hit is not None:
                     return hit
                 with q.lock:
@@ -765,6 +932,7 @@ class Coordinator:
                 finally:
                     TRACER.flush()
                 self.session.store_result(rkey, page, plan)
+                q.result_cache_stored = rkey is not None
                 return page
         page = self.session.execute(q.sql, user=q.user)
         # in-process execution: the session-side executor's kernel profile
@@ -1250,6 +1418,39 @@ class _Handler(BaseHTTPRequestHandler):
                 "summary": obs.rollup(),
                 "compiles": obs.tail(256),
                 "census": obs.merged_census().snapshot(),
+            })
+            return
+        if self.path == "/v1/signatures":
+            # the signature census (HTTP face of
+            # system.runtime.plan_signatures), busiest shapes first,
+            # each annotated with its warmest node
+            from ..obs import serving_observatory as _so
+
+            obs = _so.get_observatory()
+            self._json(200, {
+                "signatures": obs.signature_rows(),
+                "top": obs.top_signatures(10, local_node_id=co.node_id),
+            })
+            return
+        if self.path == "/v1/affinity":
+            # per-node warmth per signature (HTTP face of
+            # system.runtime.signature_affinity) — the locality-aware
+            # dispatcher's input table
+            from ..obs import serving_observatory as _so
+
+            self._json(200, {
+                "affinity": _so.get_observatory().affinity_rows(
+                    local_node_id=co.node_id
+                ),
+            })
+            return
+        if self.path == "/v1/slo":
+            # per-tenant objectives + live multi-window burn rates
+            # (HTTP face of system.runtime.slos)
+            from ..obs import serving_observatory as _so
+
+            self._json(200, {
+                "slos": _so.get_observatory().slo_rows(),
             })
             return
         if self.path == "/v1/cache":

@@ -4,7 +4,9 @@ Reference parity: core/trino-main/src/main/resources/webapp/ — the React
 cluster/query UI served by the coordinator.  This engine serves one
 dependency-free HTML page at /ui that polls the same REST endpoints the
 reference UI uses (/v1/status, /v1/query, /v1/query/{id}) and renders the
-cluster summary, the query list, and per-query task statistics.
+cluster summary, the query list, per-query task statistics, the
+per-tenant SLO panel (/v1/slo), and the top-10 plan signatures with
+their warmest node (/v1/signatures).
 """
 
 UI_HTML = """<!doctype html>
@@ -34,6 +36,8 @@ UI_HTML = """<!doctype html>
             border: 1px solid #2a3340; border-radius: 6px;
             white-space: pre-wrap; font-family: ui-monospace, monospace;
             font-size: 12px; display: none; }
+  h2 { font-size: 13px; color: #9aa7b4; margin: 22px 0 6px; }
+  .burn-ok { color: #7fd1b9; } .burn-hot { color: #e0707a; }
 </style>
 </head>
 <body>
@@ -50,6 +54,20 @@ UI_HTML = """<!doctype html>
     <tbody id="rows"></tbody>
   </table>
   <div id="detail"></div>
+  <h2>tenant SLOs</h2>
+  <table>
+    <thead><tr><th>tenant</th><th>target</th><th>budget</th>
+               <th>fast burn</th><th>slow burn</th><th>violations</th>
+               <th>burn events</th><th>p99</th></tr></thead>
+    <tbody id="slorows"></tbody>
+  </table>
+  <h2>top signatures</h2>
+  <table>
+    <thead><tr><th>signature</th><th>tenant</th><th>count</th>
+               <th>rate/s</th><th>p99</th><th>drift</th>
+               <th>cache h/m</th><th>warmest node</th></tr></thead>
+    <tbody id="sigrows"></tbody>
+  </table>
 </main>
 <script>
 async function j(u) { const r = await fetch(u); return r.json(); }
@@ -102,6 +120,50 @@ async function refresh() {
       tbody.appendChild(tr);
     }
   } catch (e) { /* coordinator restarting */ }
+  try {
+    const slo = await j('/v1/slo');
+    const sb = document.getElementById('slorows');
+    sb.innerHTML = '';
+    for (const t of slo.slos || []) {
+      const tr = document.createElement('tr');
+      const burn = (v) => {
+        const td = document.createElement('td');
+        td.textContent = v.toFixed(2) + 'x';
+        td.className = v > 1.0 ? 'burn-hot' : 'burn-ok';
+        return td;
+      };
+      const cell = (v) => {
+        const td = document.createElement('td');
+        td.textContent = v;
+        return td;
+      };
+      tr.appendChild(cell(t.tenant));
+      tr.appendChild(cell(t.latencyTargetS.toFixed(2) + 's'));
+      tr.appendChild(cell((t.errorBudget * 100).toFixed(0) + '%'));
+      tr.appendChild(burn(t.fastBurnRate));
+      tr.appendChild(burn(t.slowBurnRate));
+      tr.appendChild(cell(t.violationsTotal + '/' + t.observedTotal));
+      tr.appendChild(cell(t.burnEvents));
+      tr.appendChild(cell((t.p99S * 1000).toFixed(0) + 'ms'));
+      sb.appendChild(tr);
+    }
+    const sigs = await j('/v1/signatures');
+    const gb = document.getElementById('sigrows');
+    gb.innerHTML = '';
+    for (const s of sigs.top || []) {
+      const tr = document.createElement('tr');
+      for (const v of [s.signature.slice(0, 12), s.tenant, s.count,
+                       s.ratePerS.toFixed(2), (s.p99S * 1000).toFixed(0) + 'ms',
+                       s.driftRatio.toFixed(1) + 'x',
+                       s.cacheHits + '/' + s.cacheMisses,
+                       s.warmestNode || '–']) {
+        const td = document.createElement('td');
+        td.textContent = v;
+        tr.appendChild(td);
+      }
+      gb.appendChild(tr);
+    }
+  } catch (e) { /* observatory not up yet */ }
 }
 refresh(); setInterval(refresh, 2000);
 </script>

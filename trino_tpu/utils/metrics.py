@@ -48,6 +48,8 @@ METRIC_SUBSYSTEMS = (
     "autoscaler",
     "compile",
     "coordinator",
+    "signature",
+    "slo",
 )
 
 METRIC_NAME_RE = re.compile(
