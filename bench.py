@@ -134,6 +134,22 @@ def _serve_mode() -> str:
     return ""
 
 
+def _lake_mode() -> bool:
+    """--lake (also BENCH_LAKE=1).
+
+    Opt-in lakehouse chaos phase: concurrent writer sessions race
+    INSERT commits on the snapshot metadata-pointer CAS while readers
+    run analytics plus pinned time-travel scans, all with seeded
+    objstore_error / objstore_latency faults active on every session's
+    object store.  Records commit/conflict/retry counts and asserts
+    zero lost updates.  Off by default — it measures transactional
+    robustness, not scan speed.
+    """
+    if os.environ.get("BENCH_LAKE") == "1":
+        return True
+    return "--lake" in sys.argv[1:]
+
+
 def _mesh_sizes() -> tuple:
     """--mesh[=1,2,4,8] (also BENCH_MESH=1,2,4,8).
 
@@ -169,6 +185,7 @@ CACHE_MODE = _cache_mode()
 CHAOS_CHURN = _chaos_churn()
 CHAOS_COORDINATOR = _chaos_coordinator()
 SERVE_MODE = _serve_mode()
+LAKE_MODE = _lake_mode()
 MESH_SIZES = _mesh_sizes()
 CACHE_PROPS = {
     "off": {"result_cache": False, "compile_cache": False,
@@ -1278,6 +1295,135 @@ def main():
             "wall_s": round(time.perf_counter() - t0, 1),
         }
 
+    def _cfg_lake():
+        # lakehouse concurrent-writer chaos (--lake): writer sessions
+        # race INSERT commits on the snapshot metadata-pointer CAS (the
+        # loser re-reads the winner's snapshot and retries, journaling
+        # SNAPSHOT_CONFLICT) while a reader session runs aggregates and
+        # a pinned FOR VERSION AS OF scan — with seeded objstore_error /
+        # objstore_latency faults active on every session's object
+        # store.  Zero lost updates is the hard invariant.
+        import json as _json
+        import threading
+
+        from trino_tpu.session import Session
+        from trino_tpu.utils.metrics import REGISTRY
+
+        t0 = time.perf_counter()
+        writers, inserts, rows_per = 3, 6, 64
+        faults = _json.dumps({
+            "seed": 23,
+            "objstore_error": {"p": 0.05, "times": 10},
+            "objstore_latency": {"p": 0.05, "times": 20,
+                                 "stall_s": 0.005},
+        })
+        warehouse = tempfile.mkdtemp(prefix="bench-lake-")
+
+        def _session():
+            s = Session()
+            s.create_catalog("lake", "lakehouse", {
+                "lake.warehouse-dir": warehouse,
+                "lake.fault-injection": faults,
+            })
+            return s
+
+        def _metric(name):
+            m = REGISTRY.get(name)
+            return float(m.total()) if m is not None else 0.0
+
+        base = {n: _metric(n) for n in (
+            "trino_tpu_lake_commits_total",
+            "trino_tpu_lake_conflicts_total",
+            "trino_tpu_lake_time_travel_total",
+            "trino_tpu_objstore_retries_total",
+            "trino_tpu_fault_injected_total",
+        )}
+        admin = _session()
+        admin.execute(
+            "create table lake.default.ledger "
+            "(writer bigint, seq bigint, amount double)"
+        )
+        errors: list = []
+
+        def write(wid: int):
+            s = _session()
+            try:
+                for batch in range(inserts):
+                    vals = ", ".join(
+                        f"({wid}, {batch * rows_per + i}, {i * 0.25})"
+                        for i in range(rows_per)
+                    )
+                    s.execute(
+                        f"insert into lake.default.ledger values {vals}"
+                    )
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"writer {wid}: {exc}")
+
+        stop = threading.Event()
+        reads = [0]
+
+        def read():
+            s = _session()
+            try:
+                while not stop.is_set():
+                    s.execute(
+                        "select writer, count(*), sum(amount) from "
+                        "lake.default.ledger group by writer"
+                    )
+                    s.execute(
+                        "select count(*) from lake.default.ledger "
+                        "for version as of 1"
+                    )
+                    reads[0] += 1
+            except Exception as exc:  # noqa: BLE001
+                errors.append(f"reader: {exc}")
+
+        threads = [
+            threading.Thread(target=write, args=(w,), daemon=True)
+            for w in range(writers)
+        ]
+        rd = threading.Thread(target=read, daemon=True)
+        for th in threads:
+            th.start()
+        rd.start()
+        for th in threads:
+            th.join(timeout=240)
+        stop.set()
+        rd.join(timeout=60)
+
+        want = writers * inserts * rows_per
+        got = admin.execute(
+            "select count(*) from lake.default.ledger"
+        ).to_pylist()[0][0]
+        snaps = admin.execute(
+            "select count(*) from system.runtime.snapshots "
+            "where table_name = 'ledger'"
+        ).to_pylist()[0][0]
+        return {
+            "writers": writers,
+            "inserts_per_writer": inserts,
+            "rows_expected": want,
+            "rows_found": got,
+            "lost_updates": want - got,
+            "snapshots": snaps,
+            "reader_iterations": reads[0],
+            "lake_commits": _metric("trino_tpu_lake_commits_total")
+            - base["trino_tpu_lake_commits_total"],
+            "cas_conflicts_retried": _metric(
+                "trino_tpu_lake_conflicts_total"
+            ) - base["trino_tpu_lake_conflicts_total"],
+            "time_travel_scans": _metric(
+                "trino_tpu_lake_time_travel_total"
+            ) - base["trino_tpu_lake_time_travel_total"],
+            "objstore_retries": _metric(
+                "trino_tpu_objstore_retries_total"
+            ) - base["trino_tpu_objstore_retries_total"],
+            "faults_injected": _metric("trino_tpu_fault_injected_total")
+            - base["trino_tpu_fault_injected_total"],
+            "errors": errors[:5],
+            "wall_s": round(time.perf_counter() - t0, 1),
+        }
+
     def _cfg_serve():
         # closed-loop multi-tenant serving bench (--serve / --serve-smoke):
         # a weighted-fair resource-group tree fronts a distributed cluster
@@ -1770,6 +1916,10 @@ def main():
         plan.append((
             "chaos_coordinator_sf0.001", _cfg_chaos_coordinator, 120, []
         ))
+    if LAKE_MODE:
+        # appended after the CPU filter too: transactional robustness
+        # runs on any backend when explicitly requested (--lake)
+        plan.append(("lake_concurrent_writers", _cfg_lake, 90, []))
     if SERVE_MODE:
         # appended after the CPU filter too: serving behavior is worth
         # measuring on every backend when explicitly requested

@@ -67,6 +67,13 @@ SLO_LITERAL_RE = re.compile(r'["\'](trino_tpu_slo_[a-z0-9_]*)["\']')
 SIGNATURE_LITERAL_RE = re.compile(
     r'["\'](trino_tpu_signature_[a-z0-9_]*)["\']'
 )
+# object-store and lakehouse literals likewise: the lake bench phase and
+# the concurrent-writer acceptance tests assert on these series by full
+# name
+OBJSTORE_LITERAL_RE = re.compile(
+    r'["\'](trino_tpu_objstore_[a-z0-9_]*)["\']'
+)
+LAKE_LITERAL_RE = re.compile(r'["\'](trino_tpu_lake_[a-z0-9_]*)["\']')
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -108,6 +115,7 @@ def check_tree(root: str):
             NODE_LITERAL_RE, JOURNAL_LITERAL_RE, DOCTOR_LITERAL_RE,
             RESOURCE_GROUP_LITERAL_RE, AUTOSCALER_LITERAL_RE,
             COMPILE_LITERAL_RE, SLO_LITERAL_RE, SIGNATURE_LITERAL_RE,
+            OBJSTORE_LITERAL_RE, LAKE_LITERAL_RE,
         ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
@@ -161,6 +169,8 @@ def check_tree(root: str):
          "trino_tpu.obs.serving_observatory", "AFFINITY_FIELDS"),
         ("trino_tpu/obs/serving_observatory.py",
          "trino_tpu.obs.serving_observatory", "SLO_FIELDS"),
+        ("trino_tpu/connectors/lakehouse.py",
+         "trino_tpu.connectors.lakehouse", "SNAPSHOT_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

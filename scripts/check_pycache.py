@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Lint: no ``__pycache__`` / bytecode artifacts tracked in the repo.
+
+Interpreter droppings (``__pycache__/`` directories, ``.pyc`` files)
+committed alongside source go stale silently and have twice shadowed
+real modules during refactors; ``.gitignore`` prevents NEW ones, but a
+force-add or an overly broad ``git add`` still slips them through.  This
+check fails on any tracked artifact — and, as a belt-and-braces pass for
+non-git checkouts, on any ``__pycache__`` directory whose sibling source
+file no longer exists (an orphan that can shadow imports).
+
+Run standalone (``python scripts/check_pycache.py``, exit 1 on
+violations) or via ``scripts/lint.py`` (wired into tier-1 through
+tests/test_lint.py).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def tracked_artifacts(root: str):
+    try:
+        out = subprocess.run(
+            ["git", "ls-files", "-z"], cwd=root, capture_output=True,
+            timeout=30, check=True,
+        ).stdout
+    except Exception:  # not a git checkout: the orphan scan still runs
+        return None
+    bad = []
+    for rel in out.decode("utf-8", "replace").split("\0"):
+        if not rel:
+            continue
+        parts = rel.split("/")
+        if "__pycache__" in parts or rel.endswith((".pyc", ".pyo")):
+            bad.append(rel)
+    return bad
+
+
+def orphaned_bytecode(root: str):
+    """``.pyc`` files whose source module is gone: the cached module
+    would still import (shadowing the deletion) under some loaders."""
+    bad = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        if os.path.basename(dirpath) != "__pycache__":
+            dirnames[:] = [d for d in dirnames if d != ".git"]
+            continue
+        srcdir = os.path.dirname(dirpath)
+        for fn in filenames:
+            if not fn.endswith((".pyc", ".pyo")):
+                continue
+            mod = fn.split(".", 1)[0]
+            if not os.path.exists(os.path.join(srcdir, mod + ".py")):
+                bad.append(os.path.relpath(os.path.join(dirpath, fn), root))
+    return bad
+
+
+def main() -> int:
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    tracked = tracked_artifacts(root)
+    orphans = orphaned_bytecode(root)
+    rc = 0
+    for rel in tracked or ():
+        print(f"{rel}: bytecode artifact is tracked by git — "
+              "`git rm -r --cached` it")
+        rc = 1
+    for rel in orphans:
+        print(f"{rel}: orphaned bytecode (source module deleted) — "
+              "remove the stale __pycache__ entry")
+        rc = 1
+    if not rc:
+        n = "n/a" if tracked is None else len(tracked)
+        print(f"ok: no tracked ({n}) or orphaned bytecode artifacts")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,14 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly "$@" || rc=1
 
+echo "== lake smoke =="
+# ~15s concurrent-writer lakehouse smoke: 2 writer sessions racing the
+# metadata-pointer CAS x 1 polling reader, seeded objstore_error /
+# objstore_latency faults active — zero lost updates, complete snapshot
+# history, stable pinned time-travel reads (scripts/lake_smoke.py)
+timeout -k 10 180 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 \
+    python scripts/lake_smoke.py || rc=1
+
 echo "== serve smoke =="
 # ~30s closed-loop serving smoke: two tenants behind weighted-fair
 # resource groups at tiny QPS — zero failed queries, the fairness

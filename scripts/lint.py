@@ -10,6 +10,8 @@ Aggregates the source linters:
     ``# no-donate:`` reason); pallas kernels are registry-attributed
   - ``check_pad_discipline.py``  — all shape padding quantizes through
     trino_tpu/exec/shapes.py (no ad-hoc next-multiple-of-128)
+  - ``check_pycache.py``         — no tracked or orphaned ``__pycache__``
+    bytecode artifacts
 
 Exit code is non-zero when ANY linter fails; each linter's own output is
 printed under a header.  Wired into tier-1 via tests/test_lint.py, so a
@@ -26,6 +28,7 @@ import check_dispatch_guard  # noqa: E402
 import check_donation  # noqa: E402
 import check_metric_names  # noqa: E402
 import check_pad_discipline  # noqa: E402
+import check_pycache  # noqa: E402
 import check_session_props  # noqa: E402
 
 LINTERS = (
@@ -34,6 +37,7 @@ LINTERS = (
     ("check_session_props", check_session_props),
     ("check_donation", check_donation),
     ("check_pad_discipline", check_pad_discipline),
+    ("check_pycache", check_pycache),
 )
 
 

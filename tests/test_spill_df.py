@@ -203,6 +203,54 @@ def test_window_spill_partitioned():
     assert got == expected
 
 
+DISTINCT_GROUPED = (
+    "select l_returnflag, count(distinct l_suppkey) c, "
+    "approx_percentile(l_extendedprice, 0.5) p from lineitem "
+    "group by l_returnflag order by l_returnflag"
+)
+
+
+def test_grouped_distinct_spill_matches_in_memory():
+    """Grouped count(DISTINCT) under a tight limit: hash-partitioning
+    rows by the GROUP BY keys keeps groups intact per partition, so the
+    original single-step Aggregate is exact there — including the
+    non-decomposable approx_percentile riding alongside."""
+    ref = tpch_session(SF).execute(DISTINCT_GROUPED).to_pylist()
+    s = tpch_session(SF, query_max_memory_bytes=100_000)
+    assert s.execute(DISTINCT_GROUPED).to_pylist() == ref
+
+
+def test_grouped_distinct_spill_varchar_values():
+    """DISTINCT over a dictionary column must dedupe by string VALUE,
+    not per-batch dictionary code."""
+    sql = (
+        "select l_returnflag, count(distinct l_shipmode) m from lineitem "
+        "group by l_returnflag order by l_returnflag"
+    )
+    ref = tpch_session(SF).execute(sql).to_pylist()
+    s = tpch_session(SF, query_max_memory_bytes=100_000)
+    assert s.execute(sql).to_pylist() == ref
+
+
+def test_global_multi_distinct_spill_with_wide_decimal():
+    """Global multi-DISTINCT (beyond the optimizer's single-distinct
+    rewrite) spills via per-batch host distinct state; the wide-decimal
+    column dedupes limb-PAIR-wise (np.unique over rows), a shape the
+    in-core sort kernel cannot even express."""
+    ref = tpch_session(SF).execute(
+        "select count(distinct l_quantity) a, "
+        "count(distinct l_suppkey) b, count(*) c from lineitem"
+    ).to_pylist()
+    s = tpch_session(SF, query_max_memory_bytes=100_000)
+    got = s.execute(
+        # cast is injective, so the distinct count must match the
+        # narrow reference exactly
+        "select count(distinct cast(l_quantity as decimal(25,4))) a, "
+        "count(distinct l_suppkey) b, count(*) c from lineitem"
+    ).to_pylist()
+    assert got == ref
+
+
 def test_sort_spill_varchar_dictionaries_unified():
     """Regression: per-batch lazy dictionaries (o_clerk) must be remapped
     into one union dictionary before merging sorted runs."""

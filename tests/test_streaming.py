@@ -76,9 +76,10 @@ def test_q3_streams_exact(free):
 
 def test_count_distinct_streams_under_memory_limit(free):
     """Round 3 refused this (raw rows gathered to one task); the
-    decomposed plan (count over hash-partitioned Distinct) now tiles —
-    and with the rewrite disabled the limit still surfaces LOUDLY rather
-    than silently wrong."""
+    decomposed plan (count over hash-partitioned Distinct) tiles, and
+    with the rewrite disabled the distinct SPILL path (host-array
+    distinct state) still answers exactly.  Only with spill disabled
+    too does the limit surface LOUDLY rather than silently wrong."""
     from trino_tpu.utils.memory import ExceededMemoryLimitError
 
     q = "select count(distinct l_suppkey) from lineitem"
@@ -89,8 +90,13 @@ def test_count_distinct_streams_under_memory_limit(free):
         0.05, query_max_memory_bytes=1_000_000,
         distinct_agg_rewrite=False,
     )
+    assert raw.execute(q).to_pylist() == ref
+    refused = tpch_session(
+        0.05, query_max_memory_bytes=1_000_000,
+        distinct_agg_rewrite=False, spill_enabled=False,
+    )
     with pytest.raises(ExceededMemoryLimitError):
-        raw.execute(q)
+        refused.execute(q)
 
 
 def test_multiple_tiles_used(free):

@@ -313,6 +313,50 @@ def test_wide_window_sum():
     assert rows[3][1] == 5
 
 
+def test_wide_window_min_max():
+    """min/max over two-limb decimal(25,4) windows: whole-partition,
+    plus a running (unbounded-preceding) frame — the limb-wise compare
+    (signed hi, unsigned lo tie-break) must order genuinely 128-bit
+    values, with NULLs ignored by the frame."""
+    s = Session()
+    s.create_catalog("memory", "memory", {})
+    s.execute("create table wmm (g bigint, o bigint, v decimal(25,4))")
+    s.execute(
+        "insert into wmm values "
+        "(1, 1, 123456789012345678901.2345), "
+        "(1, 2, -987654321098765432109.8765), "
+        "(2, 1, 0.0001), (2, 2, null), "
+        "(2, 3, 99999999999999999999.9999)"
+    )
+    rows = s.execute(
+        "select g, min(v) over (partition by g) lo, "
+        "max(v) over (partition by g) hi from wmm order by g, o"
+    ).to_pylist()
+    assert rows[0][1:] == rows[1][1:] == (
+        D("-987654321098765432109.8765"),
+        D("123456789012345678901.2345"),
+    )
+    assert rows[2][1:] == rows[3][1:] == rows[4][1:] == (
+        D("0.0001"), D("99999999999999999999.9999"),
+    )
+    running = s.execute(
+        "select o, min(v) over (order by o rows between unbounded "
+        "preceding and current row) from wmm where g = 2 order by o"
+    ).to_pylist()
+    # NULL at o=2 must not disturb the running minimum
+    assert [r[1] for r in running] == [
+        D("0.0001"), D("0.0001"), D("0.0001"),
+    ]
+    running_max = s.execute(
+        "select o, max(v) over (order by o rows between unbounded "
+        "preceding and current row) from wmm where g = 1 order by o"
+    ).to_pylist()
+    assert [r[1] for r in running_max] == [
+        D("123456789012345678901.2345"),
+        D("123456789012345678901.2345"),
+    ]
+
+
 def test_wide_scalar_subquery():
     s = Session()
     s.create_catalog("memory", "memory", {})

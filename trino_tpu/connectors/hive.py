@@ -17,17 +17,24 @@ timestamp normalization into the engine's device representations.
 
 Catalog config: {"hive.warehouse-dir": path}. Layout:
   {warehouse}/{table}/*.parquet       (all files share one schema)
+
+Storage goes through the trino_tpu.fs object-store layer (listing,
+fingerprinting, sidecar IO, part-file writes and overwrite deletes), so
+hive tables inherit its atomic-PUT semantics and seeded objstore_*
+fault sites; parquet FOOTER/row-group reads use the store's
+``local_path()`` escape hatch because pyarrow wants real file paths.
 """
 from __future__ import annotations
 
-import glob
 import hashlib
+import io
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from .. import types as T
+from ..fs import LocalObjectStore, ObjectStoreError
 from ..page import Column, Page
 from ..spi import (
     ColumnSchema,
@@ -127,9 +134,12 @@ def _engine_to_arrow_type(t: T.Type):
 
 
 class HiveMetadata(ConnectorMetadata):
-    def __init__(self, warehouse: str, connector: Optional["HiveConnector"] = None):
+    def __init__(self, warehouse: str,
+                 connector: Optional["HiveConnector"] = None,
+                 fs: Optional[LocalObjectStore] = None):
         self.warehouse = warehouse
         self.connector = connector
+        self.fs = fs if fs is not None else LocalObjectStore(warehouse)
 
     FORMATS = ("parquet", "orc", "csv", "json")  # hive-formats analog
     # ANALYZE sidecar (metastore table-parameters analog).  Dot-prefixed
@@ -140,21 +150,26 @@ class HiveMetadata(ConnectorMetadata):
     STATS_SIDECAR = ".trino_stats.json"
 
     def list_tables(self) -> List[str]:
-        if not os.path.isdir(self.warehouse):
-            return []
-        out = []
-        for d in sorted(os.listdir(self.warehouse)):
-            if any(
-                glob.glob(os.path.join(self.warehouse, d, f"*.{ext}"))
-                for ext in self.FORMATS
+        tables = set()
+        for e in self.fs.list_files():
+            parts = e.path.split("/")
+            if (
+                len(parts) == 2
+                and parts[1].rsplit(".", 1)[-1].lower() in self.FORMATS
             ):
-                out.append(d)
-        return out
+                tables.add(parts[0])
+        return sorted(tables)
 
     def _files(self, table: str) -> List[str]:
+        """Data-file paths of one table, as REAL paths (pyarrow readers
+        need them) — but discovered via the object store so listing
+        passes the fault sites like any other storage op."""
+        entries = self.fs.list_files(table)
         for ext in self.FORMATS:
             files = sorted(
-                glob.glob(os.path.join(self.warehouse, table, f"*.{ext}"))
+                self.fs.local_path(e.path)
+                for e in entries
+                if e.path.rsplit(".", 1)[-1].lower() == ext
             )
             if files:
                 return files
@@ -185,8 +200,6 @@ class HiveMetadata(ConnectorMetadata):
         file so discovery (footer-based) sees the table immediately; the
         scaled writer sink then adds part files beside it."""
         _require_pyarrow()
-        tdir = os.path.join(self.warehouse, schema.name)
-        os.makedirs(tdir, exist_ok=True)
         fields = [
             pa.field(c.name, _engine_to_arrow_type(c.type))
             for c in schema.columns
@@ -195,10 +208,14 @@ class HiveMetadata(ConnectorMetadata):
             {f.name: pa.array([], f.type) for f in fields},
             schema=pa.schema(fields),
         )
-        pq.write_table(empty, os.path.join(tdir, "schema-0.parquet"))
+        buf = io.BytesIO()
+        pq.write_table(empty, buf)
+        self.fs.write_file(
+            f"{schema.name}/schema-0.parquet", buf.getvalue()
+        )
 
-    def _sidecar_path(self, table: str) -> str:
-        return os.path.join(self.warehouse, table, self.STATS_SIDECAR)
+    def _sidecar_key(self, table: str) -> str:
+        return f"{table}/{self.STATS_SIDECAR}"
 
     def store_table_statistics(
         self, table: str, stats: TableStatistics, data_version: int
@@ -223,23 +240,22 @@ class HiveMetadata(ConnectorMetadata):
                 for name, c in stats.columns.items()
             },
         }
-        tmp = self._sidecar_path(table) + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(doc, f)
-        os.replace(tmp, self._sidecar_path(table))
+        # atomic PUT via the object store (no torn sidecars); the store
+        # never lists dotfiles, so this does not move data_version
+        self.fs.write_file(
+            self._sidecar_key(table), json.dumps(doc).encode()
+        )
 
     def _sidecar_statistics(self, table: str) -> Optional[TableStatistics]:
         """Persisted ANALYZE results, iff still keyed to the current
         data_version (files changed since collection -> stale)."""
         import json
 
-        path = self._sidecar_path(table)
-        if self.connector is None or not os.path.exists(path):
+        if self.connector is None:
             return None
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                doc = json.load(f)
-        except (OSError, ValueError):
+            doc = json.loads(self.fs.read_file(self._sidecar_key(table)))
+        except (ObjectStoreError, ValueError):
             return None
         if int(doc.get("data_version", -1)) != self.connector.data_version(table):
             return None
@@ -494,11 +510,14 @@ class HiveConnector(Connector):
     cacheable = True
 
     def __init__(self, name: str, warehouse: str,
-                 writer_target_bytes: int = 32 << 20):
+                 writer_target_bytes: int = 32 << 20,
+                 injector=None):
         self.name = name
         self.warehouse = warehouse
         self.writer_target_bytes = writer_target_bytes
-        self._metadata = HiveMetadata(warehouse, connector=self)
+        self.fs = LocalObjectStore(warehouse, injector=injector)
+        self._metadata = HiveMetadata(warehouse, connector=self,
+                                      fs=self.fs)
 
     def data_version(self, table: Optional[str] = None) -> int:
         """Fingerprint of (path, mtime_ns, ctime_ns, inode, size) per
@@ -509,27 +528,22 @@ class HiveConnector(Connector):
         filesystems with coarse mtime granularity (an atomic
         rename-into-place always changes the inode).  The digest is
         process-stable (blake2b, not salted hash()) — persistent
-        compile-cache keys embed it and must survive restarts."""
-        root_dir = (
-            os.path.join(self.warehouse, table) if table else self.warehouse
-        )
+        compile-cache keys embed it and must survive restarts.
+
+        Listing goes through the object store (which skips dotfiles, so
+        the ANALYZE sidecar never invalidates the version it is keyed
+        by); the inode/ctime terms come from a local stat via the
+        ``local_path()`` escape hatch."""
         h = hashlib.blake2b(digest_size=8)
-        for root, _dirs, files in sorted(os.walk(root_dir)):
-            for f in sorted(files):
-                if f.startswith("."):
-                    # hidden metadata (the ANALYZE stats sidecar) is not
-                    # table data; including it would let a stats write
-                    # invalidate the version the stats are keyed by
-                    continue
-                p = os.path.join(root, f)
-                try:
-                    st = os.stat(p)
-                except OSError:
-                    continue
-                h.update(
-                    repr((p, st.st_mtime_ns, st.st_ctime_ns, st.st_ino,
-                          st.st_size)).encode()
-                )
+        for e in self.fs.list_files(table or ""):
+            try:
+                st = os.stat(self.fs.local_path(e.path))
+            except OSError:
+                continue
+            h.update(
+                repr((e.path, st.st_mtime_ns, st.st_ctime_ns, st.st_ino,
+                      st.st_size)).encode()
+            )
         return int.from_bytes(h.digest(), "little")
 
     def metadata(self) -> HiveMetadata:
@@ -571,8 +585,10 @@ class HivePageSink(PageSink):
 
     def __init__(self, warehouse: str, table: str, columns, overwrite: bool,
                  writer_target_bytes: int = 32 << 20,
-                 max_writers: int = 8):
+                 max_writers: int = 8,
+                 fs: Optional[LocalObjectStore] = None):
         self.warehouse = warehouse
+        self.fs = fs if fs is not None else LocalObjectStore(warehouse)
         self.table = table
         self.columns = list(columns)
         self.overwrite = overwrite
@@ -590,10 +606,10 @@ class HivePageSink(PageSink):
     def finish(self) -> int:
         from ..exec.partitioner import concat_pages, take_rows
 
-        tdir = os.path.join(self.warehouse, self.table)
-        if self.overwrite and os.path.isdir(tdir):
-            for f in glob.glob(os.path.join(tdir, "*.parquet")):
-                os.remove(f)
+        if self.overwrite:
+            for e in self.fs.list_files(self.table):
+                if e.path.endswith(".parquet"):
+                    self.fs.delete_file(e.path)
         if not self.pages:
             self.writers_used = 0
             return 0
@@ -610,7 +626,7 @@ class HivePageSink(PageSink):
         if nwriters == 1:
             write_parquet_table(
                 self.warehouse, self.table, page,
-                file_name=f"part-{stamp}-0.parquet",
+                file_name=f"part-{stamp}-0.parquet", fs=self.fs,
             )
             return page.count
         from ..exec.partitioner import SkewedPartitionRebalancer
@@ -627,7 +643,7 @@ class HivePageSink(PageSink):
                     return
                 write_parquet_table(
                     self.warehouse, self.table, sub,
-                    file_name=f"part-{stamp}-{w}.parquet",
+                    file_name=f"part-{stamp}-{w}.parquet", fs=self.fs,
                 )
             except BaseException as e:  # noqa: BLE001 — re-raised below
                 errors.append(e)
@@ -653,6 +669,7 @@ class HivePageSinkProvider(PageSinkProvider):
         return HivePageSink(
             self.connector.warehouse, table, columns, overwrite,
             writer_target_bytes=self.connector.writer_target_bytes,
+            fs=self.connector.fs,
         )
 
 
@@ -665,11 +682,18 @@ class HiveConnectorFactory(ConnectorFactory):
         warehouse = config.get("hive.warehouse-dir")
         if not warehouse:
             raise ValueError("hive catalog requires hive.warehouse-dir")
+        injector = None
+        spec = config.get("hive.fault-injection")
+        if spec:
+            from ..utils.faults import FaultInjector
+
+            injector = FaultInjector.from_spec(spec)
         return HiveConnector(
             catalog_name, warehouse,
             writer_target_bytes=int(
                 config.get("hive.writer-target-bytes", 32 << 20)
             ),
+            injector=injector,
         )
 
 
@@ -679,9 +703,11 @@ def write_parquet_table(
     page: Page,
     rows_per_group: int = 100_000,
     file_name: str = "part-0.parquet",
+    fs: Optional[LocalObjectStore] = None,
 ):
     """Write a Page as a parquet table file (TableWriter role for tests and
-    CTAS into hive catalogs)."""
+    CTAS into hive catalogs).  Serializes to a buffer and PUTs through
+    the object store so the write is atomic and fault-injectable."""
     _require_pyarrow()
     arrays = []
     names = page.names or [f"c{i}" for i in range(page.num_columns)]
@@ -720,9 +746,7 @@ def write_parquet_table(
         else:
             arrays.append(pa.array(vals))
     tbl = pa.table(dict(zip(names, arrays)))
-    os.makedirs(os.path.join(warehouse, table), exist_ok=True)
-    pq.write_table(
-        tbl,
-        os.path.join(warehouse, table, file_name),
-        row_group_size=rows_per_group,
-    )
+    store = fs if fs is not None else LocalObjectStore(warehouse)
+    buf = io.BytesIO()
+    pq.write_table(tbl, buf, row_group_size=rows_per_group)
+    store.write_file(f"{table}/{file_name}", buf.getvalue())

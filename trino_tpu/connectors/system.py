@@ -83,6 +83,20 @@ SCHEMAS: Dict[str, List] = {
         ("heals", T.BIGINT),
         ("invalidations", T.BIGINT),
     ],
+    # one row per committed lakehouse snapshot across every mounted
+    # catalog whose connector exposes snapshots_rows() (duck-typed like
+    # the rest of this table's feeds); parent_id -1 marks the root
+    "snapshots": [
+        ("catalog", T.VARCHAR),
+        ("table_name", T.VARCHAR),
+        ("snapshot_id", T.BIGINT),
+        ("parent_id", T.BIGINT),
+        ("operation", T.VARCHAR),
+        ("data_files", T.BIGINT),
+        ("rows", T.BIGINT),
+        ("is_current", T.BOOLEAN),
+        ("committed_at_us", T.BIGINT),
+    ],
     # one row per (node, pool): the cluster memory view — the session's
     # LocalMemoryManager plus every heartbeat-announced worker snapshot
     # held by the coordinator ClusterMemoryManager (MemoryPool MBeans /
@@ -428,6 +442,28 @@ class _SystemSource:
                 "value": [r[1] for r in rows],
                 "default": [r[2] for r in rows],
             }
+        if table == "snapshots":
+            out = {
+                "catalog": [], "table_name": [], "snapshot_id": [],
+                "parent_id": [], "operation": [], "data_files": [],
+                "rows": [], "is_current": [], "committed_at_us": [],
+            }
+            for c in s.catalogs.names():
+                conn = s.catalogs.get(c)
+                if not hasattr(conn, "snapshots_rows"):
+                    continue
+                for (t, snap, parent, op, nfiles, nrows, cur,
+                     ts) in conn.snapshots_rows():
+                    out["catalog"].append(c)
+                    out["table_name"].append(t)
+                    out["snapshot_id"].append(snap)
+                    out["parent_id"].append(parent)
+                    out["operation"].append(op)
+                    out["data_files"].append(nfiles)
+                    out["rows"].append(nrows)
+                    out["is_current"].append(bool(cur))
+                    out["committed_at_us"].append(ts)
+            return out
         if table == "caches":
             mgr = getattr(s, "caches", None)
             stats = mgr.stats_rows() if mgr is not None else []

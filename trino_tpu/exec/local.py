@@ -550,7 +550,14 @@ class LocalExecutor:
         if limit and self.config.get("spill_enabled", True):
             from . import spill, streaming
 
-            # streaming (fragment-tiled) execution first: the general
+            # DISTINCT aggregation first: the streaming fragmenter keeps
+            # a distinct Aggregate single-step behind one hash exchange,
+            # which locally gathers every input row into one in-memory
+            # fragment — the spill rewrite partitions host-side instead
+            sp = spill.plan_distinct_spill(self, plan, int(limit))
+            if sp is not None:
+                return spill.execute_spilled_distinct(self, plan, *sp)
+            # streaming (fragment-tiled) execution next: the general
             # bounded-working-set path; shape-matched spill rewrites
             # remain for plans the fragmenter cannot tile
             frags = streaming.plan_streaming(self, plan, int(limit))
@@ -2953,9 +2960,13 @@ class _TraceCtx:
             return cnt, jnp.ones(cnt.shape, bool)
         if f.kind in ("min", "max"):
             if lanes[f.args[0]][0].ndim == 2:
-                raise ExecutionError(
-                    "window min/max over wide decimals (>18 digits) is "
-                    "not implemented"
+                # wide (two-limb) decimal lane: limb-wise masked compares
+                v, cnt = W.framed_minmax_wide(
+                    lanes[f.args[0]], sel, b, f.frame, f.kind
+                )
+                return (
+                    jnp.where((cnt > 0)[:, None], v, jnp.zeros_like(v)),
+                    cnt > 0,
                 )
             v, cnt = W.framed_minmax(lanes[f.args[0]], sel, b, f.frame, f.kind)
             return jnp.where(cnt > 0, v, jnp.zeros_like(v)), cnt > 0

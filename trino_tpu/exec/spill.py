@@ -222,6 +222,7 @@ JOIN_RIGHT_ID = -3
 JOIN_OUT_ID = -4
 SORT_RUNS_ID = -5
 WINDOW_SRC_ID = -6
+DISTINCT_SRC_ID = -7
 
 
 def _is_scan_chain(node: P.PlanNode) -> Optional[P.TableScan]:
@@ -553,6 +554,204 @@ def execute_spilled_window(executor, plan, win, scan, npart):
             out_pages.append(page)
     rewritten = _replace_aggregate(
         plan, win, P.RemoteSource(JOIN_OUT_ID, wsyms, wtypes)
+    )
+    merged_remote = dict(orig_remote)
+    merged_remote[JOIN_OUT_ID] = out_pages
+    final = FragmentExecutor(
+        executor.catalogs, cfg, {}, merged_remote, dyn
+    )
+    return final.execute(rewritten)
+
+
+def plan_distinct_spill(executor, plan: P.Output, memory_limit: int):
+    """Out-of-core DISTINCT aggregation — the LOCAL analog of the mesh
+    path's hash repartition by grouping keys: a single-step Aggregate
+    carrying distinct aggregates over a scan chain whose working set
+    exceeds the limit.  GROUP BY queries hash-partition rows by their
+    grouping keys (groups never straddle partitions, so per-partition
+    single-step aggregation is exact for ANY aggregate, distinct
+    included); global queries spill per-argument distinct state to host
+    arrays and count it there."""
+    agg = _single(plan, P.Aggregate)
+    if agg is None or agg.step != "single":
+        return None
+    if not any(a.distinct for a in agg.aggs):
+        return None
+    scan = _is_scan_chain(agg.source)
+    if scan is None or _single(plan, P.TableScan) is None:
+        return None
+    if getattr(executor, "splits_by_scan", None) is not None:
+        # fragment task: the distributed planner already hash-partitions
+        # distinct aggregation across tasks; this rewrite re-enumerates
+        # whole-table splits and would double-count another task's rows
+        return None
+    if not agg.keys:
+        # global: only count(DISTINCT x) reduces to host-side uniques;
+        # the remaining aggs must merge through partial/final kernels
+        for a in agg.aggs:
+            if a.distinct and (
+                a.kind != "count" or a.arg is None or a.arg2 is not None
+            ):
+                return None
+            if not a.distinct and not a.partializable:
+                return None
+    est = _est_side(executor, scan)
+    if est <= memory_limit:
+        from .streaming import estimate_program_bytes
+
+        if estimate_program_bytes(executor, plan) <= memory_limit:
+            return None
+        est = max(est, float(memory_limit))
+    npart = max(2, math.ceil(est * 2 / memory_limit))
+    return (agg, scan, npart)
+
+
+def _host_distinct_count(pages, sym, typ):
+    """Union per-batch deduped pages (the spilled distinct state) in host
+    arrays and count distinct non-null values.  Varchar compares by STRING
+    VALUE, not dictionary code — batches may dictionary-encode the same
+    string under different codes, and merge_pages_to_arrays only unifies
+    (it does not dedup) the merged dictionary."""
+    import numpy as np
+
+    from .local import merge_pages_to_arrays
+
+    dicts: Dict[str, object] = {}
+    merged, total = merge_pages_to_arrays(pages, [sym], [(sym, typ)], dicts)
+    vals, oks = merged[sym]
+    if oks is not None:
+        vals = vals[oks] if vals.ndim == 1 else vals[oks, :]
+    d = dicts.get(sym)
+    if d is not None:
+        safe = np.clip(vals, 0, max(len(d) - 1, 0)).astype(np.int64)
+        return int(len(np.unique(np.asarray(d).astype(str)[safe])))
+    if vals.ndim == 2:  # wide decimal: a value is its (lo, hi) limb pair
+        return int(len(np.unique(vals, axis=0)))
+    return int(len(np.unique(vals)))
+
+
+def execute_spilled_distinct(executor, plan, agg, scan, npart):
+    """Grouped: hash-partition rows by GROUP BY keys host-side, run the
+    ORIGINAL single-step aggregate (distinct and all) per partition —
+    groups are disjoint across partitions so concatenation is exact.
+    Global: per split batch run a device Distinct over each
+    count(DISTINCT) argument; the batches' deduped values are the spilled
+    distinct state, unioned and counted in host arrays, while any
+    non-distinct aggs merge through the ordinary partial/final spill."""
+    import dataclasses
+
+    from ..expr import ir
+    from ..page import Page, column_from_pylist
+    from .fragment_exec import FragmentExecutor
+
+    limit = int(executor.config.get("memory_limit_bytes"))
+    cfg, orig_remote, dyn = _spill_ctx(executor)
+    syms = tuple(agg.output_symbols())
+    types_map = agg.output_types()
+
+    if agg.keys:
+        from ..exec.partitioner import partition_page
+
+        pages, src_syms, src_types = _side_pages(
+            executor, agg.source, scan, limit
+        )
+        parts: List[List] = [[] for _ in range(npart)]
+        for page in pages:
+            for p, sub in enumerate(
+                partition_page(page, list(agg.keys), npart)
+            ):
+                if sub.count:
+                    parts[p].append(sub)
+        agg_sub = dataclasses.replace(
+            agg,
+            source=P.RemoteSource(DISTINCT_SRC_ID, src_syms, src_types),
+        )
+        aplan = P.Output(agg_sub, syms, syms)
+        out_pages = []
+        for p in range(npart):
+            if not parts[p]:
+                continue
+            remote = dict(orig_remote)
+            remote[DISTINCT_SRC_ID] = parts[p]
+            sub = FragmentExecutor(executor.catalogs, cfg, {}, remote, dyn)
+            page = sub.execute(aplan)
+            if page.count:
+                out_pages.append(page)
+    else:
+        conn = executor.catalogs.get(scan.catalog)
+        batch_budget = max(limit // SAFETY_FACTOR, 1)
+        nbatches = max(
+            1, math.ceil(_est_side(executor, scan) / batch_budget)
+        )
+        splits = conn.split_manager().get_splits(
+            scan.table, nbatches, scan.constraint
+        )
+        batch = max(1, len(splits) // nbatches)
+        src_types = agg.source.output_types()
+        d_cols = sorted({a.arg for a in agg.aggs if a.distinct})
+        state: Dict[str, List[Page]] = {c: [] for c in d_cols}
+        dplans = {
+            c: P.Output(
+                P.Distinct(
+                    P.Project(
+                        agg.source,
+                        ((c, ir.ColumnRef(src_types[c], c)),),
+                    )
+                ),
+                (c,), (c,),
+            )
+            for c in d_cols
+        }
+        for start in range(0, max(len(splits), 1), batch):
+            bsplits = splits[start : start + batch]
+            for c in d_cols:
+                sub = FragmentExecutor(
+                    executor.catalogs, cfg, {0: bsplits}, orig_remote, dyn
+                )
+                state[c].append(sub.execute(dplans[c]))
+        counts = {
+            c: _host_distinct_count(state[c], c, src_types[c])
+            for c in d_cols
+        }
+        nd_aggs = tuple(a for a in agg.aggs if not a.distinct)
+        nd_page = None
+        if nd_aggs:
+            # the remaining (partializable) aggs merge through the same
+            # partial/final kernels the exchange uses, per split batch
+            nd_partial = P.Aggregate(agg.source, (), nd_aggs, "partial")
+            psyms = tuple(nd_partial.output_symbols())
+            pplan = P.Output(nd_partial, psyms, psyms)
+            partial_pages = []
+            for start in range(0, max(len(splits), 1), batch):
+                sub = FragmentExecutor(
+                    executor.catalogs, cfg,
+                    {0: splits[start : start + batch]}, orig_remote, dyn,
+                )
+                partial_pages.append(sub.execute(pplan))
+            nd_final = P.Aggregate(
+                P.RemoteSource(
+                    SPILL_SOURCE_ID, psyms,
+                    tuple(nd_partial.output_types().items()),
+                ),
+                (), nd_aggs, "final",
+            )
+            nd_syms = tuple(nd_final.output_symbols())
+            remote = dict(orig_remote)
+            remote[SPILL_SOURCE_ID] = partial_pages
+            sub = FragmentExecutor(executor.catalogs, cfg, {}, remote, dyn)
+            nd_page = sub.execute(P.Output(nd_final, nd_syms, nd_syms))
+        cols = []
+        for a in agg.aggs:
+            if a.distinct:
+                cols.append(
+                    column_from_pylist(a.output_type, [counts[a.arg]])
+                )
+            else:
+                cols.append(nd_page.by_name(a.output))
+        out_pages = [Page(cols, 1, list(syms))]
+
+    rewritten = _replace_aggregate(
+        plan, agg, P.RemoteSource(JOIN_OUT_ID, syms, tuple(types_map.items()))
     )
     merged_remote = dict(orig_remote)
     merged_remote[JOIN_OUT_ID] = out_pages

@@ -191,6 +191,32 @@ def _rule_node_churn(ctx) -> Optional[Dict]:
                     summary, deaths + churn)
 
 
+def _rule_snapshot_conflict(ctx) -> Optional[Dict]:
+    """A lakehouse commit lost the metadata-pointer CAS to a concurrent
+    writer and retried (optimistic concurrency doing its job), possibly
+    under injected object-store faults.  WARN when the query still
+    succeeded — the retry loop absorbed the race — ERROR only when the
+    retry budget was exhausted and the query failed."""
+    conflicts = _events_of(ctx, J.SNAPSHOT_CONFLICT)
+    faults = _events_of(
+        ctx, J.FAULT_INJECTED,
+        sites=("objstore_error", "objstore_throttle", "objstore_latency"),
+    )
+    if not conflicts:
+        return None
+    tables = sorted({
+        (e.get("detail") or {}).get("table") or "?" for e in conflicts
+    })
+    summary = (
+        f"snapshot commit lost the metadata CAS {len(conflicts)} time(s) "
+        f"on {','.join(tables)} -> re-read winner and retried"
+    )
+    if faults:
+        summary += f" (under {len(faults)} injected object-store fault(s))"
+    sev = J.ERROR if ctx.get("error") else J.WARN
+    return _finding("snapshot_conflict", sev, summary, conflicts + faults)
+
+
 def _rule_coordinator_restart(ctx) -> Optional[Dict]:
     """The coordinator itself died and came back: the query was either
     resumed from WAL-recorded committed spools (QUERY_RESUMED) or
@@ -484,6 +510,10 @@ _RULES = (
     # and tasks; a dead coordinator loses only bookkeeping the WAL
     # reconstructs), above mesh shrink
     _rule_coordinator_restart,
+    # snapshot conflicts below coordinator restart (a lost CAS is
+    # absorbed by the commit retry loop; it only explains latency or, on
+    # budget exhaustion, the failure) and above mesh shrink
+    _rule_snapshot_conflict,
     _rule_mesh_shrink,
     # overload below node churn (a dead worker is a fault, not demand),
     # above memory pressure (a backed-up admission queue is usually the

@@ -90,6 +90,9 @@ SLO_BURN = "slo_burn"
 COORDINATOR_RESTART = "coordinator_restart"
 QUERY_RESUMED = "query_resumed"
 QUERY_ORPHANED = "query_orphaned"
+# lakehouse optimistic concurrency: a writer lost the metadata-pointer
+# CAS to a concurrent commit and is re-reading + retrying
+SNAPSHOT_CONFLICT = "snapshot_conflict"
 
 # severities
 INFO = "info"

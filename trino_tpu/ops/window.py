@@ -31,6 +31,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import types as T
 from ..expr.lower import Lane
@@ -306,12 +307,15 @@ def framed_sum_wide(
 
 def _segscan(v: jnp.ndarray, reset: jnp.ndarray, op, reverse: bool):
     """Segmented prefix scan: op-combine values left-to-right (or right-to-
-    left), restarting at rows where reset is True (in scan direction)."""
+    left), restarting at rows where reset is True (in scan direction).
+    Values may carry trailing dims (wide-decimal limb pairs); the reset
+    flag broadcasts over them."""
 
     def combine(a, c):
         f1, v1 = a
         f2, v2 = c
-        return (f1 | f2, jnp.where(f2, v2, op(v1, v2)))
+        f2b = f2[..., None] if v2.ndim > f2.ndim else f2
+        return (f1 | f2, jnp.where(f2b, v2, op(v1, v2)))
 
     _, out = jax.lax.associative_scan(combine, (reset, v), reverse=reverse)
     return out
@@ -343,7 +347,11 @@ def _range_extreme(
             jnp.maximum(width, 1).astype(jnp.int64))),
         jnp.int64(-1),
     )
-    out = jnp.full(n, identity, dtype=masked.dtype)
+    # identity may itself carry trailing dims (a wide-decimal sentinel
+    # limb pair); broadcast it to the lane shape either way
+    out = jnp.broadcast_to(
+        jnp.asarray(identity, dtype=masked.dtype), masked.shape
+    )
     tbl = masked
     # levels must include k = floor(log2(n)): a frame spanning the whole
     # batch has width n and queries that top level
@@ -351,6 +359,8 @@ def _range_extreme(
     s_clip = jnp.clip(start, 0, n - 1)
     for k in range(levels):
         hit = lev == k
+        if masked.ndim > 1:
+            hit = hit[:, None]
         # two overlapping 2^k blocks: [s, s+2^k-1] and [e-2^k+1, e]
         second = jnp.clip(end - (1 << k) + 1, 0, n - 1)
         cand = op(tbl[s_clip], tbl[second])
@@ -399,4 +409,79 @@ def framed_minmax(
     else:
         # sliding frame (bounded both ends): per-row range reduction
         out = _range_extreme(masked, start, end, op, sentinel)
+    return out, cnt
+
+
+# --- wide (two-limb) decimal min/max ------------------------------------
+# decimal(19..38) lanes are (n, 2) int64: limb 0 the low 64 bits
+# (unsigned), limb 1 the high 64 bits (signed) — Int128ArrayBlock layout.
+# Ordering is limb-wise: compare high limbs signed, tie-break on low
+# limbs UNsigned (XOR the sign bit turns unsigned compare into signed).
+
+_WIDE_SIGN = np.int64(-(2**63))
+
+
+def _wide_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    lo_a = a[..., 0] ^ _WIDE_SIGN
+    lo_b = b[..., 0] ^ _WIDE_SIGN
+    return (a[..., 1] < b[..., 1]) | (
+        (a[..., 1] == b[..., 1]) & (lo_a < lo_b)
+    )
+
+
+def _wide_min_op(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(_wide_less(x, y)[..., None], x, y)
+
+
+def _wide_max_op(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return jnp.where(_wide_less(y, x)[..., None], x, y)
+
+
+def wide_sentinel(kind: str) -> np.ndarray:
+    """Identity limb pair for wide min/max.  hi = ±(2^63 - 1) strictly
+    dominates every decimal(38) value (|hi limb| <= 5.5e18 < 2^63 - 1).
+    min/max only compare and select — never add — so the full int64
+    range is safe here (unlike I64_MAX's 2^62 headroom for sums)."""
+    hi = np.int64(2**63 - 1)
+    if kind == "min":
+        return np.array([-1, hi], dtype=np.int64)  # lo = all ones
+    return np.array([0, -hi], dtype=np.int64)
+
+
+def framed_minmax_wide(
+    lane: Lane,
+    sel: jnp.ndarray,
+    b: WindowBounds,
+    frame,
+    kind: str,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(value, count) min/max over wide-decimal (n, 2) lanes: the same
+    prefix/suffix segmented scans and sparse-table range reduction as
+    framed_minmax, with the scalar compare replaced by the limb-wise
+    one and whole limb PAIRS selected per combine."""
+    v, ok = lane
+    live = sel & ok
+    sent = wide_sentinel(kind)
+    masked = jnp.where(live[:, None], v, sent)
+    op = _wide_min_op if kind == "min" else _wide_max_op
+    start, end = frame_range(frame, b)
+    # frame count inline (framed_sum_count sums scalar lanes only)
+    nonempty = end >= start
+    s = jnp.clip(start, 0, b.n - 1)
+    e1 = jnp.clip(end + 1, 0, b.n)
+    cc = _excl_cumsum(live.astype(jnp.int64))
+    cnt = jnp.where(nonempty, cc[e1] - cc[s], 0)
+    if _prefix_unbounded(frame):
+        pb = jnp.concatenate(
+            [jnp.ones(1, bool), b.part_start[1:] != b.part_start[:-1]]
+        )
+        running = _segscan(masked, pb, op, reverse=False)
+        out = running[jnp.clip(end, 0, b.n - 1)]
+    elif _suffix_unbounded(frame):
+        nb = jnp.concatenate([b.part_start[1:] != b.part_start[:-1],
+                              jnp.ones(1, bool)])
+        running = _segscan(masked, nb, op, reverse=True)
+        out = running[jnp.clip(start, 0, b.n - 1)]
+    else:
+        out = _range_extreme(masked, start, end, op, sent)
     return out, cnt

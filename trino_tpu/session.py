@@ -58,6 +58,9 @@ class Session:
             self.catalogs.register_factory(HiveConnectorFactory())
         except ImportError:  # pyarrow not installed
             pass
+        from .connectors.lakehouse import LakehouseConnectorFactory
+
+        self.catalogs.register_factory(LakehouseConnectorFactory())
         self.default_catalog = catalog
         self.properties = SessionProperties(config)
         self.metadata = Metadata(self.catalogs)

@@ -1715,6 +1715,32 @@ class Analyzer:
         catalog, schema = self.metadata.resolve_table(
             t.name, self.default_catalog
         )
+        handle = schema.name
+        if t.version is not None:
+            # time travel: resolve FOR VERSION|TIMESTAMP AS OF to a
+            # snapshot id and pin the scan by suffixing the handle —
+            # "orders@3" — so splits, stats, caches and data_version all
+            # key on the pinned snapshot with no extra plumbing
+            kind, expr = t.version
+            if isinstance(expr, (ast.Literal, ast.TypedLiteral)):
+                value = expr.value
+            else:
+                raise SemanticError(
+                    "FOR VERSION/TIMESTAMP AS OF expects a literal"
+                )
+            conn = self.metadata.catalogs.get(catalog)
+            resolve = getattr(
+                conn.metadata(), "resolve_snapshot", None
+            )
+            if resolve is None:
+                raise SemanticError(
+                    f"catalog {catalog} does not support time travel"
+                )
+            try:
+                snap = resolve(schema.name, kind, value)
+            except (ValueError, KeyError) as exc:
+                raise SemanticError(str(exc)) from None
+            handle = f"{schema.name}@{snap}"
         assigns = []
         types_ = []
         fields = []
@@ -1725,7 +1751,7 @@ class Analyzer:
             types_.append((sym, c.type))
             fields.append(Field(qual, c.name.lower(), sym, c.type))
         node: P.PlanNode = P.TableScan(
-            catalog, schema.name, tuple(assigns), tuple(types_)
+            catalog, handle, tuple(assigns), tuple(types_)
         )
         if t.sample is not None:
             _, pct = t.sample

@@ -219,6 +219,9 @@ class Table(Node):
     # TABLESAMPLE (method, percentage); engine treats both methods as
     # BERNOULLI row sampling
     sample: Optional[Tuple[str, float]] = None
+    # time travel: FOR VERSION|TIMESTAMP AS OF <expr> -> ("version"|
+    # "timestamp", expr); the analyzer resolves it to a pinned snapshot
+    version: Optional[Tuple[str, "Node"]] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1008,13 +1008,34 @@ class Parser:
             self.expect_op(")")
             return rel
         name = self.qualified_name()
+        version = None
+        if self.accept_kw("for"):
+            # time travel: FOR VERSION AS OF n / FOR TIMESTAMP AS OF t
+            if self.accept_soft("version"):
+                kind = "version"
+            elif self.accept_kw("timestamp"):
+                kind = "timestamp"
+            else:
+                raise ParseError(
+                    f"expected VERSION or TIMESTAMP after FOR "
+                    f"at {self.peek()!r}"
+                )
+            self.expect_kw("as")
+            if not self.accept_soft("of"):
+                raise ParseError(
+                    f"expected OF after {kind.upper()} AS "
+                    f"at {self.peek()!r}"
+                )
+            version = (kind, self.expr())
         sample = None
         if self.accept_soft("tablesample"):
             sample = self._sample_clause()
         if (self.peek().kind == "ident"
                 and self.peek().text.lower() == "match_recognize"):
             self.next()
-            return self._match_recognize(ast.Table(name, None, sample))
+            return self._match_recognize(
+                ast.Table(name, None, sample, version)
+            )
         alias = None
         if self.accept_kw("as"):
             alias = self.ident()
@@ -1024,7 +1045,7 @@ class Parser:
         if sample is None and self.accept_soft("tablesample"):
             # grammar-conformant order: alias before TABLESAMPLE
             sample = self._sample_clause()
-        return ast.Table(name, alias, sample)
+        return ast.Table(name, alias, sample, version)
 
     def qualified_name(self) -> Tuple[str, ...]:
         parts = [self.ident()]

@@ -60,6 +60,26 @@ SITES = (
                             # coordinator (server/coordinator_main.py) —
                             # an in-process coordinator firing it would
                             # take the whole test runner down
+    "objstore_latency",     # object-store op stalls (rule field
+                            # `stall_s`) — the Tail-at-Scale storage
+                            # analog; keyed "{op}:{path}" so `match`
+                            # scopes it to e.g. metadata reads
+    "objstore_error",       # transient object-store failure (S3 500/
+                            # SlowDown analog); the fs layer retries with
+                            # bounded backoff, so a rule with small
+                            # `times` is invisible to callers while a
+                            # persistent rule exhausts the retry budget
+    "objstore_throttle",    # throttling rejection (S3 503) — retried
+                            # like objstore_error but counted separately
+                            # so dashboards can tell overload from faults
+    "lake_commit_crash",    # hard writer exit (kill -9 analog) BETWEEN
+                            # a lakehouse commit's data-file write and
+                            # its metadata-pointer CAS, keyed
+                            # "{table}:{snapshotId}"; only honored when
+                            # TRINO_TPU_CRASH_FAULTS=1 marks the process
+                            # as a sacrificial subprocess writer — an
+                            # in-process session firing it would take
+                            # the whole test runner down
 )
 
 

@@ -50,6 +50,8 @@ METRIC_SUBSYSTEMS = (
     "coordinator",
     "signature",
     "slo",
+    "objstore",
+    "lake",
 )
 
 METRIC_NAME_RE = re.compile(
