@@ -181,12 +181,45 @@ def _mesh_sizes() -> tuple:
     return tuple(n for n in sizes if n >= 1)
 
 
+def _hosts_sizes() -> tuple:
+    """--hosts[=1,2] (also BENCH_HOSTS=1,2).
+
+    Opt-in multi-host sweep: for each listed P, stand up P real host
+    processes on localhost (2 virtual devices each, cross-host mesh
+    mode on) and time a grouped aggregation whose hash repartition
+    crosses the process boundary — recording cross-host exchange
+    bytes/wall and per-host throughput.  Off by default: it measures
+    the network exchange, not single-process scan speed.
+    """
+    spec = os.environ.get("BENCH_HOSTS", "")
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--hosts":
+            spec = (
+                argv[i + 1]
+                if i + 1 < len(argv) and argv[i + 1][:1].isdigit()
+                else "1,2"
+            )
+        elif a.startswith("--hosts="):
+            spec = a.split("=", 1)[1]
+    if not spec:
+        return ()
+    try:
+        sizes = sorted({int(x) for x in spec.split(",") if x.strip()})
+    except ValueError:
+        raise SystemExit(
+            f"--hosts takes a CSV of host-process counts, got {spec!r}"
+        )
+    return tuple(n for n in sizes if n >= 1)
+
+
 CACHE_MODE = _cache_mode()
 CHAOS_CHURN = _chaos_churn()
 CHAOS_COORDINATOR = _chaos_coordinator()
 SERVE_MODE = _serve_mode()
 LAKE_MODE = _lake_mode()
 MESH_SIZES = _mesh_sizes()
+HOSTS_SIZES = _hosts_sizes()
 CACHE_PROPS = {
     "off": {"result_cache": False, "compile_cache": False,
             "scan_cache_enabled": False},
@@ -975,6 +1008,10 @@ def main():
             import trino_tpu
 
             trino_tpu.force_cpu(max(8, max(MESH_SIZES)))
+    if HOSTS_SIZES:
+        # children (BENCH_ONLY subprocesses) must see the same axis; the
+        # host processes themselves set their own XLA_FLAGS device split
+        os.environ["BENCH_HOSTS"] = ",".join(str(n) for n in HOSTS_SIZES)
     import jax
 
     # persistent compilation cache: repeated runs (and the driver's run
@@ -1216,6 +1253,91 @@ def main():
             "queries_survived": survived,
             "wall_s": round(time.perf_counter() - t0, 1),
         }
+
+    def _cfg_hosts(n):
+        # multi-host sweep (--hosts): n REAL host processes on localhost,
+        # each a 2-device virtual slice with the cross-host mesh on; the
+        # grouped aggregation's partial->final repartition is the
+        # exchange whose bytes/wall this config records.  Per-host GB/s
+        # is the cross-host wire traffic each process sustained — the
+        # number that should grow with P if the exchange layer scales.
+        def run():
+            import re as _re
+            import urllib.request as _rq
+
+            from trino_tpu.testing.runner import DistributedQueryRunner
+
+            local_devices = 2
+            sql = (
+                "select l_returnflag, l_linestatus, count(*), "
+                "sum(l_quantity), sum(l_extendedprice * (1 - l_discount)) "
+                "from lineitem group by l_returnflag, l_linestatus "
+                "order by l_returnflag, l_linestatus"
+            )
+
+            def scrape(uri, name):
+                with _rq.urlopen(f"{uri}/metrics", timeout=5.0) as resp:
+                    text = resp.read().decode()
+                m = _re.search(
+                    rf"^{_re.escape(name)} (\S+)", text, _re.M
+                )
+                return float(m.group(1)) if m else 0.0
+
+            t0 = time.perf_counter()
+            with DistributedQueryRunner(
+                workers=0,
+                catalogs=(("tpch", "tpch", {"tpch.scale-factor": 0.01}),),
+                properties={"cross_host_mesh": True, **CACHE_PROPS},
+            ) as runner:
+                for _ in range(n):
+                    runner.add_subprocess_worker(
+                        local_devices=local_devices
+                    )
+                nrows = runner.rows(
+                    "select count(*) from lineitem"
+                )[0][0]
+                runner.rows(sql)  # warm: compile + page caches
+                uris = [u for _, _, u in runner.subprocess_workers]
+                walls = []
+                b0 = sum(
+                    scrape(u, "trino_tpu_exchange_cross_host_fetch_bytes")
+                    for u in uris
+                )
+                f0 = sum(
+                    scrape(u, "trino_tpu_exchange_cross_host_fetch_total")
+                    for u in uris
+                )
+                for _ in range(3):
+                    q0 = time.perf_counter()
+                    runner.rows(sql)
+                    walls.append(time.perf_counter() - q0)
+                x_bytes = sum(
+                    scrape(u, "trino_tpu_exchange_cross_host_fetch_bytes")
+                    for u in uris
+                ) - b0
+                x_fetches = sum(
+                    scrape(u, "trino_tpu_exchange_cross_host_fetch_total")
+                    for u in uris
+                ) - f0
+            steady = min(walls)
+            return {
+                "hosts": n,
+                "local_devices": local_devices,
+                "global_devices": n * local_devices,
+                "steady_s": round(steady, 4),
+                "rows_per_sec": round(nrows / steady, 1),
+                "cross_host_fetches": int(x_fetches),
+                "cross_host_bytes": int(x_bytes),
+                "cross_host_bytes_per_s": round(
+                    x_bytes / 3 / steady, 1
+                ),
+                "per_host_exchange_gbps": round(
+                    x_bytes / 3 / steady / n / 1e9, 6
+                ),
+                "wall_s": round(time.perf_counter() - t0, 1),
+            }
+
+        return run
 
     def _cfg_chaos_coordinator():
         # coordinator-crash chaos (--chaos-coordinator): a killable
@@ -1934,6 +2056,11 @@ def main():
         plan.append((
             f"mesh_q6_{widest}dev_unfused", _cfg_mesh(widest, "off"), 90, []
         ))
+    if HOSTS_SIZES:
+        # appended after the CPU filter too: the multi-host exchange
+        # axis is explicit opt-in on every backend (--hosts/BENCH_HOSTS)
+        for n in HOSTS_SIZES:
+            plan.append((f"hosts_agg_{n}host", _cfg_hosts(n), 120, []))
 
     only = os.environ.get("BENCH_ONLY")
     if only:
@@ -2084,6 +2211,46 @@ def main():
             }
         if mesh:
             state["mesh_scaling"] = mesh
+
+    # multi-host rollup (--hosts): cross-host exchange bytes/wall per
+    # host count, plus the single- to multi-host throughput ratio (the
+    # network exchange's price tag on this backend)
+    if HOSTS_SIZES:
+        hosts = {}
+        for n in HOSTS_SIZES:
+            cfg = state["configs"].get(f"hosts_agg_{n}host", {})
+            if isinstance(cfg, dict) and cfg.get("rows_per_sec"):
+                hosts[f"{n}host"] = {
+                    "rows_per_sec": cfg["rows_per_sec"],
+                    "steady_s": cfg.get("steady_s"),
+                    "cross_host_bytes": cfg.get("cross_host_bytes"),
+                    "cross_host_bytes_per_s": cfg.get(
+                        "cross_host_bytes_per_s"
+                    ),
+                    "per_host_exchange_gbps": cfg.get(
+                        "per_host_exchange_gbps"
+                    ),
+                }
+        lo, hi = min(HOSTS_SIZES), max(HOSTS_SIZES)
+        a = state["configs"].get(f"hosts_agg_{lo}host", {})
+        b = state["configs"].get(f"hosts_agg_{hi}host", {})
+        if (
+            isinstance(a, dict) and isinstance(b, dict)
+            and a.get("rows_per_sec") and b.get("rows_per_sec")
+        ):
+            hosts["scaling"] = {
+                "from_hosts": lo,
+                "to_hosts": hi,
+                "speedup": round(
+                    b["rows_per_sec"] / a["rows_per_sec"], 3
+                ),
+                "cross_host_bytes_delta": (
+                    int(b.get("cross_host_bytes") or 0)
+                    - int(a.get("cross_host_bytes") or 0)
+                ),
+            }
+        if hosts:
+            state["multihost"] = hosts
 
     # per-operator timeline of the slowest completed TPC-H config (BENCH
     # "operator_timeline"): one eager operator_stats pass at SF1 so a

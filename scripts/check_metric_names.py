@@ -74,6 +74,9 @@ OBJSTORE_LITERAL_RE = re.compile(
     r'["\'](trino_tpu_objstore_[a-z0-9_]*)["\']'
 )
 LAKE_LITERAL_RE = re.compile(r'["\'](trino_tpu_lake_[a-z0-9_]*)["\']')
+# multi-host cluster literals likewise: the kill -9 host-loss acceptance
+# test and the multihost smoke assert on these series by full name
+HOST_LITERAL_RE = re.compile(r'["\'](trino_tpu_host_[a-z0-9_]*)["\']')
 
 # one naming regime across the observability surface: metric names above,
 # span names at tracer call sites (snake_case, like the metric stems),
@@ -115,7 +118,7 @@ def check_tree(root: str):
             NODE_LITERAL_RE, JOURNAL_LITERAL_RE, DOCTOR_LITERAL_RE,
             RESOURCE_GROUP_LITERAL_RE, AUTOSCALER_LITERAL_RE,
             COMPILE_LITERAL_RE, SLO_LITERAL_RE, SIGNATURE_LITERAL_RE,
-            OBJSTORE_LITERAL_RE, LAKE_LITERAL_RE,
+            OBJSTORE_LITERAL_RE, LAKE_LITERAL_RE, HOST_LITERAL_RE,
         ):
             for m in regex.finditer(text):
                 if m.span(1) in seen_spans:
@@ -171,6 +174,8 @@ def check_tree(root: str):
          "trino_tpu.obs.serving_observatory", "SLO_FIELDS"),
         ("trino_tpu/connectors/lakehouse.py",
          "trino_tpu.connectors.lakehouse", "SNAPSHOT_FIELDS"),
+        ("trino_tpu/distributed/topology.py",
+         "trino_tpu.distributed.topology", "TOPOLOGY_FIELDS"),
     )
     for rel, mod, attr in field_schemas:
         try:

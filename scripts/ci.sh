@@ -38,6 +38,16 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu BENCH_SERVE=smoke \
     BENCH_ONLY=serve_smoke python bench.py \
     | python scripts/check_serve_smoke.py || rc=1
 
+echo "== multihost smoke =="
+# ~30s multi-host cluster smoke: coordinator + 2 real host processes on
+# localhost (2 virtual devices each, cross-host mesh mode on), one
+# grouped aggregation whose repartition crosses the process boundary —
+# byte-identical to single-host, mesh-mode compiles on every host, the
+# cross-host exchange metric strictly positive, zero failed queries
+# (scripts/multihost_smoke.py)
+timeout -k 10 180 env JAX_PLATFORMS=cpu JAX_ENABLE_X64=1 \
+    python scripts/multihost_smoke.py || rc=1
+
 echo "== bench sentinel =="
 if ls BENCH_r*.json >/dev/null 2>&1; then
     python scripts/bench_sentinel.py || rc=1

@@ -10,6 +10,21 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if os.environ.get("TRINO_TPU_TEST_TPU") != "1":
+    # Share compiled XLA executables across every process the suite
+    # spawns: the distributed/lifecycle/recovery/multihost tests each
+    # stand up fresh worker processes that would otherwise recompile
+    # identical fragment programs from scratch.  The cache is keyed by
+    # HLO + compile options + jax version, so reuse is always sound;
+    # min-compile-time 0 catches the many sub-second fragment programs
+    # that dominate on the CPU tier-1 path.  Env (not jax.config) so
+    # subprocess workers inherit it.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", "/tmp/trino_tpu_xla_cache"
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
 import trino_tpu
 
 def pytest_configure(config):

@@ -306,3 +306,104 @@ def test_writer_killed_mid_commit_leaves_readable_history(tmp_path):
         "order by snapshot_id"
     ).to_pylist()
     assert [tuple(r) for r in snaps] == [(0, "create", 0), (1, "append", 2)]
+
+
+# --- maintenance: expire_snapshots + remove_orphan_files ------------------
+
+
+def test_expire_snapshots_prunes_history_and_reclaims_files(tmp_path):
+    """expire_snapshots rides the same CAS commit protocol as writers:
+    the pruned metadata races the pointer, and only a WON swap deletes
+    the expired snapshots' manifests and their now-unreferenced data
+    files.  Time travel to an expired snapshot must fail loudly while
+    the current snapshot stays byte-identical."""
+    s = _lake(tmp_path)
+    s.execute("create table lake.default.exp (k bigint)")
+    s.execute("insert into lake.default.exp values (1), (2)")
+    # overwrite: the append's data file is now referenced ONLY by history
+    s.execute("delete from lake.default.exp where k = 1")
+    conn = s.catalogs.get("lake")
+    assert s.execute(
+        "select k from lake.default.exp for version as of 1 order by k"
+    ).to_pylist() == [(1,), (2,)]
+    data_before = len(conn.fs.list_files("exp/data"))
+
+    res = conn.expire_snapshots("exp", keep=1)
+    assert res["expiredSnapshots"] == 2  # create + append pruned
+    assert res["removedFiles"] == 1  # the append-only data file
+    assert res["currentSnapshotId"] == 2
+
+    # current snapshot unperturbed; the expired one is gone from history
+    assert s.execute(
+        "select k from lake.default.exp order by k"
+    ).to_pylist() == [(2,)]
+    with pytest.raises(Exception, match="1"):
+        s.execute("select k from lake.default.exp for version as of 1")
+    snaps = s.execute(
+        "select snapshot_id, operation from system.runtime.snapshots "
+        "where table_name = 'exp' order by snapshot_id"
+    ).to_pylist()
+    assert [tuple(r) for r in snaps] == [(2, "overwrite")]
+
+    # the reclaim really happened on the store, and left no new orphans
+    assert len(conn.fs.list_files("exp/data")) == data_before - 1
+    assert conn.orphaned_files("exp") == []
+
+    # idempotent: nothing left to prune
+    again = conn.expire_snapshots("exp", keep=1)
+    assert again["expiredSnapshots"] == 0 and again["removedFiles"] == 0
+
+    # maintenance on a pinned snapshot handle is a contract violation
+    with pytest.raises(ValueError, match="pinned"):
+        conn.expire_snapshots("exp@2")
+
+    assert _metric_total("trino_tpu_lake_expired_snapshots_total") >= 2
+    expired = [
+        e for e in journal.get_journal().tail()
+        if e.get("eventType") == journal.SNAPSHOT_EXPIRED
+    ]
+    assert expired, "expiry was not journaled"
+    assert expired[0]["detail"]["table"] == "exp"
+    assert expired[0]["detail"]["expired"] == 2
+    assert expired[0]["detail"]["removedFiles"] == 1
+
+
+def test_remove_orphan_files_sweeps_crashed_writer_leftovers(tmp_path):
+    """A crashed writer's data file (written before its commit CAS ever
+    landed) is swept by remove_orphan_files; referenced files and the
+    in-flight grace window are respected, and the sweep is journaled."""
+    s = _lake(tmp_path)
+    s.execute("create table lake.default.orph (k bigint)")
+    s.execute("insert into lake.default.orph values (1), (2)")
+    conn = s.catalogs.get("lake")
+    # the crashed writer's leftover: present in the store, referenced by
+    # no committed snapshot (same shape the kill-9 scenario detects)
+    conn.fs.write_file("orph/data/deadwriter-000.bin", b"x" * 128)
+    assert conn.orphaned_files("orph") == ["orph/data/deadwriter-000.bin"]
+
+    res = conn.remove_orphan_files("orph", older_than_s=0.0)
+    assert res["removedFiles"] == 1
+    assert res["freedBytes"] == 128
+    assert conn.orphaned_files("orph") == []
+
+    # referenced files untouched: the table reads back identically
+    assert s.execute(
+        "select k from lake.default.orph order by k"
+    ).to_pylist() == [(1,), (2,)]
+
+    swept = [
+        e for e in journal.get_journal().tail()
+        if e.get("eventType") == journal.ORPHANS_REMOVED
+    ]
+    assert swept, "orphan sweep was not journaled"
+    assert swept[-1]["detail"]["table"] == "orph"
+    assert swept[-1]["detail"]["removedFiles"] == 1
+    assert swept[-1]["detail"]["freedBytes"] == 128
+    assert _metric_total("trino_tpu_lake_orphans_removed_total") >= 1
+
+    # in-flight-writer grace: a fresh unreferenced file inside the age
+    # floor must NOT be swept — a live writer's commit may be in flight
+    conn.fs.write_file("orph/data/inflight-001.bin", b"y")
+    res2 = conn.remove_orphan_files("orph", older_than_s=3600.0)
+    assert res2["removedFiles"] == 0
+    assert conn.orphaned_files("orph") == ["orph/data/inflight-001.bin"]

@@ -149,6 +149,13 @@ SESSION_PROPERTIES: Dict[str, PropertyMetadata] = {
             int, 0,
         ),
         PropertyMetadata(
+            "cross_host_mesh",
+            "multi-host clusters: run eligible fragments as per-host "
+            "shard_map slices of the global mesh, with repartition and "
+            "partial-aggregate merges crossing the network exchange",
+            _bool, False,
+        ),
+        PropertyMetadata(
             "join_distribution_type",
             "automatic | broadcast | partitioned "
             "(DetermineJoinDistributionType analog)",

@@ -656,6 +656,183 @@ class LakehouseConnector(Connector):
             if e.path not in referenced
         )
 
+    def expire_snapshots(self, table: str, keep: int = 1) -> dict:
+        """Prune snapshot history down to the newest ``keep`` snapshots
+        (the current one always survives), reclaiming manifests and any
+        data files only expired snapshots referenced — Iceberg's
+        ``expire_snapshots`` procedure.
+
+        The metadata change rides the SAME compare-and-swap commit
+        protocol as writers: prepare a token-named metadata document
+        with the pruned history, race the pointer, and on a lost CAS
+        re-read the winner and retry — so maintenance is safe to run
+        concurrently with appends.  Files are deleted only AFTER the CAS
+        lands: until then every snapshot is still reachable, and the
+        immutable loser documents are mere orphan metadata."""
+        name, pinned = _split_handle(table)
+        if pinned is not None:
+            raise ValueError(
+                f"cannot run maintenance on a pinned snapshot: {table}"
+            )
+        keep = max(int(keep), 1)
+        fs = self.fs
+        t0 = time.perf_counter()
+        for attempt in range(MAX_COMMIT_RETRIES):
+            state = _load_state(fs, name)
+            snaps = list(state.meta["snapshots"])
+            kept = snaps[-keep:]
+            if not any(
+                int(s["snapshotId"]) == state.current for s in kept
+            ):
+                kept = [
+                    s for s in snaps
+                    if int(s["snapshotId"]) == state.current
+                ] + kept
+            dropped = [s for s in snaps if s not in kept]
+            if not dropped:
+                return {
+                    "table": name, "expiredSnapshots": 0,
+                    "removedFiles": 0,
+                    "currentSnapshotId": state.current,
+                }
+            kept_refs = {
+                f["path"]
+                for s in kept
+                for f in _read_manifest(fs, name, s)
+            }
+            dropped_refs = {
+                f["path"]
+                for s in dropped
+                for f in _read_manifest(fs, name, s)
+            }
+            token = uuid.uuid4().hex[:8]
+            meta = dict(state.meta)
+            meta["snapshots"] = kept
+            meta_name = f"v{state.current}-{token}.json"
+            fs.write_file(
+                f"{name}/metadata/{meta_name}", json.dumps(meta).encode()
+            )
+            if fs.compare_and_swap(
+                _ptr_key(name), state.ptr, meta_name.encode()
+            ):
+                removed = 0
+                for s in dropped:
+                    try:
+                        fs.delete_file(
+                            f"{name}/metadata/{s['manifest']}"
+                        )
+                    except ObjectStoreError:
+                        pass
+                for p in sorted(dropped_refs - kept_refs):
+                    try:
+                        fs.delete_file(p)
+                        removed += 1
+                    except ObjectStoreError:
+                        pass
+                REGISTRY.counter(
+                    "trino_tpu_lake_commits_total",
+                    "Lakehouse snapshot commits by operation",
+                ).inc(op="expire_snapshots")
+                REGISTRY.counter(
+                    "trino_tpu_lake_expired_snapshots_total",
+                    "Snapshots pruned by expire_snapshots",
+                ).inc(len(dropped))
+                REGISTRY.histogram(
+                    "trino_tpu_lake_commit_seconds",
+                    "Wall seconds per lakehouse commit (incl. retries)",
+                ).observe(time.perf_counter() - t0)
+                journal.emit(
+                    journal.SNAPSHOT_EXPIRED,
+                    severity=journal.INFO,
+                    table=name,
+                    expired=len(dropped),
+                    removedFiles=removed,
+                    currentSnapshotId=state.current,
+                )
+                return {
+                    "table": name,
+                    "expiredSnapshots": len(dropped),
+                    "removedFiles": removed,
+                    "currentSnapshotId": state.current,
+                }
+            REGISTRY.counter(
+                "trino_tpu_lake_conflicts_total",
+                "Lakehouse commit CAS losses (retried)",
+            ).inc(op="expire_snapshots")
+            journal.emit(
+                journal.SNAPSHOT_CONFLICT,
+                severity=journal.WARN,
+                table=name,
+                attempted=state.current,
+                winner=_load_state(fs, name).current,
+                attempt=attempt + 1,
+            )
+        raise ObjectStoreError(
+            f"expire_snapshots on {name} lost the metadata CAS "
+            f"{MAX_COMMIT_RETRIES} times; giving up"
+        )
+
+    def remove_orphan_files(
+        self, table: str, older_than_s: float = 0.0
+    ) -> dict:
+        """Delete data files no committed snapshot references — what a
+        crashed writer (or a CAS loser that never retried) leaves
+        behind.  ``older_than_s`` is the in-flight-writer grace: a live
+        writer's data file exists BEFORE its commit CAS lands, so
+        production callers pass an age floor (Iceberg defaults to 3
+        days); tests pass 0.
+
+        Validation rides the commit protocol: after computing the
+        orphan set the pointer is re-read, and if a concurrent commit
+        moved it the scan restarts — a file that just became referenced
+        must not be swept."""
+        name, _ = _split_handle(table)
+        fs = self.fs
+        now_ns = time.time_ns()
+        for attempt in range(MAX_COMMIT_RETRIES):
+            state = _load_state(fs, name)
+            referenced = set()
+            for s in state.meta["snapshots"]:
+                for f in _read_manifest(fs, name, s):
+                    referenced.add(f["path"])
+            candidates = [
+                e for e in fs.list_files(f"{name}/data")
+                if e.path not in referenced
+                and (now_ns - e.mtime_ns) >= older_than_s * 1e9
+            ]
+            # pointer unchanged => no commit raced the scan; a moved
+            # pointer may have promoted a candidate to referenced
+            if fs.read_file(_ptr_key(name)) != state.ptr:
+                continue
+            removed = 0
+            freed = 0
+            for e in candidates:
+                try:
+                    fs.delete_file(e.path)
+                    removed += 1
+                    freed += int(e.size)
+                except ObjectStoreError:
+                    pass
+            REGISTRY.counter(
+                "trino_tpu_lake_orphans_removed_total",
+                "Orphan data files reclaimed by remove_orphan_files",
+            ).inc(removed)
+            journal.emit(
+                journal.ORPHANS_REMOVED,
+                severity=journal.INFO,
+                table=name,
+                removedFiles=removed,
+                freedBytes=freed,
+            )
+            return {
+                "table": name, "removedFiles": removed,
+                "freedBytes": freed,
+            }
+        raise ObjectStoreError(
+            f"remove_orphan_files on {name} kept racing commits "
+            f"{MAX_COMMIT_RETRIES} times; giving up"
+        )
+
     def metadata(self) -> LakehouseMetadata:
         return LakehouseMetadata(self)
 

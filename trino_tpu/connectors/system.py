@@ -58,6 +58,12 @@ SCHEMAS: Dict[str, List] = {
         # ACTIVE/DEGRADED/QUARANTINED + strikes toward the blacklist
         ("device_state", T.VARCHAR),
         ("device_strikes", T.BIGINT),
+        # multi-host topology (distributed/topology.py): which host the
+        # node lives on, its process index in the global mesh, and how
+        # many local devices its slice owns; NULL for plain workers
+        ("host", T.VARCHAR),
+        ("process_index", T.BIGINT),
+        ("local_devices", T.BIGINT),
     ],
     "views": [
         ("table_catalog", T.VARCHAR),
@@ -418,7 +424,9 @@ class _SystemSource:
                     nodes.append(
                         (snap["nodeId"], snap["uri"], snap["state"],
                          max(now - float(snap["stateSince"] or now), 0.0),
-                         dstate, strikes)
+                         dstate, strikes, snap.get("host"),
+                         snap.get("processIndex"),
+                         snap.get("localDevices"))
                     )
             else:
                 sup = getattr(s, "device_supervisor", None)
@@ -426,7 +434,7 @@ class _SystemSource:
                     sup.snapshot() if sup is not None else None
                 )
                 nodes.append(("local", "local://", "active", 0.0,
-                              dstate, strikes))
+                              dstate, strikes, None, None, None))
             return {
                 "node_id": [n[0] for n in nodes],
                 "http_uri": [n[1] for n in nodes],
@@ -434,6 +442,9 @@ class _SystemSource:
                 "state_age_s": [n[3] for n in nodes],
                 "device_state": [n[4] for n in nodes],
                 "device_strikes": [n[5] for n in nodes],
+                "host": [n[6] for n in nodes],
+                "process_index": [n[7] for n in nodes],
+                "local_devices": [n[8] for n in nodes],
             }
         if table == "session_properties":
             rows = s.properties.show()

@@ -1,0 +1,15 @@
+"""Multi-host cluster runtime: topology, bootstrap, host-sized units.
+
+``P processes x their local device slices of one global logical mesh``
+— see topology.py for the bootstrap/registry layer; the cross-host
+execution mode itself lives in parallel/mesh_executor.py (per-host
+shard_map fragments whose repartition/partial-aggregate merges travel
+the network exchange instead of in-XLA collectives).
+"""
+from .topology import (  # noqa: F401
+    TOPOLOGY_FIELDS,
+    ClusterTopology,
+    HostSlice,
+    bootstrap,
+    local_topology,
+)

@@ -1,0 +1,211 @@
+"""Multi-host cluster topology: who owns which slice of the global mesh.
+
+Reference parity: a Trino cluster is a set of node JVMs discovered via
+announcements; a multi-host TPU pod is a set of *processes* each owning
+a local slice of one global device mesh (jax.distributed.initialize,
+``jax.process_index()`` / ``jax.local_devices()``).  This module is the
+junction of the two views:
+
+  - ``bootstrap()`` initialises the process's place in the global mesh.
+    On a real multi-host backend (TPU pod slice, or any environment that
+    exports a distributed coordinator address) it calls
+    ``jax.distributed.initialize()`` so every process sees the global
+    device set.  On the CPU tier-1 path there is no cross-process XLA
+    runtime: each host process is an independent JAX runtime whose
+    "local slice" is K virtual CPU devices
+    (``XLA_FLAGS=--xla_force_host_platform_device_count=K``), and the
+    topology identity (host id, process index) arrives via CLI flags —
+    the subprocess harness in testing/runner.py is the bootstrapper.
+
+  - ``ClusterTopology`` is the coordinator-side registry built from
+    worker announcements: which node is which process, on which host,
+    with how many local devices.  The scheduler, autoscaler and the
+    HOST_GONE fault path all read the cluster's shape from here.
+
+A lost host must be ordinary (Dean & Barroso, *The Tail at Scale*): the
+topology layer's job is bookkeeping precise enough that when one process
+dies, everything downstream — mesh shrink, FTE reassignment, doctor
+verdict — knows exactly which slice of the mesh it took with it.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import socket
+import threading
+from typing import Dict, List, Optional
+
+# the topology document carried in worker announcements and surfaced in
+# system.runtime.nodes: lowerCamelCase wire fields, linted by
+# scripts/check_metric_names.py
+TOPOLOGY_FIELDS = (
+    "host",
+    "processIndex",
+    "localDevices",
+    "globalDevices",
+    "processCount",
+)
+
+
+@dataclasses.dataclass
+class HostSlice:
+    """One process's slice of the global mesh, as announced."""
+
+    node_id: str
+    uri: str
+    host: str
+    process_index: int
+    local_devices: int
+
+    def to_doc(self) -> dict:
+        return {
+            "host": self.host,
+            "processIndex": self.process_index,
+            "localDevices": self.local_devices,
+        }
+
+
+def local_topology(
+    host: Optional[str] = None,
+    process_index: Optional[int] = None,
+    local_devices: Optional[int] = None,
+) -> dict:
+    """This process's topology document (the announcement payload).
+
+    Explicit arguments (the subprocess harness / CLI flags) win; absent
+    those, fall back to what the JAX runtime reports about itself —
+    which, after a real ``jax.distributed.initialize()``, is the global
+    truth, and in a single-process run degenerates to process 0 of 1.
+    """
+    import jax
+
+    if process_index is None:
+        try:
+            process_index = int(jax.process_index())
+        except Exception:
+            process_index = 0
+    if local_devices is None:
+        try:
+            local_devices = len(jax.local_devices())
+        except Exception:
+            local_devices = 1
+    try:
+        global_devices = len(jax.devices())
+        process_count = int(jax.process_count())
+    except Exception:
+        global_devices = local_devices
+        process_count = 1
+    return {
+        "host": host or socket.gethostname(),
+        "processIndex": process_index,
+        "localDevices": local_devices,
+        "globalDevices": global_devices,
+        "processCount": process_count,
+    }
+
+
+def bootstrap(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join (or stand up) the process's place in the global mesh.
+
+    Real multi-host backends: when a distributed coordinator address is
+    known — passed explicitly or via ``TRINO_TPU_DIST_COORDINATOR`` /
+    JAX's own ``JAX_COORDINATOR_ADDRESS`` — call
+    ``jax.distributed.initialize()`` so ``jax.devices()`` spans every
+    host (SNIPPETS pattern: one global mesh across processes).  The
+    call is idempotent-guarded: a second bootstrap in the same process
+    is a no-op.
+
+    CPU tier-1 path: no coordinator address is set; each process keeps
+    its own single-controller runtime and the function only returns the
+    local topology document.  Cross-process data movement then happens
+    through the exchange layer, not XLA collectives — which is exactly
+    the cross-host execution model.
+    """
+    addr = (
+        coordinator_address
+        or os.environ.get("TRINO_TPU_DIST_COORDINATOR")
+        or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    )
+    if addr:
+        nproc = num_processes or int(
+            os.environ.get("TRINO_TPU_DIST_PROCESSES", "0")
+        ) or None
+        pid = process_id
+        if pid is None and "TRINO_TPU_DIST_PROCESS_ID" in os.environ:
+            pid = int(os.environ["TRINO_TPU_DIST_PROCESS_ID"])
+        import jax
+
+        if not getattr(jax.distributed, "is_initialized", lambda: False)():
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=addr,
+                    num_processes=nproc,
+                    process_id=pid,
+                )
+            except RuntimeError:
+                # already initialised by an outer harness: keep going
+                pass
+    return local_topology()
+
+
+class ClusterTopology:
+    """Coordinator-side registry of host slices, fed by announcements.
+
+    Thread-safe: announcements arrive on HTTP handler threads while the
+    scheduler and autoscaler read the shape concurrently.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slices: Dict[str, HostSlice] = {}
+
+    def register(self, node_id: str, uri: str, topology: Optional[dict]):
+        """Record (or refresh) a node's announced slice.  Nodes that
+        announce without topology (pre-multi-host workers) are simply
+        not host-sized units — they never appear here."""
+        if not topology:
+            return
+        try:
+            hs = HostSlice(
+                node_id=node_id,
+                uri=uri,
+                host=str(topology.get("host", "")),
+                process_index=int(topology.get("processIndex", 0)),
+                local_devices=int(topology.get("localDevices", 1)),
+            )
+        except (TypeError, ValueError):
+            return
+        with self._lock:
+            self._slices[node_id] = hs
+
+    def forget(self, node_id: str) -> Optional[HostSlice]:
+        with self._lock:
+            return self._slices.pop(node_id, None)
+
+    def slice_for(self, node_id: str) -> Optional[HostSlice]:
+        with self._lock:
+            return self._slices.get(node_id)
+
+    def slices(self) -> List[HostSlice]:
+        with self._lock:
+            return sorted(
+                self._slices.values(), key=lambda s: s.process_index
+            )
+
+    def process_count(self) -> int:
+        with self._lock:
+            return len(self._slices)
+
+    def global_device_count(self) -> int:
+        """Total devices across every registered slice — the size the
+        one global logical mesh would have."""
+        with self._lock:
+            return sum(s.local_devices for s in self._slices.values())
+
+    def hosts(self) -> List[str]:
+        with self._lock:
+            return sorted({s.host for s in self._slices.values()})

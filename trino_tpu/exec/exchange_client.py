@@ -71,6 +71,7 @@ def _fetch_buffer(
     retries: int = RETRY_ATTEMPTS,
     retry_budget_s: float = RETRY_BUDGET_S,
     injector=None,
+    cross_host: bool = False,
 ) -> List[Page]:
     """Poll one upstream (task, buffer) until complete; returns its pages."""
     pages: List[Page] = []
@@ -90,6 +91,20 @@ def _fetch_buffer(
     fetch_bytes = REGISTRY.counter(
         "trino_tpu_exchange_fetch_bytes", "Serialized page bytes pulled over exchange"
     )
+    # genuinely-cross-host series: only fetches whose target is another
+    # process's URI — the multi-host acceptance tests assert network
+    # exchange on these, never inferring it from totals that local
+    # (same-process) fetches also bump
+    x_total = x_bytes = None
+    if cross_host:
+        x_total = REGISTRY.counter(
+            "trino_tpu_exchange_cross_host_fetch_total",
+            "Exchange fetches targeting a different host process",
+        )
+        x_bytes = REGISTRY.counter(
+            "trino_tpu_exchange_cross_host_fetch_bytes",
+            "Serialized page bytes pulled from other host processes",
+        )
     while True:
         url = f"{uri}/v1/task/{task}/results/{buffer}/{token}"
         try:
@@ -100,6 +115,8 @@ def _fetch_buffer(
                     "injected transient exchange failure"
                 )
             fetch_total.inc()
+            if x_total is not None:
+                x_total.inc()
             with urllib.request.urlopen(url, timeout=10.0) as resp:
                 seen_task = True
                 transient = 0
@@ -109,6 +126,8 @@ def _fetch_buffer(
                     body = resp.read()
                     if body:
                         fetch_bytes.inc(len(body))
+                        if x_bytes is not None:
+                            x_bytes.inc(len(body))
                         pages.append(deserialize_page(body))
                     if resp.headers.get("X-Buffer-Complete") == "true":
                         return pages
@@ -179,6 +198,7 @@ class ExchangeClient:
         retry_budget_s: Optional[float] = None,
         fault_injector=None,
         traceparent: Optional[str] = None,
+        own_uri: Optional[str] = None,
     ):
         self.timeout = timeout
         self.concurrency = concurrency
@@ -187,6 +207,9 @@ class ExchangeClient:
             RETRY_BUDGET_S if retry_budget_s is None else float(retry_budget_s)
         )
         self.fault_injector = fault_injector
+        # this worker's own base URI: fetches targeting any OTHER uri are
+        # cross-host network exchanges and get their own metric series
+        self.own_uri = (own_uri or "").rstrip("/")
         # W3C trace context of the hosting task: fetch spans run on pool
         # threads with empty span stacks, so the link must be explicit
         self.traceparent = traceparent
@@ -243,6 +266,10 @@ class ExchangeClient:
                 pages = _fetch_buffer(
                     loc["uri"], loc["task"], int(loc["buffer"]), self.timeout,
                     self.retries, self.retry_budget_s, self.fault_injector,
+                    cross_host=bool(
+                        self.own_uri
+                        and loc["uri"].rstrip("/") != self.own_uri
+                    ),
                 )
             fetch_seconds.observe(time.time() - start)
             return pages

@@ -165,6 +165,35 @@ def _rule_memory_kill(ctx) -> Optional[Dict]:
     return _finding("memory_kill", J.ERROR, summary, kills + revokes)
 
 
+def _rule_host_gone(ctx) -> Optional[Dict]:
+    """A host-sized capacity unit (a process owning a whole slice of the
+    global device mesh) went GONE.  Ranked ABOVE plain node churn: the
+    same death also fires NODE_GONE, but losing a host takes out every
+    device in its slice plus its local spools at once — the host loss is
+    the cause, the node transition its per-node shadow."""
+    gone = _events_of(ctx, J.HOST_GONE)
+    if not gone:
+        return None
+    reassigned = _events_of(ctx, J.FTE_REASSIGN)
+    hosts = sorted({
+        (e.get("detail") or {}).get("host") or e.get("nodeId") or "?"
+        for e in gone
+    })
+    devices = sum(
+        int((e.get("detail") or {}).get("localDevices") or 0) for e in gone
+    )
+    summary = (
+        f"host loss: {','.join(hosts)} "
+        f"({devices} local device(s)) left the cluster"
+    )
+    if reassigned:
+        summary += f" -> {len(reassigned)} task attempt(s) reassigned"
+    if ctx.get("errorCode") == "NO_NODES_AVAILABLE":
+        summary += " -> no schedulable nodes left"
+    return _finding("host_gone", J.ERROR if ctx.get("error") else J.WARN,
+                    summary, gone + reassigned)
+
+
 def _rule_node_churn(ctx) -> Optional[Dict]:
     # FTE_REASSIGN alone is a recovery *mechanism*, not churn evidence —
     # spool heals reassign too.  The rule needs an actual node signal.
@@ -505,6 +534,10 @@ def _rule_estimate_drift(ctx) -> Optional[Dict]:
 _RULES = (
     _rule_device_fault,
     _rule_memory_kill,
+    # host loss directly above node churn: a GONE host also fires
+    # NODE_GONE for its node, but the host verdict carries the real
+    # blast radius (a whole device slice and its spools)
+    _rule_host_gone,
     _rule_node_churn,
     # coordinator restart below node churn (a dead worker loses spools
     # and tasks; a dead coordinator loses only bookkeeping the WAL

@@ -66,6 +66,11 @@ STRAGGLER_FLAG = "straggler_flag"
 HEDGE = "hedge"
 NODE_SUSPECT = "node_suspect"
 NODE_GONE = "node_gone"
+# multi-host: a node that announced itself as a host-sized capacity unit
+# (a process owning a slice of the global device mesh) went GONE — its
+# whole device slice left the cluster at once, distinct from per-node
+# NODE_GONE which also fires for the same transition
+HOST_GONE = "host_gone"
 NODE_REJOIN = "node_rejoin"
 NODE_DRAINING = "node_draining"
 NODE_DRAINED = "node_drained"
@@ -93,6 +98,11 @@ QUERY_ORPHANED = "query_orphaned"
 # lakehouse optimistic concurrency: a writer lost the metadata-pointer
 # CAS to a concurrent commit and is re-reading + retrying
 SNAPSHOT_CONFLICT = "snapshot_conflict"
+# lakehouse maintenance: history pruned / unreferenced data reclaimed —
+# both commit through the same metadata-pointer CAS as writers, so they
+# are safe to run concurrently with appends
+SNAPSHOT_EXPIRED = "snapshot_expired"
+ORPHANS_REMOVED = "orphans_removed"
 
 # severities
 INFO = "info"

@@ -292,7 +292,9 @@ class MeshExecutor(LocalExecutor):
         self.force_wide_mul = False
 
         for attempt in range(7):
-            ctx = _MeshTraceCtx(self, None, None)
+            # class-attribute hook (LocalExecutor.trace_ctx_cls idiom):
+            # the cross-host slice executor swaps in _SliceTraceCtx
+            ctx = self.mesh_trace_ctx_cls(self, None, None)
 
             def fragment(scans, counts):
                 ctx.scans = scans
@@ -659,9 +661,15 @@ class MeshExecutor(LocalExecutor):
         scans: Dict[str, Dict[str, np.ndarray]] = {}
         counts: Dict[str, np.ndarray] = {}
         dicts: Dict[str, np.ndarray] = {}
+        # preorder TableScan index: the same ordinal FragmentExecutor's
+        # _load_walk uses as the scheduler's split-assignment key, so the
+        # cross-host subclass can look up its ASSIGNED splits
+        scan_idx = [0]
 
         def walk(node: P.PlanNode):
             if isinstance(node, P.TableScan):
+                idx = scan_idx[0]
+                scan_idx[0] += 1
                 conn = self.catalogs.get(node.catalog)
                 cols = [c for _, c in node.assignments]
                 provider = conn.page_source_provider()
@@ -669,12 +677,7 @@ class MeshExecutor(LocalExecutor):
                 symbols = [sym_of[c] for c in cols]
                 tmap = dict(node.types)
                 types = [(s, tmap[s]) for s in symbols]
-                # real connector splits (hive files/row groups, tpch shards)
-                # round-robin over devices — the NodeScheduler split
-                # placement, with devices standing in for worker nodes
-                splits = conn.split_manager().get_splits(
-                    node.table, ndev, node.constraint
-                )
+                splits = self._scan_splits(node, idx, ndev)
                 per_dev: List[Dict[str, tuple]] = []
                 per_dev_dicts: List[Dict[str, np.ndarray]] = []
                 dev_counts: List[int] = []
@@ -728,11 +731,35 @@ class MeshExecutor(LocalExecutor):
                 scans[str(id(node))] = merged
                 counts[str(id(node))] = np.array(dev_counts, dtype=np.int64)
                 return
+            if isinstance(node, P.RemoteSource):
+                self._load_remote_source(node, ndev, scans, counts, dicts)
+                return
             for s in node.sources:
                 walk(s)
 
         walk(plan)
         return scans, counts, dicts
+
+    def _scan_splits(self, node: P.TableScan, idx: int, ndev: int):
+        """All of a table's splits — this executor owns the whole mesh.
+        The cross-host subclass narrows this to the splits the
+        coordinator assigned to THIS host's task (split assignment
+        happened one level up, across hosts)."""
+        conn = self.catalogs.get(node.catalog)
+        # real connector splits (hive files/row groups, tpch shards)
+        # round-robin over devices — the NodeScheduler split
+        # placement, with devices standing in for worker nodes
+        return conn.split_manager().get_splits(
+            node.table, ndev, node.constraint
+        )
+
+    def _load_remote_source(self, node, ndev, scans, counts, dicts):
+        # single-process mesh plans have no exchanges inside them; only
+        # the cross-host slice executor (which overrides this) feeds
+        # fragments containing RemoteSource nodes
+        raise ExecutionError(
+            "mesh executor cannot read remote sources"
+        )
 
     def _merge_split_dicts(self, per_dev, per_dev_dicts, dicts):
         """Unify per-device varchar dictionaries across the mesh: build one
@@ -1452,3 +1479,152 @@ class _MeshTraceCtx(_TraceCtx):
             self.visit = saved_visit
         out.replicated = True
         return out
+
+
+class _SliceTraceCtx(_MeshTraceCtx):
+    """Trace context for ONE HOST'S slice of a multi-host cluster.
+
+    The mesh here spans only this process's local devices; the global
+    exchange between hosts is the server exchange layer (HTTP pages +
+    spool), not an XLA collective.  Two consequences:
+
+      - a RemoteSource is a network input this host already fetched: its
+        pages were merged once and tiled identically onto every local
+        device, so the batch is replicated (the broadcast build side of
+        FIXED_BROADCAST_DISTRIBUTION joins)
+      - a PARTIAL aggregate must STAY partial: each device emits its
+        accumulator rows and the Output gather ships ndev partial rows
+        per group through the exchange — the consumer fragment's FINAL
+        step merges them exactly as if they came from more tasks.  The
+        inherited mesh path would psum/merge to finished values here,
+        which double-finalizes once the consumer merges again.
+    """
+
+    def _visit_remotesource(self, node: P.RemoteSource) -> Batch:
+        b = self._visit_tablescan(node)
+        return Batch(b.lanes, b.sel, b.ordered, replicated=True)
+
+    def _visit_aggregate(self, node: P.Aggregate) -> Batch:
+        if node.step == "partial":
+            # bypass the fused/collective mesh paths (they emit FINALIZED
+            # outputs); the plain local partial path emits per-device
+            # accumulator lanes, one independent slice per device
+            b = self.visit(node.source)
+            out = _TraceCtx._visit_aggregate(self, node, b)
+            return Batch(
+                out.lanes, out.sel, out.ordered, replicated=b.replicated
+            )
+        return super()._visit_aggregate(node)
+
+
+# node types a host slice can run SPMD over its local devices.  Sort /
+# Window / SetOperation / writers are excluded: they either demand the
+# whole input ordered in one place or mutate external state — those
+# fragments keep the single-device FragmentExecutor.
+_SLICE_NODES = (
+    P.Output, P.TableScan, P.RemoteSource, P.Filter, P.Project, P.Values,
+    P.Aggregate, P.Join, P.SemiJoin, P.ScalarJoin, P.TopN, P.Limit,
+    P.Distinct,
+)
+
+
+def slice_eligible(plan: P.PlanNode) -> bool:
+    """True when a fragment can run as a per-host shard_map slice.
+
+    Exactly one TableScan: that makes it a SOURCE fragment whose splits
+    the coordinator already partitioned across hosts, and guarantees any
+    RemoteSource inputs are broadcast build sides (plan/fragment.py
+    places partitioned exchanges only between fragments).  Aggregates
+    must be PARTIAL — a final-step merge belongs to the consumer side of
+    the network exchange, where the rows from every host meet.
+    """
+    nscans = 0
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if not isinstance(n, _SLICE_NODES):
+            return False
+        if isinstance(n, P.TableScan):
+            nscans += 1
+        elif isinstance(n, P.Aggregate) and n.step != "partial":
+            return False
+        stack.extend(n.sources)
+    return nscans == 1
+
+
+class CrossHostFragmentExecutor(MeshExecutor):
+    """Runs one fragment task as this host's slice of the global mesh.
+
+    Drop-in for exec.fragment_exec.FragmentExecutor on slice-eligible
+    fragments: same constructor shape, same stats surface.  The worker
+    hands it the splits the coordinator assigned to THIS task and the
+    remote pages it already pulled through the exchange client; the
+    executor shards the assigned splits over the local devices and runs
+    the fragment SPMD.  Cross-host repartition and partial->final merges
+    happen where they always did — in the consumer fragment, fed through
+    the HTTP/spool exchange — so one kill -9'd host loses only its slice
+    and FTE replays its tasks from committed spools.
+    """
+
+    mesh_trace_ctx_cls = _SliceTraceCtx
+
+    def __init__(self, catalogs: CatalogManager, config: Optional[dict],
+                 splits_by_scan, remote_pages, dynamic_filters=None):
+        super().__init__(catalogs, mesh=None, config=config)
+        self.splits_by_scan = splits_by_scan or {}
+        self.remote_pages = remote_pages or {}
+        # dynamic filters are a scan-pruning optimization; the slice path
+        # skips them (semantically a no-op — the probe-side filter still
+        # applies) rather than threading them through the stacked loader
+        self.dynamic_filters = dynamic_filters or {}
+        self.df_rows_pruned = 0
+        # same exchange accounting as FragmentExecutor: bytes this task
+        # pulled across the network before any operator ran
+        self.exchange_bytes = sum(
+            int(getattr(c.values, "nbytes", 0))
+            + int(getattr(c.validity, "nbytes", 0) or 0)
+            for pages in (remote_pages or {}).values()
+            for p in pages
+            for c in p.columns
+        )
+        if self.bandwidth_ledger is not None:
+            self.bandwidth_ledger.exchange_bytes += self.exchange_bytes
+
+    def _scan_splits(self, node: P.TableScan, idx: int, ndev: int):
+        # ONLY the splits the coordinator assigned to this task, keyed by
+        # the same preorder scan ordinal FragmentExecutor._load_walk uses
+        return self.splits_by_scan.get(idx, [])
+
+    def _load_remote_source(self, node, ndev, scans, counts, dicts):
+        """Merge the fetched exchange pages once, then tile the rows
+        identically onto every local device ([ndev, cap] stacks) — the
+        slice ctx marks the batch replicated, so joins treat it as the
+        broadcast build side without any per-device repartition."""
+        pages = self.remote_pages.get(node.fragment_id, [])
+        local_dicts: Dict[str, np.ndarray] = {}
+        merged, total = merge_pages_to_arrays(
+            pages, list(node.symbols), list(node.types_), local_dicts
+        )
+        for s, t in node.types_:
+            if t.is_dictionary and s not in local_dicts:
+                local_dicts[s] = np.array([], dtype=object)
+        dicts.update(local_dicts)
+        cap = self.ladder.quantize(max(total, 1))
+        out: Dict[str, np.ndarray] = {}
+        for sym in node.symbols:
+            v, ok = merged[sym]
+            stacked = np.zeros((ndev, cap), dtype=v.dtype)
+            stacked[:, :total] = v[:total]
+            okstack = np.zeros((ndev, cap), dtype=bool)
+            okstack[:, :total] = (
+                np.ones(total, dtype=bool) if ok is None else ok[:total]
+            )
+            out[sym] = stacked
+            out[sym + "$ok"] = okstack
+        scans[str(id(node))] = out
+        counts[str(id(node))] = np.full(ndev, total, dtype=np.int64)
+
+
+# class-attribute hook resolution: _MeshTraceCtx is defined below
+# MeshExecutor, so the default binding lives here at module bottom
+MeshExecutor.mesh_trace_ctx_cls = _MeshTraceCtx

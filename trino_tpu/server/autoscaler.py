@@ -88,10 +88,33 @@ class Autoscaler:
             return 0.0
         return cm.cluster_reserved_bytes() / total
 
+    def _topology_of(self, node_id: str) -> Optional[dict]:
+        """The node's announced multi-host topology, if it has one —
+        when set, this capacity unit is HOST-sized (a whole process with
+        its own device slice), and the scale event says so."""
+        nm = self.coordinator.node_manager
+        try:
+            with nm.lock:
+                n = nm.nodes.get(node_id)
+                if n is not None and n.topology:
+                    return dict(n.topology)
+        except Exception:  # noqa: BLE001 — telemetry only
+            pass
+        return None
+
     def _record(self, action: str, node_id: str, workers: int,
-                backlog: int):
+                backlog: int, topology: Optional[dict] = None):
         from ..obs import journal
 
+        detail = {}
+        if topology:
+            # host-granular elasticity: the unit admitted/retired is a
+            # whole host process and its device slice, not a bare node
+            detail = {
+                "host": topology.get("host", ""),
+                "processIndex": topology.get("processIndex", 0),
+                "localDevices": topology.get("localDevices", 0),
+            }
         event_id = journal.emit(
             journal.SCALE_OUT if action == "scale_out"
             else journal.SCALE_IN,
@@ -99,6 +122,7 @@ class Autoscaler:
             severity=journal.INFO,
             workers=workers,
             backlog=backlog,
+            **detail,
         )
         REGISTRY.counter(
             "trino_tpu_autoscaler_actions_total",
@@ -111,6 +135,7 @@ class Autoscaler:
             "backlog": backlog,
             "eventId": event_id,
             "ts": time.time(),
+            **detail,
         })
 
     # -- the loop body --------------------------------------------------
@@ -178,14 +203,27 @@ class Autoscaler:
         try:
             if direction == "out":
                 try:
-                    self.scale_out_cb()
+                    added = self.scale_out_cb()
                 except Exception:  # noqa: BLE001 — a failed spawn must
                     return         # not wedge the loop; cooldown retries
-                self._record("scale_out", "", workers + 1, backlog)
+                # the harness spawner returns (Popen, node_id, uri):
+                # resolve the admitted node's topology so a host-sized
+                # admission is journaled as one
+                new_id = ""
+                if isinstance(added, (tuple, list)) and len(added) >= 2:
+                    new_id = str(added[1])
+                self._record(
+                    "scale_out", new_id, workers + 1, backlog,
+                    topology=self._topology_of(new_id) if new_id else None,
+                )
             else:
                 node_id, uri = victim
+                topo = self._topology_of(node_id)
                 self._drain(node_id, uri)
-                self._record("scale_in", node_id, workers - 1, backlog)
+                self._record(
+                    "scale_in", node_id, workers - 1, backlog,
+                    topology=topo,
+                )
         finally:
             with self._lock:
                 self._busy = False

@@ -136,6 +136,11 @@ class Coordinator:
         # system.runtime.nodes reads announced node + device health
         # through the session (coordinator_only system scans)
         session.node_manager = self.node_manager
+        # multi-host cluster shape: host-sized units announce their
+        # slice of the global mesh here (distributed/topology.py)
+        from ..distributed import ClusterTopology
+
+        self.cluster_topology = ClusterTopology()
         # memory admission gate (resource-group softMemoryLimit role):
         # queries wait in QUEUED until their estimated peak fits; tenant
         # shares cap how much of the budget one tenant's admitted
@@ -657,6 +662,12 @@ class Coordinator:
         except Exception:
             pass
         try:
+            # a dead host-sized unit takes its device slice with it:
+            # the cluster topology stops counting its mesh share
+            self.cluster_topology.forget(node_id)
+        except Exception:
+            pass
+        try:
             with self._opstats_lock:
                 retired = mark_node_tasks_terminal(
                     self._opstats_by_stage, node_id
@@ -987,6 +998,9 @@ class Coordinator:
             "operator_stats": props.get("operator_stats"),
             "straggler_dispersion_factor":
                 props.get("straggler_dispersion_factor"),
+            # multi-host: workers with >1 local device run eligible
+            # fragments as per-host slices of the global mesh
+            "cross_host_mesh": props.get("cross_host_mesh"),
         }
 
     def _run_fte(
@@ -1298,7 +1312,14 @@ class _Handler(BaseHTTPRequestHandler):
                 self.coordinator.node_manager.announce(
                     doc["nodeId"], doc["uri"], memory=doc.get("memory"),
                     device=doc.get("device"), state=doc.get("state"),
+                    topology=doc.get("topology"),
                 )
+                if doc.get("topology"):
+                    # host-sized units register their mesh slice in the
+                    # coordinator's cluster topology (distributed/)
+                    self.coordinator.cluster_topology.register(
+                        doc["nodeId"], doc["uri"], doc.get("topology")
+                    )
                 if doc.get("memory"):
                     self.coordinator.cluster_memory.update_node(
                         doc["nodeId"], doc["memory"]

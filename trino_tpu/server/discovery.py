@@ -56,6 +56,9 @@ NODE_FIELDS = (
     "stateSince",
     "failureRatio",
     "device",
+    "host",
+    "processIndex",
+    "localDevices",
 )
 
 
@@ -79,6 +82,11 @@ class NodeState:
         # state ACTIVE/DEGRADED/QUARANTINED + per-device strike counts,
         # consumed by scheduler placement and system.runtime.nodes
         self.device: Optional[dict] = None
+        # announced multi-host topology (distributed/topology.py
+        # TOPOLOGY_FIELDS: host, processIndex, localDevices, ...): a node
+        # carrying one is a host-sized capacity unit — losing it fires
+        # HOST_GONE in addition to NODE_GONE
+        self.topology: Optional[dict] = None
 
 
 class NodeManager:
@@ -136,6 +144,33 @@ class NodeManager:
                 else journal.WARN if state == SUSPECT else journal.INFO,
                 prev=prev,
             )
+            if state == GONE and n.topology:
+                # host-sized unit lost: its whole device slice left the
+                # mesh at once — a distinct event so the doctor can rank
+                # a host loss above single-node churn
+                journal.emit(
+                    journal.HOST_GONE,
+                    node_id=n.node_id,
+                    severity=journal.ERROR,
+                    host=n.topology.get("host", ""),
+                    processIndex=n.topology.get("processIndex", 0),
+                    localDevices=n.topology.get("localDevices", 0),
+                )
+                REGISTRY.counter(
+                    "trino_tpu_host_gone_total",
+                    "Host-sized capacity units declared GONE",
+                ).inc()
+                # the GLOBAL logical mesh shrank: every device in the
+                # dead process's slice left at once (the cluster-level
+                # analog of a quarantined device dropping out of the
+                # local SPMD mesh)
+                journal.emit(
+                    journal.MESH_SHRINK,
+                    node_id=n.node_id,
+                    severity=journal.WARN,
+                    host=n.topology.get("host", ""),
+                    devicesLost=n.topology.get("localDevices", 0),
+                )
         return (n.node_id, n.uri, prev, state)
 
     def _fire(self, events):
@@ -179,7 +214,8 @@ class NodeManager:
     def announce(self, node_id: str, uri: str,
                  memory: Optional[dict] = None,
                  device: Optional[dict] = None,
-                 state: Optional[str] = None):
+                 state: Optional[str] = None,
+                 topology: Optional[dict] = None):
         now = time.time()
         events = []
         with self.lock:
@@ -194,6 +230,8 @@ class NodeManager:
                 n.memory = memory
             if device is not None:
                 n.device = device
+            if topology is not None:
+                n.topology = topology
             announced = state or ACTIVE
             if announced == "SHUTTING_DOWN":
                 # legacy full-shutdown drain maps onto DRAINING: it also
@@ -290,6 +328,9 @@ class NodeManager:
                     "stateSince": n.state_since,
                     "failureRatio": round(n.failure_ratio, 4),
                     "device": n.device,
+                    "host": (n.topology or {}).get("host"),
+                    "processIndex": (n.topology or {}).get("processIndex"),
+                    "localDevices": (n.topology or {}).get("localDevices"),
                 }
                 for n in self.nodes.values()
             ]

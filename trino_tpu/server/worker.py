@@ -88,6 +88,9 @@ class TaskManager:
         # dispatches through it, so quarantine outlives any one task
         self.supervisor = supervisor
         self.tasks: Dict[str, TaskExecution] = {}
+        # set by WorkerServer once its listen port is bound: exchange
+        # fetches targeting any other URI count as cross-host traffic
+        self.own_uri = ""
         # cumulative placements: /v1/task DELETEs pop finished tasks out
         # of ``tasks``, so "did this node ever get work" needs a counter
         # that survives cleanup (drain + late-joiner assertions key on it)
@@ -144,6 +147,36 @@ class TaskManager:
         self.abort(task_id)
         with self.lock:
             self.tasks.pop(task_id, None)
+
+    def _make_executor(self, plan, config, splits_by_scan, remote_pages,
+                       dfs):
+        """Pick the fragment executor.  Cross-host mode: when the
+        session asks for it (``cross_host_mesh``) and this worker owns
+        more than one local device, eligible fragments run through the
+        mesh slice executor — a per-host shard_map over the local device
+        slice whose repartition/partial-aggregate merges then travel the
+        network exchange instead of in-XLA collectives.  Ineligible
+        fragments (final-step merges, anything past the slice grammar)
+        take the scalar FragmentExecutor on the same worker, so a mixed
+        plan is still one query."""
+        want = config.get("cross_host_mesh", False)
+        if isinstance(want, str):
+            want = want.strip().lower() not in ("false", "0", "no", "off", "")
+        if want:
+            from ..parallel.mesh_executor import (
+                CrossHostFragmentExecutor,
+                slice_eligible,
+            )
+            import jax
+
+            if len(jax.devices()) > 1 and slice_eligible(plan):
+                return CrossHostFragmentExecutor(
+                    self.catalogs, config, splits_by_scan, remote_pages,
+                    dfs,
+                )
+        return FragmentExecutor(
+            self.catalogs, config, splits_by_scan, remote_pages, dfs
+        )
 
     # ------------------------------------------------------------------
     def _run(self, t: TaskExecution):
@@ -210,6 +243,7 @@ class TaskManager:
                 retry_budget_s=config.get("exchange_retry_budget_s"),
                 fault_injector=inj if inj.enabled() else None,
                 traceparent=task_span.traceparent,
+                own_uri=self.own_uri,
             )
             remote_pages = client.fetch_sources(
                 {int(fid): list(locs) for fid, locs in sources.items()}
@@ -249,8 +283,8 @@ class TaskManager:
                 # per-operator timeline: forces the eager (non-jitted)
                 # path so _TraceCtx can bracket every operator visit
                 config["collect_node_stats"] = True
-            ex = FragmentExecutor(
-                self.catalogs, config, splits_by_scan, remote_pages, dfs
+            ex = self._make_executor(
+                plan, config, splits_by_scan, remote_pages, dfs
             )
             # blocked-on-exchange: the wall this task spent pulling its
             # remote source pages before any operator could run
@@ -490,6 +524,7 @@ class _WorkerHandler(BaseHTTPRequestHandler):
                 "coordinator": False,
                 "state": state,
                 "device": device,
+                "topology": w.topology,
                 "uptime": f"{time.time() - w.started:.0f}s",
             })
             return
@@ -583,11 +618,24 @@ class WorkerServer:
         fault_injection=None,
         memory_bytes: Optional[int] = None,
         device_memory_bytes: Optional[int] = None,
+        host: Optional[str] = None,
+        process_index: Optional[int] = None,
     ):
         from ..memory import LocalMemoryManager
         from ..memory.pools import detect_device_bytes
 
         self.node_id = f"worker-{uuid.uuid4().hex[:8]}"
+        # multi-host identity is OPT-IN: only a worker explicitly placed
+        # in a cluster topology (host id / process index from the
+        # bootstrap harness) announces itself as a host-sized capacity
+        # unit; plain workers never trip the HOST_GONE machinery
+        self.topology: Optional[dict] = None
+        if host is not None or process_index is not None:
+            from ..distributed import local_topology
+
+            self.topology = local_topology(
+                host=host, process_index=process_index
+            )
         self.memory_manager = LocalMemoryManager(
             memory_bytes if memory_bytes is not None else (8 << 30),
             device_bytes=(
@@ -621,6 +669,7 @@ class WorkerServer:
         )
         self.httpd = server_cls(("127.0.0.1", port), handler)
         self.port = self.httpd.server_address[1]
+        self.task_manager.own_uri = self.uri
         self.thread = threading.Thread(
             target=self.httpd.serve_forever, daemon=True
         )
@@ -775,6 +824,8 @@ class WorkerServer:
                     # shape-census sketch, and new ledger events since
                     # the last round (coordinator merges engine-wide)
                     "compiles": self._compile_snapshot(),
+                    # multi-host slice identity (None for plain workers)
+                    "topology": self.topology,
                 }).encode()
                 req = urllib.request.Request(
                     f"{self.coordinator_uri}/v1/announcement",

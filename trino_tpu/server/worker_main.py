@@ -42,6 +42,21 @@ def main(argv=None) -> int:
         "--fault-injection", default=None,
         help="worker-level FaultInjector spec (JSON {seed, site: rule})",
     )
+    # multi-host identity: a worker launched with these flags announces
+    # itself as a host-sized capacity unit (one process owning a slice of
+    # the global device mesh).  The device count itself comes from
+    # XLA_FLAGS=--xla_force_host_platform_device_count=K, which the
+    # PARENT must place in the environment — it is read at first jax
+    # import (the enable_x64() call below), long before argparse could
+    # act on a flag.
+    p.add_argument(
+        "--host", default=None,
+        help="host identity to announce (multi-host topology)",
+    )
+    p.add_argument(
+        "--process-index", type=int, default=None,
+        help="process index within the multi-host cluster",
+    )
     args = p.parse_args(argv)
 
     # parity with the in-process topology: conftest/force_cpu enable
@@ -66,6 +81,8 @@ def main(argv=None) -> int:
         coordinator_uri=args.coordinator,
         port=args.port,
         fault_injection=fault_injection,
+        host=args.host,
+        process_index=args.process_index,
     ).start()
     print(json.dumps({"nodeId": w.node_id, "uri": w.uri}), flush=True)
 

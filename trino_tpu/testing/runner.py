@@ -51,11 +51,19 @@ def spawn_subprocess_worker(
     coordinator_uri: str,
     catalog_spec: Sequence[Tuple[str, str, dict]],
     fault_injection: Optional[dict] = None,
+    local_devices: Optional[int] = None,
+    process_index: Optional[int] = None,
+    host: Optional[str] = None,
 ) -> Tuple[subprocess.Popen, str, str]:
     """Spawn one worker as a real child process (worker_main.py) and
     block until it prints its announce line; returns (Popen, node_id,
     uri).  Shared by the in-process runner and SubprocessCoordinator —
-    the caller decides how to wait for discovery adoption."""
+    the caller decides how to wait for discovery adoption.
+
+    ``local_devices``/``process_index``/``host`` turn the child into a
+    host-sized capacity unit of a multi-host cluster: the process gets
+    its own slice of ``local_devices`` virtual CPU devices and announces
+    a topology the coordinator tracks (HOST_GONE on loss)."""
     cmd = [
         sys.executable, "-m", "trino_tpu.server.worker_main",
         "--coordinator", coordinator_uri,
@@ -65,8 +73,20 @@ def spawn_subprocess_worker(
     ]
     if fault_injection:
         cmd += ["--fault-injection", json.dumps(fault_injection)]
+    if host is not None:
+        cmd += ["--host", str(host)]
+    if process_index is not None:
+        cmd += ["--process-index", str(process_index)]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    if local_devices is not None:
+        # must be in the environment BEFORE the child's first jax import
+        # (worker_main's enable_x64() call) — XLA reads it at backend
+        # init, a CLI flag would be too late
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(local_devices)}"
+        ).strip()
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
         text=True, env=env,
@@ -107,6 +127,8 @@ class DistributedQueryRunner:
         # real child processes (worker_main.py), killable with SIGKILL:
         # list of (Popen, node_id, uri)
         self.subprocess_workers: List[tuple] = []
+        # monotone process-index allocator for host-sized capacity units
+        self._next_process_index = 0
         for _ in range(workers):
             w = WorkerServer(
                 _build_catalogs(catalogs), self.coordinator.uri
@@ -148,13 +170,31 @@ class DistributedQueryRunner:
         self,
         fault_injection: Optional[dict] = None,
         startup_timeout: float = 60.0,
+        local_devices: Optional[int] = None,
+        process_index: Optional[int] = None,
+        host: Optional[str] = None,
     ) -> tuple:
         """Spawn a worker as a real child process (worker_main.py) and
         wait until it announces.  Unlike the in-process workers this one
         can be SIGKILLed for true kill -9 chaos: no drain, no goodbye,
-        its sockets refuse instantly.  Returns (Popen, node_id, uri)."""
+        its sockets refuse instantly.  Returns (Popen, node_id, uri).
+
+        With ``local_devices`` (and optional ``process_index``/``host``
+        identity) the child joins as a host-sized capacity unit: a
+        process owning its own slice of virtual devices, announcing a
+        topology the coordinator's ClusterTopology tracks."""
+        if local_devices is not None and process_index is None:
+            process_index = self._next_process_index
+        if process_index is not None:
+            self._next_process_index = max(
+                self._next_process_index, process_index + 1
+            )
+            if host is None:
+                host = f"host{process_index}"
         proc, node_id, uri = spawn_subprocess_worker(
-            self.coordinator.uri, self._catalog_spec, fault_injection
+            self.coordinator.uri, self._catalog_spec, fault_injection,
+            local_devices=local_devices, process_index=process_index,
+            host=host,
         )
         nm = self.coordinator.coordinator.node_manager
         deadline = time.time() + startup_timeout
@@ -172,14 +212,27 @@ class DistributedQueryRunner:
         self.subprocess_workers.append(entry)
         return entry
 
-    def enable_autoscaler(self, **overrides):
+    def enable_autoscaler(self, local_devices=None, **overrides):
         """Turn on the coordinator autoscaler with this runner's
         subprocess-worker spawner as the scale-out path: new capacity
         arrives as real child processes (late joiners, schedulable the
         moment they announce) and scale-in drains through the PR 10
-        lifecycle.  Returns the Autoscaler."""
+        lifecycle.  Returns the Autoscaler.
+
+        ``local_devices`` makes the capacity unit HOST-sized: every
+        admitted worker is a process owning its own ``local_devices``
+        virtual-device slice with a fresh process index — the multi-host
+        elasticity path (a scale-out admits a host, a scale-in drains
+        and retires one)."""
+        if local_devices is None:
+            scale_out = self.add_subprocess_worker
+        else:
+            def scale_out():
+                return self.add_subprocess_worker(
+                    local_devices=local_devices
+                )
         return self.coordinator.coordinator.enable_autoscaler(
-            scale_out=self.add_subprocess_worker, **overrides
+            scale_out=scale_out, **overrides
         )
 
     def sigkill_subprocess_worker(self, index: int = -1) -> tuple:

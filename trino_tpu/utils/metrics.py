@@ -52,6 +52,7 @@ METRIC_SUBSYSTEMS = (
     "slo",
     "objstore",
     "lake",
+    "host",
 )
 
 METRIC_NAME_RE = re.compile(
